@@ -1,0 +1,24 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities of
+MXNet v0.9.5 (NNVM era), re-designed on JAX/XLA/pjit/Pallas.
+
+Usage mirrors the reference's ``import mxnet as mx``::
+
+    import mxnet_tpu as mx
+    a = mx.nd.ones((2, 3), ctx=mx.tpu())
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=10)
+    mod = mx.mod.Module(net, ...)
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import random as rnd
+
+ndarray._init_ndarray_module()
+
+from .ndarray import NDArray

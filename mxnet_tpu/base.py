@@ -1,0 +1,154 @@
+"""Base utilities: errors, env-config, generic registries, attr parsing.
+
+TPU-native re-design of the reference's dmlc-core surface:
+- ``MXNetError`` mirrors python/mxnet/base.py:35 in the reference.
+- ``get_env`` mirrors dmlc::GetEnv runtime config (reference docs/how_to/env_var.md).
+- ``Registry`` mirrors dmlc registry used for initializers/optimizers/iterators
+  (reference include/dmlc usage via MXNET_REGISTER_* macros).
+
+No ctypes / C-ABI plumbing: the compute substrate is JAX/XLA, so the Python
+layer talks to it directly.  A native C runtime exists for the IO/runtime
+components (see mxnet_tpu/native/).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import threading
+
+__all__ = [
+    "MXNetError", "MXTPUError", "get_env", "Registry", "parse_attr_value",
+    "string_types", "numeric_types", "classproperty",
+]
+
+string_types = (str,)
+numeric_types = (int, float)
+
+
+class MXNetError(Exception):
+    """Framework error type (name kept for API parity with the reference,
+    python/mxnet/base.py:35)."""
+
+
+# Idiomatic alias.
+MXTPUError = MXNetError
+
+
+_TRUE_STRINGS = frozenset(("1", "true", "yes", "on"))
+_FALSE_STRINGS = frozenset(("0", "false", "no", "off"))
+
+
+def get_env(name, default=None, typ=None):
+    """Read a runtime config env var (dmlc::GetEnv analog).
+
+    Supported vars follow the reference's catalog (docs/how_to/env_var.md)
+    with an ``MXNET_`` prefix, e.g. ``MXNET_ENGINE_TYPE``,
+    ``MXNET_EXEC_BULK_EXEC_TRAIN``.
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is None and default is not None:
+        typ = type(default)
+    if typ is bool:
+        low = raw.strip().lower()
+        if low in _TRUE_STRINGS:
+            return True
+        if low in _FALSE_STRINGS:
+            return False
+        raise MXNetError("Invalid boolean env var %s=%r" % (name, raw))
+    if typ is not None:
+        return typ(raw)
+    return raw
+
+
+def parse_attr_value(value):
+    """Parse a string attribute into a Python value.
+
+    The reference serializes op kwargs as strings through dmlc::Parameter
+    (src/operator/optimizer_op-inl.h:25-45); symbols store attrs as strings in
+    JSON.  We accept both typed python values and their string forms:
+    ``"(2, 2)"`` -> (2, 2), ``"1"`` -> 1, ``"True"`` -> True, ``"relu"`` -> "relu".
+    """
+    if not isinstance(value, str):
+        return value
+    s = value.strip()
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        low = s.lower()
+        if low in _TRUE_STRINGS and s.isalpha():
+            return True
+        if low in _FALSE_STRINGS and s.isalpha():
+            return False
+        return s
+
+
+def attr_to_string(value):
+    """Serialize an attr value to the string form used in symbol JSON."""
+    if isinstance(value, str):
+        return value
+    if isinstance(value, bool):
+        return "True" if value else "False"
+    if isinstance(value, (list, tuple)):
+        return "(" + ", ".join(attr_to_string(v) for v in value) + (",)" if len(value) == 1 else ")")
+    return str(value)
+
+
+class Registry(object):
+    """Generic name->object registry (dmlc registry analog).
+
+    Used for optimizers, initializers, metrics, data iterators, kvstores.
+    """
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._entries = {}
+
+    def register(self, obj=None, name=None, aliases=()):
+        def _do(o):
+            key = (name or o.__name__).lower()
+            self._entries[key] = o
+            for a in aliases:
+                self._entries[a.lower()] = o
+            return o
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._entries:
+            raise MXNetError(
+                "Cannot find %s %r. Registered: %s"
+                % (self._kind, name, sorted(self._entries)))
+        return self._entries[key]
+
+    def find(self, name):
+        return self._entries.get(name.lower())
+
+    def list(self):
+        return sorted(self._entries)
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+
+class classproperty(object):
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)
+
+
+class _ThreadLocalStack(threading.local):
+    """Thread-local scope stack (used by Context / AttrScope / NameManager)."""
+
+    def __init__(self):
+        self.stack = []
+
+
+def check_call(ret):  # pragma: no cover - API-parity shim
+    """No-op kept for source compatibility with reference-style code."""
+    return ret

@@ -1,0 +1,547 @@
+"""NDArray — the imperative tensor type, backed by jax.Array.
+
+Re-design of the reference NDArray (include/mxnet/ndarray.h:40-531,
+src/ndarray/ndarray.cc).  The reference pushes every mutation through a
+threaded dependency engine; on TPU the same observable contract — async
+dispatch, serialization of conflicting writes, WaitToRead/WaitToWrite —
+is provided by XLA's async execution model: every op is an XLA computation
+dispatched asynchronously; data dependencies order them; ``wait_to_read``
+is ``jax.Array.block_until_ready``.  In-place mutation on immutable
+jax.Arrays is a handle swap (the NDArray is the mutable cell, like the
+reference's Chunk), so ``a += b`` and ``a[:] = x`` behave identically to
+the reference without an explicit engine.
+
+Serialization is byte-compatible with the reference's ``.params`` format
+(src/ndarray/ndarray.cc:593-676, kMXAPINDArrayListMagic=0x112) so reference
+checkpoints load unmodified.
+"""
+from __future__ import annotations
+
+import builtins
+import struct
+import weakref
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ops.registry import OP_REGISTRY, apply_op, get_op
+
+__all__ = [
+    "NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+    "concatenate", "load", "save", "imresize", "waitall", "onehot_encode",
+]
+
+# dtype <-> reference mshadow type_flag (mshadow/base.h kFloat32=0 ...)
+_DTYPE_TO_FLAG = {
+    np.dtype("float32"): 0,
+    np.dtype("float64"): 1,
+    np.dtype("float16"): 2,
+    np.dtype("uint8"): 3,
+    np.dtype("int32"): 4,
+}
+_FLAG_TO_DTYPE = {v: k for k, v in _DTYPE_TO_FLAG.items()}
+# TPU-native extension flags (not in the reference; > any reference flag)
+_DTYPE_TO_FLAG[np.dtype(jnp.bfloat16)] = 100
+_FLAG_TO_DTYPE[100] = np.dtype(jnp.bfloat16)
+
+_LIVE = weakref.WeakSet()
+
+
+def waitall():
+    """Block until all outstanding computation on live arrays finishes
+    (Engine::WaitForAll analog, include/mxnet/engine.h:180)."""
+    for arr in list(_LIVE):
+        try:
+            arr._data.block_until_ready()
+        except Exception:
+            pass
+
+
+class NDArray(object):
+    """Multi-device tensor with numpy-style API (reference
+    python/mxnet/ndarray.py:NDArray)."""
+
+    __slots__ = ("_data", "_ctx", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._ctx = ctx if ctx is not None else current_context()
+        self._data = _to_device(data, self._ctx)
+        _LIVE.add(self)
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def _from_jax(data, ctx=None):
+        arr = NDArray.__new__(NDArray)
+        arr._ctx = ctx if ctx is not None else current_context()
+        arr._data = _to_device(data, arr._ctx) if ctx is not None else data
+        _LIVE.add(arr)
+        return arr
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype).type
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def T(self):
+        return NDArray._from_jax(jnp.transpose(self._data))
+
+    @property
+    def handle(self):
+        """The underlying jax.Array (the PJRT buffer handle)."""
+        return self._data
+
+    # -- sync / transfer --------------------------------------------------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype):
+        return NDArray._from_jax(self._data.astype(np.dtype(dtype)), self._ctx)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context (ndarray.py:copyto)."""
+        if isinstance(other, NDArray):
+            other._data = _to_device(self._data.astype(other._data.dtype),
+                                     other._ctx)
+            return other
+        if isinstance(other, Context):
+            return NDArray._from_jax(self._data, Context(other))
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def copy(self):
+        return NDArray._from_jax(jnp.copy(self._data), self._ctx)
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    # -- shape manipulation ----------------------------------------------
+    def reshape(self, shape, reverse=False):
+        from .ops.tensor import infer_reshape
+        if isinstance(shape, int):
+            shape = (shape,)
+        new_shape = infer_reshape(self.shape, tuple(shape), reverse)
+        return NDArray._from_jax(jnp.reshape(self._data, new_shape), self._ctx)
+
+    def broadcast_to(self, shape):
+        return NDArray._from_jax(jnp.broadcast_to(self._data, tuple(shape)),
+                                 self._ctx)
+
+    def expand_dims(self, axis):
+        return NDArray._from_jax(jnp.expand_dims(self._data, axis), self._ctx)
+
+    def flatten(self):
+        return NDArray._from_jax(
+            jnp.reshape(self._data, (self.shape[0], -1)), self._ctx)
+
+    def transpose(self, axes=None):
+        return NDArray._from_jax(jnp.transpose(self._data, axes), self._ctx)
+
+    def slice(self, start, stop):
+        return self[start:stop]
+
+    def slice_axis(self, axis, begin, end):
+        return NDArray._from_jax(
+            jax.lax.slice_in_dim(self._data, begin, end, axis=axis), self._ctx)
+
+    # -- indexing ---------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        return NDArray._from_jax(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if isinstance(key, builtins.slice) and key == builtins.slice(None):
+            self._data = _to_device(jnp.broadcast_to(value, self.shape),
+                                    self._ctx)
+        else:
+            if isinstance(key, NDArray):
+                key = key._data.astype(jnp.int32)
+            self._data = self._data.at[key].set(value)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # -- python protocol --------------------------------------------------
+    def __repr__(self):
+        return "%s\n<%s %s @%s>" % (
+            str(self.asnumpy()), self.__class__.__name__,
+            "x".join(map(str, self.shape)), self._ctx)
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- arithmetic (dispatched through the op registry so imperative and
+    #    symbolic share one lowering; reference ndarray.py BinaryOp) -------
+    def _binary(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            out = apply_op(get_op(op_name), (a._data, b._data), {})[0]
+        elif isinstance(other, (int, float, np.number)):
+            out = apply_op(get_op(scalar_op), (self._data,),
+                           {"scalar": float(other)})[0]
+        else:
+            return NotImplemented
+        return NDArray._from_jax(out, self._ctx)
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        return self._binary(o, "broadcast_div", "_rdiv_scalar", reverse=True)
+
+    __rtruediv__ = __rdiv__
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", "_rmod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", "_rpower_scalar", reverse=True)
+
+    def __neg__(self):
+        return NDArray._from_jax(-self._data, self._ctx)
+
+    def __abs__(self):
+        return NDArray._from_jax(jnp.abs(self._data), self._ctx)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: handle swap — the NDArray is the mutable cell
+    def _inplace(self, other, op_name, scalar_op):
+        res = self._binary(other, op_name, scalar_op)
+        if res is NotImplemented:
+            return res
+        self._data = res._data
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, "broadcast_add", "_plus_scalar")
+
+    def __isub__(self, o):
+        return self._inplace(o, "broadcast_sub", "_minus_scalar")
+
+    def __imul__(self, o):
+        return self._inplace(o, "broadcast_mul", "_mul_scalar")
+
+    def __idiv__(self, o):
+        return self._inplace(o, "broadcast_div", "_div_scalar")
+
+    __itruediv__ = __idiv__
+
+
+def _to_device(data, ctx):
+    dev = ctx.jax_device
+    if len(data.devices()) == 1 and next(iter(data.devices())) == dev:
+        return data
+    return jax.device_put(data, dev)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference python/mxnet/ndarray.py)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(np.dtype(dtype))
+        return NDArray._from_jax(src, ctx or source_array._ctx)
+    # default dtype is float32 like the reference (python/mxnet/ndarray.py
+    # array(): mx_real_t unless dtype given)
+    src = np.asarray(source_array,
+                     dtype=np.dtype(dtype) if dtype else np.float32)
+    return NDArray(src, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(jnp.zeros(shape, dtype=np.dtype(dtype)), ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(jnp.zeros(shape, dtype=np.dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(jnp.ones(shape, dtype=np.dtype(dtype)), ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._from_jax(jnp.full(shape, val, dtype=np.dtype(dtype)), ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=np.dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray._from_jax(out, ctx)
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray._from_jax(jnp.moveaxis(tensor._data, source, destination),
+                             tensor._ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    if len(arrays) == 1 and not always_copy:
+        return arrays[0]
+    return NDArray._from_jax(
+        jnp.concatenate([a._data for a in arrays], axis=axis), arrays[0]._ctx)
+
+
+def onehot_encode(indices, out):
+    """one-hot into ``out`` (reference ndarray.py onehot_encode)."""
+    depth = out.shape[1]
+    out._data = jax.nn.one_hot(indices._data.astype(jnp.int32), depth,
+                               dtype=out._data.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serialization — byte-compatible with reference .params files
+# (src/ndarray/ndarray.cc:593-676)
+# ---------------------------------------------------------------------------
+
+_LIST_MAGIC = 0x112
+
+
+def _save_one(fo, arr):
+    shape = arr.shape
+    fo.write(struct.pack("<I", len(shape)))
+    fo.write(struct.pack("<%dI" % len(shape), *shape))
+    if len(shape) == 0:
+        return
+    fo.write(struct.pack("<ii", 1, 0))  # Context: kCPU, dev_id 0
+    npdata = arr.asnumpy()
+    flag = _DTYPE_TO_FLAG.get(np.dtype(npdata.dtype))
+    if flag is None:
+        npdata = npdata.astype(np.float32)
+        flag = 0
+    fo.write(struct.pack("<i", flag))
+    fo.write(np.ascontiguousarray(npdata).tobytes())
+
+
+def _load_one(fi, ctx=None):
+    ndim, = struct.unpack("<I", fi.read(4))
+    if ndim == 0:
+        return empty((), ctx)
+    shape = struct.unpack("<%dI" % ndim, fi.read(4 * ndim))
+    fi.read(8)  # Context (dev_type, dev_id) — ignored on load
+    flag, = struct.unpack("<i", fi.read(4))
+    dtype = _FLAG_TO_DTYPE[flag]
+    count = int(np.prod(shape)) if shape else 1
+    buf = fi.read(count * dtype.itemsize)
+    data = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    return array(data, ctx=ctx, dtype=dtype)
+
+
+def save(fname, data):
+    """Save list/dict of NDArrays in reference ``.params`` format."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names, arrays = zip(*sorted(data.items())) if data else ((), ())
+    else:
+        names, arrays = (), tuple(data)
+    with open(fname, "wb") as fo:
+        fo.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        fo.write(struct.pack("<Q", len(arrays)))
+        for arr in arrays:
+            _save_one(fo, arr)
+        fo.write(struct.pack("<Q", len(names)))
+        for name in names:
+            encoded = name.encode("utf-8")
+            fo.write(struct.pack("<Q", len(encoded)))
+            fo.write(encoded)
+
+
+def load(fname, ctx=None):
+    """Load a reference-format ``.params`` file → dict or list of NDArray."""
+    with open(fname, "rb") as fi:
+        magic, _ = struct.unpack("<QQ", fi.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError("Invalid NDArray file format: " + fname)
+        num, = struct.unpack("<Q", fi.read(8))
+        arrays = [_load_one(fi, ctx) for i in range(num)]
+        num_names, = struct.unpack("<Q", fi.read(8))
+        names = []
+        for _i in range(num_names):
+            ln, = struct.unpack("<Q", fi.read(8))
+            names.append(fi.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def imresize(src, w, h, *args, **kwargs):
+    """Bilinear image resize (reference src/io/image_io.cc imresize analog)."""
+    out = jax.image.resize(src._data.astype(jnp.float32),
+                           (h, w) + src.shape[2:], method="bilinear")
+    return NDArray._from_jax(out.astype(src._data.dtype), src._ctx)
+
+
+# ---------------------------------------------------------------------------
+# autogenerated op functions — every registered op becomes mx.nd.<op>
+# (reference _init_ndarray_module, python/mxnet/ndarray.py)
+# ---------------------------------------------------------------------------
+
+def _make_ndarray_function(opdef, func_name):
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        ctx = kwargs.pop("ctx", None)
+        is_train = kwargs.pop("is_train", True if opdef.needs_is_train else False)
+        arrays = []
+        for a in args:
+            if isinstance(a, NDArray):
+                arrays.append(a._data)
+            elif isinstance(a, (int, float)) and "scalar" not in kwargs and \
+                    not opdef.get_input_names(kwargs):
+                kwargs["scalar"] = a
+            else:
+                arrays.append(jnp.asarray(a))
+        # named tensor inputs (data=..., weight=...)
+        in_names = opdef.get_input_names(kwargs) + opdef.get_aux_names(kwargs)
+        for nm in in_names:
+            if nm in kwargs and isinstance(kwargs[nm], NDArray):
+                arrays.append(kwargs.pop(nm)._data)
+        results = apply_op(opdef, tuple(arrays), kwargs, is_train=is_train)
+        if ctx is not None:
+            ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+            results = tuple(_to_device(r, ctx) for r in results)
+        ndarrays = tuple(NDArray._from_jax(r, ctx) for r in results)
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            for o, r in zip(outs, ndarrays):
+                o._data = _to_device(r._data.astype(o._data.dtype), o._ctx)
+            return out
+        if len(ndarrays) == 1:
+            return ndarrays[0]
+        return list(ndarrays)
+
+    generic_op.__name__ = func_name
+    generic_op.__doc__ = opdef.doc
+    return generic_op
+
+
+def _init_ndarray_module():
+    module = globals()
+    for reg_name, opdef in list(OP_REGISTRY.items()):
+        if reg_name in ("zeros", "ones", "full", "arange"):
+            continue  # python creation fns above already cover these
+        if reg_name not in module:
+            module[reg_name] = _make_ndarray_function(opdef, reg_name)
+            __all__.append(reg_name)
+
+
+# populated by mxnet_tpu/__init__ after all op modules import

@@ -1,0 +1,172 @@
+"""Operator registry — the single source of truth for all ops.
+
+Re-designs the reference's dual registries (NNVM FCompute ops,
+include/mxnet/op_attr_types.h:33-63, and legacy OperatorProperty,
+include/mxnet/operator.h:77-155) as ONE registry of pure JAX functions.
+Each op is a pure function over jax.Arrays; the imperative layer (ndarray.py)
+jit-caches it per attr-set, and the symbolic layer (symbol.py/executor.py)
+traces it into a whole-graph jit — which is how the reference's cached-op /
+bulk-segment machinery (src/executor/graph_executor.cc:556,690) collapses
+into XLA's own fusion.
+
+Op conventions
+--------------
+``fn(*inputs, **attrs)`` -> jax.Array | tuple of jax.Arrays
+  - inputs are the op's data+parameter inputs, in ``input_names`` order,
+    followed by aux states in ``aux_names`` order (BatchNorm moving stats —
+    the reference's auxiliary states, include/mxnet/operator.h aux_states).
+  - if ``needs_is_train``: fn must accept keyword ``is_train`` (bool, static).
+  - if ``needs_rng``: fn must accept keyword ``rng`` (jax PRNG key).
+  - ops with aux states return outputs + updated aux concatenated in one flat
+    tuple; the executor splits on ``num_outputs``.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError, parse_attr_value
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OP_REGISTRY", "apply_op"]
+
+OP_REGISTRY = {}
+
+
+class OpDef(object):
+    __slots__ = (
+        "name", "fn", "input_names", "aux_names", "num_outputs",
+        "infer_shape", "needs_is_train", "needs_rng", "variable_inputs",
+        "aliases", "output_names", "hidden", "param_indices", "doc",
+    )
+
+    def __init__(self, name, fn, input_names=("data",), aux_names=(),
+                 num_outputs=1, infer_shape=None, needs_is_train=False,
+                 needs_rng=False, variable_inputs=False, aliases=(),
+                 output_names=None, hidden=False):
+        self.name = name
+        self.fn = fn
+        self.input_names = input_names          # tuple | callable(attrs)->tuple
+        self.aux_names = aux_names              # tuple | callable(attrs)->tuple
+        self.num_outputs = num_outputs          # int | callable(attrs)->int
+        self.infer_shape = infer_shape          # optional custom shape inference
+        self.needs_is_train = needs_is_train
+        self.needs_rng = needs_rng
+        self.variable_inputs = variable_inputs  # Concat/add_n style variadic
+        self.aliases = tuple(aliases)
+        self.output_names = output_names        # tuple | callable(attrs)->tuple
+        self.hidden = hidden
+        self.doc = fn.__doc__
+
+    # -- resolved-per-attrs accessors ------------------------------------
+    def get_input_names(self, attrs):
+        names = self.input_names
+        return tuple(names(attrs)) if callable(names) else tuple(names)
+
+    def get_aux_names(self, attrs):
+        names = self.aux_names
+        return tuple(names(attrs)) if callable(names) else tuple(names)
+
+    def get_num_outputs(self, attrs):
+        n = self.num_outputs
+        return n(attrs) if callable(n) else n
+
+    def get_output_names(self, attrs):
+        if self.output_names is None:
+            n = self.get_num_outputs(attrs)
+            if n == 1:
+                return ("output",)
+            return tuple("output%d" % i for i in range(n))
+        names = self.output_names
+        return tuple(names(attrs)) if callable(names) else tuple(names)
+
+    def normalize_attrs(self, attrs):
+        """Parse string attr values into typed python values."""
+        return {k: parse_attr_value(v) for k, v in attrs.items()}
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name, **kwargs):
+    """Decorator registering a JAX function as an op.
+
+    Example::
+
+        @register("broadcast_add", input_names=("lhs", "rhs"),
+                  aliases=("broadcast_plus",))
+        def broadcast_add(lhs, rhs):
+            return jnp.add(lhs, rhs)
+    """
+    def _reg(fn):
+        opdef = OpDef(name, fn, **kwargs)
+        if name in OP_REGISTRY:
+            raise MXNetError("op %r registered twice" % name)
+        OP_REGISTRY[name] = opdef
+        for alias in opdef.aliases:
+            OP_REGISTRY[alias] = opdef
+        return fn
+    return _reg
+
+
+def get_op(name):
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator %r is not registered" % (name,)) from None
+
+
+def list_ops():
+    """Distinct canonical op names (MXListAllOpNames analog)."""
+    return sorted({op.name for op in OP_REGISTRY.values()})
+
+
+# ---------------------------------------------------------------------------
+# jit-cached imperative application
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=8192)
+def _jitted(op_name, attr_items, is_train, with_rng):
+    """One compiled callable per (op, attrs, is_train) — the TPU analog of the
+    reference's cached engine ops (graph_executor.cc:556)."""
+    import jax
+    op = get_op(op_name)
+    attrs = dict(attr_items)
+    kw = {}
+    if op.needs_is_train:
+        kw["is_train"] = is_train
+
+    if with_rng:
+        def call(rng, *arrays):
+            return op.fn(*arrays, rng=rng, **attrs, **kw)
+    else:
+        def call(*arrays):
+            return op.fn(*arrays, **attrs, **kw)
+    return jax.jit(call)
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def apply_op(op, arrays, attrs, is_train=False, rng=None):
+    """Run an op imperatively on jax.Arrays, via the per-attr jit cache.
+
+    Returns a tuple of jax.Arrays (outputs, then updated aux if any).
+    """
+    attrs = op.normalize_attrs(attrs)
+    items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+    with_rng = op.needs_rng
+    fn = _jitted(op.name, items, bool(is_train), with_rng)
+    if with_rng:
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key()
+        out = fn(rng, *arrays)
+    else:
+        out = fn(*arrays)
+    if isinstance(out, (tuple, list)):
+        return tuple(out)
+    return (out,)

@@ -1,0 +1,726 @@
+"""Tensor ops — the NNVM FCompute op surface as pure JAX lowerings.
+
+Covers the reference's ``src/operator/tensor/`` families (9,672 LoC of
+CUDA/mshadow there; here each op is a few lines of jax/lax that XLA fuses and
+tiles onto the MXU/VPU):
+- elemwise unary/binary + scalar + broadcast + logic (elemwise_*op*.cc)
+- reductions (broadcast_reduce_op_value.cc)
+- matrix ops: dot/batch_dot/transpose/reshape/slice/... (matrix_op.cc)
+- init ops (init_op.cc), indexing ops (indexing_op.cc),
+  ordering ops (ordering_op.cc), control flow (control_flow_op.cc),
+  sampling (sample_op.cc), optimizer update ops (optimizer_op.cc:18-73)
+
+Reshape implements the reference's special codes 0/-1/-2/-3/-4
+(src/operator/tensor/matrix_op-inl.h ReshapeParam).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _tuple(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def _norm_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(a + ndim if a < 0 else a for a in axis)
+    return axis + ndim if axis < 0 else axis
+
+
+def _reduce_axes(data, axis, exclude=False):
+    if axis is None or axis == () or axis == []:
+        axes = tuple(range(data.ndim))
+    else:
+        axes = _norm_axis(_tuple(axis), data.ndim)
+    if exclude:
+        axes = tuple(i for i in range(data.ndim) if i not in axes)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# elemwise binary (same-shape) — elemwise_binary_op.cc
+# ---------------------------------------------------------------------------
+
+@register("elemwise_add", input_names=("lhs", "rhs"), aliases=("_add", "_plus", "_Plus"))
+def elemwise_add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@register("elemwise_sub", input_names=("lhs", "rhs"), aliases=("_sub", "_minus", "_Minus"))
+def elemwise_sub(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@register("elemwise_mul", input_names=("lhs", "rhs"), aliases=("_mul", "_Mul"))
+def elemwise_mul(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@register("elemwise_div", input_names=("lhs", "rhs"), aliases=("_div", "_Div"))
+def elemwise_div(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@register("_power", input_names=("lhs", "rhs"), aliases=("_Power",))
+def _power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@register("_maximum", input_names=("lhs", "rhs"), aliases=("_Maximum",))
+def _maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("_minimum", input_names=("lhs", "rhs"), aliases=("_Minimum",))
+def _minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@register("_hypot", input_names=("lhs", "rhs"))
+def _hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+@register("_grad_add", input_names=("lhs", "rhs"))
+def _grad_add(lhs, rhs):
+    """Gradient aggregation add (reference src/executor/graph_executor.cc:90)."""
+    return jnp.add(lhs, rhs)
+
+
+# ---------------------------------------------------------------------------
+# broadcast binary — elemwise_binary_broadcast_op.cc
+# ---------------------------------------------------------------------------
+
+def _broadcast_binary(name, jfn, aliases=()):
+    @register(name, input_names=("lhs", "rhs"), aliases=aliases)
+    def _op(lhs, rhs, _jfn=jfn):
+        return _jfn(lhs, rhs)
+    _op.__name__ = name
+    return _op
+
+
+_broadcast_binary("broadcast_add", jnp.add, aliases=("broadcast_plus",))
+_broadcast_binary("broadcast_sub", jnp.subtract, aliases=("broadcast_minus",))
+_broadcast_binary("broadcast_mul", jnp.multiply)
+_broadcast_binary("broadcast_div", jnp.divide)
+_broadcast_binary("broadcast_mod", jnp.mod)
+_broadcast_binary("broadcast_power", jnp.power)
+_broadcast_binary("broadcast_maximum", jnp.maximum)
+_broadcast_binary("broadcast_minimum", jnp.minimum)
+_broadcast_binary("broadcast_hypot", jnp.hypot)
+
+
+def _logic(name, jfn, aliases=()):
+    @register(name, input_names=("lhs", "rhs"), aliases=aliases)
+    def _op(lhs, rhs, _jfn=jfn):
+        return _jfn(lhs, rhs).astype(jnp.result_type(lhs))
+    return _op
+
+
+_logic("broadcast_equal", jnp.equal, aliases=("_equal", "_Equal"))
+_logic("broadcast_not_equal", jnp.not_equal, aliases=("_not_equal", "_Not_Equal"))
+_logic("broadcast_greater", jnp.greater, aliases=("_greater", "_Greater"))
+_logic("broadcast_greater_equal", jnp.greater_equal, aliases=("_greater_equal",))
+_logic("broadcast_lesser", jnp.less, aliases=("_lesser", "_Lesser"))
+_logic("broadcast_lesser_equal", jnp.less_equal, aliases=("_lesser_equal",))
+_logic("broadcast_logical_and", jnp.logical_and)
+_logic("broadcast_logical_or", jnp.logical_or)
+_logic("broadcast_logical_xor", jnp.logical_xor)
+
+
+# ---------------------------------------------------------------------------
+# scalar ops — elemwise_binary_scalar_op.cc
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(data, scalar=0.0, _fn=fn):
+        return _fn(data, jnp.asarray(scalar, dtype=data.dtype))
+    return _op
+
+
+_scalar_op("_plus_scalar", lambda a, s: a + s, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", lambda a, s: a - s, aliases=("_MinusScalar",))
+_scalar_op("_rminus_scalar", lambda a, s: s - a, aliases=("_RMinusScalar",))
+_scalar_op("_mul_scalar", lambda a, s: a * s, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", lambda a, s: a / s, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", lambda a, s: s / a, aliases=("_RDivScalar",))
+_scalar_op("_mod_scalar", lambda a, s: jnp.mod(a, s))
+_scalar_op("_rmod_scalar", lambda a, s: jnp.mod(s, a))
+_scalar_op("_power_scalar", jnp.power, aliases=("_PowerScalar",))
+_scalar_op("_rpower_scalar", lambda a, s: jnp.power(s, a), aliases=("_RPowerScalar",))
+_scalar_op("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_scalar_op("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+_scalar_op("_hypot_scalar", jnp.hypot)
+_scalar_op("_equal_scalar", lambda a, s: (a == s).astype(a.dtype))
+_scalar_op("_not_equal_scalar", lambda a, s: (a != s).astype(a.dtype))
+_scalar_op("_greater_scalar", lambda a, s: (a > s).astype(a.dtype))
+_scalar_op("_greater_equal_scalar", lambda a, s: (a >= s).astype(a.dtype))
+_scalar_op("_lesser_scalar", lambda a, s: (a < s).astype(a.dtype))
+_scalar_op("_lesser_equal_scalar", lambda a, s: (a <= s).astype(a.dtype))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Smooth L1 (reference src/operator/tensor/elemwise_binary_scalar_op_extended.cc)."""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# unary — elemwise_unary_op.cc, mshadow_op.h kernels
+# ---------------------------------------------------------------------------
+
+def _unary(name, jfn, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(data, _jfn=jfn):
+        return _jfn(data)
+    return _op
+
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("negative", jnp.negative)
+_unary("reciprocal", jnp.reciprocal)
+_unary("gamma", lambda x: jnp.exp(lax.lgamma(x)))
+_unary("gammaln", lax.lgamma)
+_unary("erf", lax.erf)
+_unary("erfinv", lax.erf_inv)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("identity", lambda x: x, aliases=("_copy", "_identity_with_attr_like_rhs"))
+
+
+@register("BlockGrad", aliases=("stop_gradient", "block_grad"))
+def block_grad(data):
+    """Forward identity, zero gradient (reference src/operator/block_grad.cc)."""
+    return lax.stop_gradient(data)
+
+
+@register("Cast", aliases=("cast",))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("softmax", aliases=("Softmax",))
+def softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# reductions — broadcast_reduce_op_value.cc
+# ---------------------------------------------------------------------------
+
+def _reduce(name, jfn, aliases=(), dtype_keep=True):
+    @register(name, aliases=aliases)
+    def _op(data, axis=None, keepdims=False, exclude=False, _jfn=jfn):
+        axes = _reduce_axes(data, axis, exclude)
+        return _jfn(data, axis=axes, keepdims=bool(keepdims))
+    return _op
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    axes = _reduce_axes(data, axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axes, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=bool(keepdims)))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=_norm_axis(axis, data.ndim), keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=_norm_axis(axis, data.ndim), keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    """argmax over axis 1 (reference broadcast_reduce_op_value.cc argmax_channel)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# broadcast_to / broadcast_axis
+# ---------------------------------------------------------------------------
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    shape = _tuple(shape)
+    target = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, target)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axis, size = _tuple(axis), _tuple(size)
+    target = list(data.shape)
+    for a, s in zip(axis, size):
+        target[_norm_axis(a, data.ndim)] = s
+    return jnp.broadcast_to(data, tuple(target))
+
+
+# ---------------------------------------------------------------------------
+# matrix ops — matrix_op.cc
+# ---------------------------------------------------------------------------
+
+@register("dot", input_names=("lhs", "rhs"))
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """dot (reference src/operator/tensor/matrix_op.cc dot) — lowers straight
+    onto the MXU via lax.dot_general after flattening to 2D semantics."""
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot", input_names=("lhs", "rhs"))
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("transpose")
+def transpose(data, axes=()):
+    axes = _tuple(axes)
+    if not axes:
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("SwapAxis", aliases=("swapaxes", "SwapAxes"))
+def swapaxes(data, dim1=0, dim2=0):
+    """reference src/operator/swapaxis.cc"""
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+def infer_reshape(in_shape, target, reverse=False):
+    """Implements the reference ReshapeParam special codes
+    (matrix_op-inl.h): 0 copy, -1 infer, -2 copy-all-remaining,
+    -3 merge-two, -4 split-one."""
+    in_shape = list(in_shape)
+    if reverse:
+        in_shape = in_shape[::-1]
+        target = list(target)[::-1]
+    out = []
+    src_idx = 0
+    infer_idx = -1
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(in_shape[src_idx]); src_idx += 1
+        elif t == -1:
+            infer_idx = len(out); out.append(1)
+        elif t == -2:
+            out.extend(in_shape[src_idx:]); src_idx = len(in_shape)
+        elif t == -3:
+            out.append(in_shape[src_idx] * in_shape[src_idx + 1]); src_idx += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            src = in_shape[src_idx]; src_idx += 1
+            if d1 == -1:
+                d1 = src // d2
+            if d2 == -1:
+                d2 = src // d1
+            out.extend([d1, d2]); i += 2
+        else:
+            out.append(t)
+            if t != -1:
+                src_idx += 1 if src_idx < len(in_shape) else 0
+        i += 1
+    total = 1
+    for d in in_shape:
+        total *= d
+    if infer_idx >= 0:
+        known = 1
+        for j, d in enumerate(out):
+            if j != infer_idx:
+                known *= d
+        out[infer_idx] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=(), reverse=False, target_shape=None, keep_highest=False):
+    if target_shape:  # legacy attr (matrix_op-inl.h legacy target_shape)
+        tgt = list(_tuple(target_shape))
+        if keep_highest:
+            tgt[0] = data.shape[0]
+        return jnp.reshape(data, tuple(tgt))
+    return jnp.reshape(data, infer_reshape(data.shape, _tuple(shape), reverse))
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    begin, end = _tuple(begin), _tuple(end)
+    step = _tuple(step) if step else (1,) * len(begin)
+    idx = []
+    for i in range(data.ndim):
+        if i < len(begin):
+            b = begin[i]
+            e = end[i] if i < len(end) else None
+            s = step[i] if i < len(step) and step[i] else 1
+            idx.append(slice(b if b is not None else None,
+                             e if e is not None else None, s))
+        else:
+            idx.append(slice(None))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    axis = _norm_axis(axis, data.ndim)
+    n = data.shape[axis]
+    if end is None:
+        end = n
+    if end < 0:
+        end += n
+    if begin < 0:
+        begin += n
+    return lax.slice_in_dim(data, begin, end, axis=axis)
+
+
+@register("reverse", aliases=("flip",))
+def reverse(data, axis=()):
+    return jnp.flip(data, axis=_norm_axis(_tuple(axis), data.ndim))
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=_norm_axis(axis, data.ndim))
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, _tuple(reps))
+
+
+@register("stack", variable_inputs=True, input_names=lambda attrs: tuple(
+    "arg%d" % i for i in range(int(attrs.get("num_args", 1)))))
+def stack(*args, num_args=1, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("add_n", variable_inputs=True, aliases=("ElementWiseSum", "_sum"),
+          input_names=lambda attrs: tuple(
+              "arg%d" % i for i in range(int(attrs.get("num_args", 1)))))
+def add_n(*args, num_args=None):
+    """reference src/operator/tensor/elemwise_sum.cc"""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# init ops — init_op.cc
+# ---------------------------------------------------------------------------
+
+@register("_zeros", input_names=(), aliases=("zeros",))
+def _zeros(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(_tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_ones", input_names=(), aliases=("ones",))
+def _ones(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(_tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_full", input_names=(), aliases=("full",))
+def _full(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(_tuple(shape), value, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", input_names=(), aliases=("arange",))
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+# ---------------------------------------------------------------------------
+# indexing — indexing_op.cc
+# ---------------------------------------------------------------------------
+
+@register("take", input_names=("a", "indices"))
+def take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("batch_take", input_names=("a", "indices"))
+def batch_take(a, indices):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("one_hot", input_names=("indices",))
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register("pick", input_names=("data", "index"))
+def pick(data, index, axis=1, keepdims=False):
+    axis = _norm_axis(axis, data.ndim)
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# control flow — control_flow_op.cc
+# ---------------------------------------------------------------------------
+
+@register("where", input_names=("condition", "x", "y"))
+def where(condition, x, y):
+    if condition.ndim == 1 and x.ndim > 1:
+        shape = (-1,) + (1,) * (x.ndim - 1)
+        condition = condition.reshape(shape)
+    return jnp.where(condition != 0, x, y)
+
+
+# ---------------------------------------------------------------------------
+# ordering — ordering_op.cc
+# ---------------------------------------------------------------------------
+
+@register("topk", num_outputs=lambda attrs: 2 if str(attrs.get("ret_typ", "indices")) == "both" else 1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    axis = _norm_axis(axis, data.ndim)
+    src = jnp.swapaxes(data, axis, -1)
+    neg = src if not is_ascend else -src
+    vals, idxs = lax.top_k(neg, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.swapaxes(vals, axis, -1)
+    idxs = jnp.swapaxes(idxs, axis, -1).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    if ret_typ == "mask":
+        mask = jnp.zeros(src.shape, dtype=data.dtype)
+        mask = jnp.put_along_axis(mask, idxs.astype(jnp.int32), 1.0, axis=-1,
+                                  inplace=False)
+        return jnp.swapaxes(mask, axis, -1)
+    return idxs
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=_norm_axis(axis, data.ndim))
+    if not is_ascend:
+        out = jnp.flip(out, axis=_norm_axis(axis, data.ndim))
+    return out
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True):
+    axis = _norm_axis(axis, data.ndim)
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# sampling — sample_op.cc
+# ---------------------------------------------------------------------------
+
+@register("_sample_uniform", input_names=(), needs_rng=True,
+          aliases=("uniform", "_random_uniform", "random_uniform"))
+def _sample_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.uniform(rng, _tuple(shape), dtype=jnp.dtype(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_sample_normal", input_names=(), needs_rng=True,
+          aliases=("normal", "_random_normal", "random_normal"))
+def _sample_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return loc + scale * jax.random.normal(rng, _tuple(shape), dtype=jnp.dtype(dtype))
+
+
+@register("_sample_gamma", input_names=(), needs_rng=True, aliases=("gamma_sample",))
+def _sample_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.gamma(rng, alpha, _tuple(shape), dtype=jnp.dtype(dtype)) * beta
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops — optimizer_op.cc:18-73 (the dist-server update path)
+# ---------------------------------------------------------------------------
+
+def _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad + wd * weight
+
+
+@register("sgd_update", input_names=("weight", "grad"))
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", input_names=("weight", "grad", "mom"), num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
+    mom = momentum * mom - lr * g
+    return weight + mom, mom
+
+
+@register("adam_update", input_names=("weight", "grad", "mean", "var"), num_outputs=3)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
+    mean = beta1 * mean + (1.0 - beta1) * g
+    var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    weight = weight - lr * mean / (jnp.sqrt(var) + epsilon)
+    return weight, mean, var
+
+
+@register("rmsprop_update", input_names=("weight", "grad", "n"), num_outputs=2)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
+    n = gamma1 * n + (1.0 - gamma1) * jnp.square(g)
+    weight = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        weight = jnp.clip(weight, -clip_weights, clip_weights)
+    return weight, n
+
+
+@register("rmspropalex_update", input_names=("weight", "grad", "n", "g", "delta"),
+          num_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
+    n = gamma1 * n + (1.0 - gamma1) * jnp.square(gr)
+    g = gamma1 * g + (1.0 - gamma1) * gr
+    delta = gamma2 * delta - lr * gr / jnp.sqrt(n - jnp.square(g) + epsilon)
+    weight = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        weight = jnp.clip(weight, -clip_weights, clip_weights)
+    return weight, n, g, delta
+
+
+# ---------------------------------------------------------------------------
+# loss helpers — loss_binary_op.cc
+# ---------------------------------------------------------------------------
+
+@register("softmax_cross_entropy", input_names=("data", "label"))
+def softmax_cross_entropy(data, label):
+    """reference src/operator/loss_binary_op.cc — summed cross entropy."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked)

@@ -1,0 +1,60 @@
+"""Random state management.
+
+The reference seeds per-device mshadow Random resources via
+``MXRandomSeed`` (src/resource.cc SeedRandom, python/mxnet/random.py).
+TPU-native design: one functional PRNG key chain (jax.random) that the
+imperative layer splits from; graph executors fold a per-step counter into
+their own key so compiled training steps stay pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as _np
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global RNG (parity: python/mxnet/random.py mx.random.seed).
+
+    Also reseeds numpy-free framework components; numpy's own RNG is NOT
+    touched (same behavior as the reference, which warns about this in
+    random.py docstring).
+    """
+    if not isinstance(seed_state, (int, _np.integer)):
+        raise ValueError("seed must be an int")
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh PRNG key."""
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def uniform(low=0, high=1, shape=(), ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+    return nd.uniform(low=low, high=high, shape=shape, ctx=ctx, dtype=dtype, out=out)
+
+
+def normal(loc=0, scale=1, shape=(), ctx=None, dtype="float32", out=None):
+    from . import ndarray as nd
+    return nd.normal(loc=loc, scale=scale, shape=shape, ctx=ctx, dtype=dtype, out=out)
+
+
+def randint(low, high, shape=(), ctx=None, dtype="int32"):
+    from . import ndarray as nd
+    data = jax.random.randint(next_key(), shape, low, high)
+    return nd.NDArray._from_jax(data.astype(dtype), ctx)

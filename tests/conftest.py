@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Tests run on an 8-device virtual CPU mesh — the reference tests multi-device
+semantics the same way, with cpu(0)/cpu(1) fake devices
+(tests/python/unittest/test_model_parallel.py:30-31).  The environment pins
+JAX_PLATFORMS=axon (real TPU), so we must override via jax.config before the
+backend initializes; XLA_FLAGS must be set before that too.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

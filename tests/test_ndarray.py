@@ -1,0 +1,236 @@
+"""NDArray imperative-op tests vs numpy (mirrors reference
+tests/python/unittest/test_ndarray.py strategy: every imperative op checked
+against a numpy oracle)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def test_creation():
+    assert mx.nd.zeros((2, 3)).shape == (2, 3)
+    assert (mx.nd.ones((2, 3)).asnumpy() == 1).all()
+    assert (mx.nd.full((2, 2), 3.5).asnumpy() == 3.5).all()
+    assert_close(mx.nd.arange(0, 10, 2).asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.dtype == np.float32
+    assert a.size == 4 and a.ndim == 2
+
+
+def test_arith():
+    a = mx.nd.array(np.random.rand(3, 4))
+    b = mx.nd.array(np.random.rand(3, 4))
+    an, bn = a.asnumpy(), b.asnumpy()
+    assert_close((a + b).asnumpy(), an + bn)
+    assert_close((a - b).asnumpy(), an - bn)
+    assert_close((a * b).asnumpy(), an * bn)
+    assert_close((a / b).asnumpy(), an / bn)
+    assert_close((a + 2).asnumpy(), an + 2)
+    assert_close((2 - a).asnumpy(), 2 - an)
+    assert_close((a ** 2).asnumpy(), an ** 2)
+    assert_close((-a).asnumpy(), -an)
+    assert_close(abs(a - b).asnumpy(), abs(an - bn))
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    b = mx.nd.ones((2, 2)) * 3
+    a += b
+    assert (a.asnumpy() == 4).all()
+    a *= 2
+    assert (a.asnumpy() == 8).all()
+    a[:] = 1.5
+    assert (a.asnumpy() == 1.5).all()
+
+
+def test_comparisons():
+    a = mx.nd.array([1, 2, 3])
+    b = mx.nd.array([3, 2, 1])
+    assert_close((a == b).asnumpy(), [0, 1, 0])
+    assert_close((a > b).asnumpy(), [0, 0, 1])
+    assert_close((a <= b).asnumpy(), [1, 1, 0])
+
+
+def test_dot():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(5, 6).astype(np.float32)
+    assert_close(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)).asnumpy(),
+                 a.dot(b), rtol=1e-4)
+    assert_close(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True).asnumpy(),
+        a.dot(b), rtol=1e-4)
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    y = np.random.rand(3, 5, 2).astype(np.float32)
+    assert_close(mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y)).asnumpy(),
+                 np.matmul(x, y), rtol=1e-4)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_close(mx.nd.sum(a).asnumpy(), x.sum(), rtol=1e-4)
+    assert_close(mx.nd.sum(a, axis=1).asnumpy(), x.sum(axis=1), rtol=1e-4)
+    assert_close(mx.nd.sum(a, axis=(0, 2)).asnumpy(), x.sum(axis=(0, 2)), rtol=1e-4)
+    assert_close(mx.nd.max(a, axis=2).asnumpy(), x.max(axis=2))
+    assert_close(mx.nd.mean(a).asnumpy(), x.mean(), rtol=1e-4)
+    assert_close(mx.nd.argmax(a, axis=1).asnumpy(), x.argmax(axis=1))
+    assert_close(mx.nd.norm(a).asnumpy(), np.sqrt((x ** 2).sum()), rtol=1e-4)
+    # exclude semantics (reference broadcast_reduce_op)
+    assert_close(mx.nd.sum(a, axis=1, exclude=True).asnumpy(),
+                 x.sum(axis=(0, 2)), rtol=1e-4)
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((4, 3, 2)).shape == (4, 3, 2)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((0, -3)).shape == (2, 12)
+    assert a.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 4)
+
+
+def test_slice_and_index():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(x)
+    assert_close(a[1].asnumpy(), x[1])
+    assert_close(a[0:2].asnumpy(), x[0:2])
+    assert_close(a.slice_axis(1, 1, 3).asnumpy(), x[:, 1:3])
+    assert_close(mx.nd.slice_axis(a, axis=1, begin=1, end=3).asnumpy(), x[:, 1:3])
+    assert_close(mx.nd.slice_axis(a, axis=2, begin=-2, end=None).asnumpy(), x[:, :, -2:])
+    assert_close(mx.nd.slice(a, begin=(0, 1), end=(2, 3)).asnumpy(), x[0:2, 1:3])
+    assert_close(mx.nd.flip(a, axis=1).asnumpy(), x[:, ::-1])
+    assert_close(mx.nd.transpose(a, axes=(1, 0, 2)).asnumpy(), x.transpose(1, 0, 2))
+    assert_close(mx.nd.expand_dims(a, axis=1).asnumpy(), x[:, None])
+    assert_close(mx.nd.repeat(a, repeats=2, axis=1).asnumpy(), x.repeat(2, axis=1))
+    assert_close(mx.nd.tile(a, reps=(1, 2, 1)).asnumpy(), np.tile(x, (1, 2, 1)))
+
+
+def test_unary_ops():
+    x = np.random.rand(3, 3).astype(np.float32) + 0.5
+    a = mx.nd.array(x)
+    for name, fn in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                     ("square", np.square), ("tanh", np.tanh),
+                     ("abs", np.abs), ("floor", np.floor), ("ceil", np.ceil),
+                     ("sign", np.sign)]:
+        assert_close(getattr(mx.nd, name)(a).asnumpy(), fn(x), rtol=1e-4)
+    assert_close(mx.nd.relu(mx.nd.array(x - 1)).asnumpy(), np.maximum(x - 1, 0))
+    assert_close(mx.nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-4)
+
+
+def test_broadcast():
+    x = np.random.rand(3, 1).astype(np.float32)
+    y = np.random.rand(1, 4).astype(np.float32)
+    assert_close(mx.nd.broadcast_add(mx.nd.array(x), mx.nd.array(y)).asnumpy(), x + y)
+    a = mx.nd.array(x)
+    assert a.broadcast_to((3, 5)).shape == (3, 5)
+    assert_close(mx.nd.broadcast_to(a, shape=(3, 5)).asnumpy(),
+                 np.broadcast_to(x, (3, 5)))
+    assert_close(mx.nd.broadcast_axis(a, axis=1, size=4).asnumpy(),
+                 np.broadcast_to(x, (3, 4)))
+
+
+def test_indexing_ops():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    assert_close(mx.nd.take(mx.nd.array(w), mx.nd.array(idx)).asnumpy(), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array([0, 2]), depth=3).asnumpy()
+    assert_close(oh, np.eye(3, dtype=np.float32)[[0, 2]])
+    data = np.random.rand(3, 5).astype(np.float32)
+    pick_idx = np.array([0, 2, 4], dtype=np.float32)
+    assert_close(mx.nd.pick(mx.nd.array(data), mx.nd.array(pick_idx)).asnumpy(),
+                 data[np.arange(3), [0, 2, 4]])
+
+
+def test_ordering():
+    x = np.random.rand(4, 8).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_close(mx.nd.sort(a, axis=1).asnumpy(), np.sort(x, axis=1))
+    assert_close(mx.nd.argsort(a, axis=1).asnumpy(), np.argsort(x, axis=1))
+    v = mx.nd.topk(a, k=3, ret_typ="value", axis=1).asnumpy()
+    assert_close(v, -np.sort(-x, axis=1)[:, :3])
+
+
+def test_where_and_clip():
+    cond = mx.nd.array([1, 0, 1])
+    x = mx.nd.array([1, 2, 3])
+    y = mx.nd.array([7, 8, 9])
+    assert_close(mx.nd.where(cond, x, y).asnumpy(), [1, 8, 3])
+    assert_close(mx.nd.clip(x, a_min=1.5, a_max=2.5).asnumpy(), [1.5, 2, 2.5])
+
+
+def test_concat_and_add_n():
+    xs = [np.random.rand(2, 3).astype(np.float32) for _ in range(3)]
+    arrs = [mx.nd.array(x) for x in xs]
+    assert_close(mx.nd.add_n(*arrs, num_args=3).asnumpy(), sum(xs))
+    assert_close(mx.nd.concatenate(arrs, axis=0).asnumpy(),
+                 np.concatenate(xs, axis=0))
+
+
+def test_optimizer_update_ops():
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1, wd=0.01)
+    assert_close(out.asnumpy(), w - 0.1 * (g + 0.01 * w), rtol=1e-5)
+    mom = np.zeros(5, dtype=np.float32)
+    outs = mx.nd.sgd_mom_update(mx.nd.array(w), mx.nd.array(g), mx.nd.array(mom),
+                                lr=0.1, momentum=0.9)
+    assert_close(outs[0].asnumpy(), w - 0.1 * g, rtol=1e-5)
+
+
+def test_dtype_and_cast():
+    a = mx.nd.array([1.5, 2.5], dtype="float32")
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    # TPU dtype policy: f64 is not a native TPU type; Cast keeps platform reals
+    c = mx.nd.Cast(a, dtype="int32")
+    assert c.dtype == np.int32
+    bf = a.astype("bfloat16")
+    assert bf.shape == a.shape
+
+
+def test_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "x.params")
+    data = {"a": mx.nd.array(np.random.rand(3, 4)),
+            "b": mx.nd.array(np.arange(5, dtype=np.int32), dtype="int32")}
+    mx.nd.save(fname, data)
+    loaded = mx.nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_close(loaded["a"].asnumpy(), data["a"].asnumpy())
+    assert loaded["b"].dtype == np.int32
+    # list save
+    mx.nd.save(fname, [data["a"]])
+    out = mx.nd.load(fname)
+    assert isinstance(out, list) and len(out) == 1
+
+
+def test_context_placement():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu(1))
+    assert a.context == mx.cpu(1)
+    b = a.as_in_context(mx.cpu(2))
+    assert b.context == mx.cpu(2)
+    assert_close(b.asnumpy(), a.asnumpy())
+    c = a.copyto(mx.cpu(0))
+    assert c.context.device_id == 0
+
+
+def test_random_seed():
+    mx.random.seed(42)
+    a = mx.nd.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.uniform(shape=(4,)).asnumpy()
+    assert_close(a, b)
+    n = mx.nd.normal(loc=1.0, scale=0.1, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.02
+
+
+def test_waitall():
+    a = mx.nd.ones((64, 64))
+    for _ in range(5):
+        a = mx.nd.dot(a, a)
+    mx.nd.waitall()
