@@ -22,3 +22,14 @@ from . import random as rnd
 ndarray._init_ndarray_module()
 
 from .ndarray import NDArray
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+
+symbol._init_symbol_module()
+
+from . import executor
+from .executor import Executor

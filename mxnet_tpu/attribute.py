@@ -1,0 +1,52 @@
+"""Attribute scoping (reference python/mxnet/attribute.py).
+
+``with mx.AttrScope(ctx_group='dev1'):`` annotates symbols created inside the
+scope — the mechanism behind manual model parallelism (reference
+example/model-parallel-lstm/lstm.py:48-99; the PlaceDevice pass consumes
+ctx_group, src/executor/graph_executor.cc:242-331).  In this framework
+ctx_group maps to mesh/device assignment at bind time (see parallel/).
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope(object):
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be strings")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge the scope's attrs into ``attr`` (user attrs win)."""
+        if not self._attr:
+            return attr or {}
+        ret = self._attr.copy()
+        if attr:
+            ret.update(attr)
+        return ret
+
+    def __enter__(self):
+        if not hasattr(AttrScope._state, "current"):
+            AttrScope._state.current = AttrScope()
+        self._old_scope = AttrScope._state.current
+        merged = self._old_scope._attr.copy()
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._state.current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        AttrScope._state.current = self._old_scope
+
+
+def current():
+    if not hasattr(AttrScope._state, "current"):
+        AttrScope._state.current = AttrScope()
+    return AttrScope._state.current

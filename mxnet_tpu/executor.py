@@ -1,0 +1,429 @@
+"""Executor — compiled graph execution.
+
+Re-design of the reference GraphExecutor (src/executor/graph_executor.cc,
+1,126 LoC).  Where the reference builds an NNVM fwd+bwd graph, plans memory,
+and pushes per-op engine tasks, this executor traces the Symbol DAG into one
+pure JAX function and jits it:
+
+- graph building + gradient: ``jax.vjp`` over the traced function
+  (nnvm::pass::Gradient analog; mirroring/remat is ``jax.checkpoint`` at the
+  model level).
+- memory planning / pooled reuse: XLA's buffer assignment.
+- bulk segments & cached ops (InitCachedOps/InitOpSegs,
+  graph_executor.cc:556,690): the whole graph IS one fused XLA program.
+
+Training dispatch is a single fused fwd+bwd+aux-update XLA call per batch:
+``forward(is_train=True)`` computes outputs, gradients (w.r.t. args whose
+grad_req != 'null', with ones head-gradients — the loss-layer convention) and
+BatchNorm-style aux updates in one compiled program; ``backward()`` then just
+writes the cached gradients into the grad arrays (honoring write/add).
+``backward(out_grads)`` with explicit head gradients re-runs the same
+compiled function with those heads.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, zeros as nd_zeros
+from .ops.registry import OpDef
+
+__all__ = ["Executor"]
+
+
+@functools.lru_cache(maxsize=2048)
+def _sig_info(fn):
+    params = inspect.signature(fn).parameters
+    has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in params.values())
+    names = frozenset(p.name for p in params.values()
+                      if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                                    inspect.Parameter.KEYWORD_ONLY))
+    return names, has_var_kw
+
+
+def _filter_attrs(op, attrs):
+    """Keep only attrs the op function accepts (graph nodes also carry
+    framework attrs like ctx_group / lr_mult)."""
+    names, has_var_kw = _sig_info(op.fn)
+    if has_var_kw:
+        return dict(attrs)
+    return {k: v for k, v in attrs.items() if k in names}
+
+
+def _node_plan(symbol):
+    """Precompute the per-node execution plan for the trace."""
+    plan = []
+    for node in symbol._nodes():
+        if node.is_variable:
+            plan.append((node, None, None, None, None))
+            continue
+        attrs = node.op.normalize_attrs(node.op_attrs())
+        call_attrs = _filter_attrs(node.op, attrs)
+        n_out = node.op.get_num_outputs(attrs)
+        n_in = len(node.op.get_input_names(attrs))
+        aux_names = node.op.get_aux_names(attrs)
+        aux_var_names = []
+        for k in range(len(aux_names)):
+            if n_in + k < len(node.inputs):
+                src, _ = node.inputs[n_in + k]
+                aux_var_names.append(src.name if src.is_variable else None)
+        plan.append((node, call_attrs, n_out, aux_var_names, None))
+    return plan
+
+
+def _build_eval(symbol):
+    """Return eval_fn(args_dict, aux_dict, rng, is_train) ->
+    (outputs_list, aux_updates_dict).  Pure — jit/vjp-able."""
+    plan = _node_plan(symbol)
+    out_refs = [(id(n), i) for n, i in symbol._outputs]
+
+    def eval_fn(args, aux, rng, is_train, monitor=None):
+        env = {}
+        aux_updates = {}
+        for node, call_attrs, n_out, aux_var_names, _ in plan:
+            if node.op is None:
+                if node.name in args:
+                    val = args[node.name]
+                elif node.name in aux:
+                    val = aux[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                env[id(node)] = (val,)
+                continue
+            ins = [env[id(src)][idx] for src, idx in node.inputs]
+            kw = {}
+            if node.op.needs_is_train:
+                kw["is_train"] = is_train
+            if node.op.needs_rng:
+                kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
+            out = node.op.fn(*ins, **call_attrs, **kw)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            env[id(node)] = tuple(out[:n_out])
+            for name, arr in zip(aux_var_names, out[n_out:]):
+                if name is not None:
+                    aux_updates[name] = arr
+            if monitor is not None:
+                monitor(node, env[id(node)])
+        outputs = [env[nid][i] for nid, i in out_refs]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+class Executor(object):
+    """Bound, compiled executor (parity: python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+        self._monitor_all = False
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self.arg_dict = _to_dict("args", args, arg_names, self._ctx)
+        self.aux_dict = _to_dict("aux_states", aux_states, aux_names,
+                                 self._ctx, allow_missing=not aux_names)
+        self.grad_req = _req_dict(grad_req, arg_names)
+        if args_grad is None:
+            self.grad_dict = {}
+        else:
+            self.grad_dict = _to_dict("args_grad", args_grad, arg_names,
+                                      self._ctx, allow_missing=True)
+        self._diff_names = tuple(
+            n for n in arg_names
+            if self.grad_req.get(n, "null") != "null" and n in self.grad_dict)
+
+        self._eval = _build_eval(symbol)
+        self._jit_fwd = jax.jit(lambda a, x, r: self._eval(a, x, r, False)[0])
+        self._jit_fwd_train = jax.jit(lambda a, x, r: self._eval(a, x, r, True))
+        diff_names = self._diff_names
+
+        def train_fn(args, aux, rng, heads):
+            diff = {k: args[k] for k in diff_names}
+            rest = {k: v for k, v in args.items() if k not in diff}
+
+            def f(d):
+                merged = dict(rest)
+                merged.update(d)
+                outs, auxu = self._eval(merged, aux, rng, True)
+                return tuple(outs), auxu
+
+            outs, vjp_fn, auxu = jax.vjp(f, diff, has_aux=True)
+            grads, = vjp_fn(tuple(heads))
+            return list(outs), grads, auxu
+
+        self._jit_train = jax.jit(train_fn)
+
+        self._outputs = None      # list[NDArray]
+        self._grads = None        # dict name -> jax array
+        self._head_cache = {}     # arg-shape signature -> ones head grads
+
+    # -- construction helpers --------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                     group2ctx=None, shared_exec=None, shapes=None):
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**(shapes or {}))
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_types, _, aux_types = symbol.infer_type(
+            **{k: v for k, v in (type_dict or {}).items()})
+        args = {}
+        for name, shape, typ in zip(arg_names, arg_shapes, arg_types):
+            args[name] = nd_zeros(shape, ctx=ctx, dtype=np.dtype(typ))
+        aux = {}
+        for name, shape, typ in zip(aux_names, aux_shapes, aux_types):
+            aux[name] = nd_zeros(shape, ctx=ctx, dtype=np.dtype(typ))
+        req = _req_dict(grad_req, arg_names)
+        grads = {name: nd_zeros(shape, ctx=ctx)
+                 for name, shape in zip(arg_names, arg_shapes)
+                 if req.get(name, "null") != "null"}
+        return Executor(symbol, ctx, args, args_grad=grads, grad_req=grad_req,
+                        aux_states=aux, group2ctx=group2ctx,
+                        shared_exec=shared_exec)
+
+    # -- dict/list views ---------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._symbol.list_auxiliary_states()]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- execution ---------------------------------------------------------
+    def _raw(self, d):
+        return {k: v._data for k, v in d.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._data = v._data
+            else:
+                self.arg_dict[k][:] = v
+        rng = _random.next_key()
+        self._last_rng = rng
+        args, aux = self._raw(self.arg_dict), self._raw(self.aux_dict)
+
+        if self._monitor_callback is not None:
+            return self._forward_monitored(args, aux, rng, is_train)
+
+        if is_train and self._diff_names:
+            heads = self._ones_heads()
+            outs, grads, auxu = self._jit_train(args, aux, rng, heads)
+            self._grads = grads
+        elif is_train:
+            outs, auxu = self._jit_fwd_train(args, aux, rng)
+            self._grads = None
+        else:
+            outs = self._jit_fwd(args, aux, rng)
+            auxu = {}
+            self._grads = None
+        self._outputs = [NDArray._from_jax(o) for o in outs]
+        if is_train:
+            self._apply_aux(auxu)
+        return self._outputs
+
+    def _ones_heads(self):
+        sig = tuple(sorted((k, v.shape) for k, v in self.arg_dict.items()))
+        heads = self._head_cache.get(sig)
+        if heads is None:
+            _, out_shapes, _ = self._symbol.infer_shape_partial(
+                **{k: v.shape for k, v in self.arg_dict.items()})
+            heads = [jnp.ones(s if s is not None else (), dtype=jnp.float32)
+                     for s in out_shapes]
+            self._head_cache[sig] = heads
+        return heads
+
+    def _apply_aux(self, auxu):
+        for name, arr in auxu.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._data = arr
+
+    def backward(self, out_grads=None):
+        """Write gradients into grad arrays.  Uses the cached fused-step
+        gradients when called without explicit head gradients."""
+        if not self._diff_names:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+            args, aux = self._raw(self.arg_dict), self._raw(self.aux_dict)
+            # reuse the forward pass's RNG key so stochastic ops (Dropout,
+            # rrelu) see the same masks the observed outputs were computed
+            # with — otherwise the gradients would belong to a different
+            # sampled forward
+            rng = getattr(self, "_last_rng", None)
+            if rng is None:
+                rng = _random.next_key()
+                self._last_rng = rng
+            outs, grads, _auxu = self._jit_train(args, aux, rng, heads)
+            self._outputs = [NDArray._from_jax(o) for o in outs]
+            self._grads = grads
+        if self._grads is None:
+            # forward(is_train=True) was not called — run the fused step now
+            args, aux = self._raw(self.arg_dict), self._raw(self.aux_dict)
+            rng = _random.next_key()
+            self._last_rng = rng
+            outs, grads, auxu = self._jit_train(args, aux, rng,
+                                                self._ones_heads())
+            self._outputs = [NDArray._from_jax(o) for o in outs]
+            self._grads = grads
+            self._apply_aux(auxu)
+        for name in self._diff_names:
+            garr = self.grad_dict[name]
+            g = self._grads[name].astype(garr._data.dtype)
+            if self.grad_req[name] == "add":
+                garr._data = garr._data + g
+            else:
+                garr._data = g
+
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            self.forward()
+        return self._outputs
+
+    # -- monitored (eager) execution for mx.mon.Monitor --------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-node output tap (reference
+        MXExecutorSetMonitorCallback / graph_executor.cc:69-72).  Runs the
+        graph eagerly (unfused) while installed."""
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def _forward_monitored(self, args, aux, rng, is_train):
+        taps = []
+
+        monitor_all = self._monitor_all
+
+        def monitor(node, outs):
+            names = ([node.name + "_output"] if len(outs) == 1 else
+                     ["%s_output%d" % (node.name, i) for i in range(len(outs))])
+            for nm, arr in zip(names, outs):
+                taps.append((nm, arr))
+
+        if monitor_all:
+            for name, arr in {**aux, **args}.items():
+                taps.append((name, arr))
+
+        outs, auxu = self._eval(args, aux, rng, is_train, monitor=monitor)
+        self._outputs = [NDArray._from_jax(o) for o in outs]
+        if is_train:
+            self._apply_aux(auxu)
+        self._grads = None
+        for nm, arr in taps:
+            self._monitor_callback(nm, NDArray._from_jax(arr))
+        return self._outputs
+
+    # -- misc ---------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = arr._data.astype(
+                    self.arg_dict[name]._data.dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = arr._data
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **new_shapes):
+        """Return a new executor for new input shapes, sharing parameter
+        arrays (executor.py:reshape).  Recompilation is handled by jit."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        arg_names = self._symbol.list_arguments()
+        new_args, new_grads = {}, {}
+        for name, shape in zip(arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if cur.shape == tuple(shape):
+                new_args[name] = cur
+                if name in self.grad_dict:
+                    new_grads[name] = self.grad_dict[name]
+            else:
+                if name not in new_shapes and not partial_shaping:
+                    raise MXNetError(
+                        "reshape changes the shape of parameter %r from %s to "
+                        "%s; pass partial_shaping=True to allow reallocating "
+                        "it (contents are NOT preserved)"
+                        % (name, cur.shape, tuple(shape)))
+                new_args[name] = nd_zeros(shape, ctx=self._ctx)
+                if name in self.grad_dict:
+                    new_grads[name] = nd_zeros(shape, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args,
+                        args_grad=new_grads or None, grad_req=self.grad_req,
+                        aux_states=self.aux_dict, group2ctx=self._group2ctx)
+
+    def debug_str(self):
+        lines = ["Symbol Outputs:"]
+        for name in self._symbol.list_outputs():
+            lines.append("\toutput[%s]" % name)
+        for node in self._symbol._nodes():
+            if node.is_variable:
+                lines.append("Variable:%s" % node.name)
+            else:
+                ins = ", ".join(s.name for s, _ in node.inputs)
+                lines.append("Op:%s, Name=%s\n\tInputs:\n\t\t%s"
+                             % (node.op.name, node.name, ins))
+        return "\n".join(lines)
+
+
+def _to_dict(what, values, names, ctx, allow_missing=False):
+    if values is None:
+        if allow_missing:
+            return {}
+        raise MXNetError("%s must be provided" % what)
+    if isinstance(values, dict):
+        out = {}
+        for name in names:
+            if name in values:
+                v = values[name]
+                out[name] = v if isinstance(v, NDArray) else NDArray(v, ctx=ctx)
+            elif not allow_missing:
+                raise MXNetError("%s: missing entry %r" % (what, name))
+        return out
+    values = list(values)
+    if len(values) != len(names):
+        raise MXNetError("%s: length mismatch (%d given, %d needed: %s)"
+                         % (what, len(values), len(names), names))
+    return {n: (v if isinstance(v, NDArray) else NDArray(v, ctx=ctx))
+            for n, v in zip(names, values) if v is not None}
+
+
+def _req_dict(grad_req, arg_names):
+    if isinstance(grad_req, str):
+        return {n: grad_req for n in arg_names}
+    if isinstance(grad_req, (list, tuple)):
+        return dict(zip(arg_names, grad_req))
+    if isinstance(grad_req, dict):
+        return {n: grad_req.get(n, "null") for n in arg_names}
+    raise MXNetError("invalid grad_req %r" % (grad_req,))

@@ -1,0 +1,174 @@
+"""Executor tests: bind/forward/backward, grad_req, aux updates, reshape
+(mirrors reference tests/python/unittest/test_executor.py and the numeric
+checks of test_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward)
+
+
+def test_bind_forward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    an, bn = np.random.rand(3, 4).astype("f"), np.random.rand(3, 4).astype("f")
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(an), "b": mx.nd.array(bn)})
+    out = ex.forward()[0]
+    assert_almost_equal(out.asnumpy(), an + bn)
+
+
+def test_backward_write_and_add():
+    a = mx.sym.Variable("a")
+    out = mx.sym.sum(a * a)
+    an = np.random.rand(4).astype("f")
+    grad = mx.nd.zeros((4,))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(an)}, args_grad={"a": grad},
+                  grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(grad.asnumpy(), 2 * an, rtol=1e-4)
+    # grad_req='add' accumulates (the reference's gradient-accumulation path,
+    # inplace_addto_detect_pass.cc)
+    grad2 = mx.nd.ones((4,))
+    ex2 = out.bind(mx.cpu(), {"a": mx.nd.array(an)}, args_grad={"a": grad2},
+                   grad_req="add")
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert_almost_equal(grad2.asnumpy(), 1 + 2 * an, rtol=1e-4)
+
+
+def test_explicit_head_grads():
+    a = mx.sym.Variable("a")
+    out = a * 3
+    an = np.random.rand(2, 2).astype("f")
+    grad = mx.nd.zeros((2, 2))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(an)}, args_grad={"a": grad})
+    ex.forward(is_train=True)
+    head = np.random.rand(2, 2).astype("f")
+    ex.backward([mx.nd.array(head)])
+    assert_almost_equal(grad.asnumpy(), 3 * head, rtol=1e-5)
+
+
+def test_numeric_gradient_mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    act = mx.sym.Activation(fc, act_type="tanh")
+    loc = {"data": np.random.rand(3, 4).astype("f"),
+           "fc_weight": np.random.rand(5, 4).astype("f") * 0.5,
+           "fc_bias": np.random.rand(5).astype("f")}
+    check_numeric_gradient(act, loc, numeric_eps=1e-2, rtol=3e-2, atol=1e-3)
+
+
+def test_numeric_gradient_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                              name="conv")
+    loc = {"data": np.random.rand(1, 2, 5, 5).astype("f"),
+           "conv_weight": np.random.rand(2, 2, 3, 3).astype("f") * 0.3,
+           "conv_bias": np.random.rand(2).astype("f")}
+    check_numeric_gradient(conv, loc, numeric_eps=1e-2, rtol=5e-2, atol=1e-3)
+
+
+def test_softmax_output_grad():
+    """SoftmaxOutput backward = (p - onehot) regardless of head grads
+    (reference src/operator/softmax_output-inl.h)."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sm = mx.sym.SoftmaxOutput(data=data, label=label)
+    x = np.random.rand(3, 4).astype("f")
+    lbl = np.array([1, 0, 3], dtype="f")
+    ex = sm.bind(mx.cpu(), {"data": mx.nd.array(x), "label": mx.nd.array(lbl)},
+                 args_grad={"data": mx.nd.zeros((3, 4))},
+                 grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    p = ex.outputs[0].asnumpy()
+    ex.backward()
+    onehot = np.eye(4, dtype="f")[lbl.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(), p - onehot, rtol=1e-4)
+
+
+def test_linear_regression_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    out = mx.sym.LinearRegressionOutput(data=data, label=label)
+    x = np.random.rand(4, 3).astype("f")
+    y = np.random.rand(4, 3).astype("f")
+    check_symbolic_backward(
+        out, {"data": x, "label": y}, [np.ones((4, 3), dtype="f")],
+        {"data": (x - y) / 3.0}, rtol=1e-4)
+
+
+def test_bn_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    out = mx.sym.sum(bn)
+    x = (np.random.randn(16, 3) * 2 + 5).astype("f")
+    ex = out.simple_bind(mx.cpu(), data=(16, 3))
+    ex.aux_dict["bn_moving_var"][:] = 1.0
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert_almost_equal(mm, 0.5 * x.mean(axis=0), rtol=1e-4)
+    # eval mode must not move stats
+    ex.forward(is_train=False, data=x)
+    assert_almost_equal(ex.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_dropout_train_vs_eval():
+    data = mx.sym.Variable("data")
+    dp = mx.sym.Dropout(data, p=0.5)
+    x = np.ones((100, 100), dtype="f")
+    ex = dp.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_eval, x)
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out_train == 0).mean()
+    assert 0.4 < frac < 0.6
+    assert abs(out_train.mean() - 1.0) < 0.05
+
+
+def test_simple_bind_and_reshape():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    assert ex.arg_dict["fc_weight"].shape == (4, 6)
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.arg_dict["data"].shape == (5, 6)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.forward(is_train=False, data=np.random.rand(5, 6).astype("f"))
+    assert ex2.outputs[0].shape == (5, 4)
+
+
+def test_monitor_callback():
+    tapped = []
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    ex.set_monitor_callback(lambda name, arr: tapped.append(name))
+    ex.forward(is_train=False, data=np.random.rand(2, 6).astype("f"))
+    assert "fc_output" in tapped
+
+
+def test_rnn_cell_gradients():
+    """Fused RNN trains: gradient flows to parameters."""
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H = 4, 2, 3, 5
+    data = mx.sym.Variable("data")
+    params = mx.sym.Variable("params")
+    state = mx.sym.Variable("state")
+    cell = mx.sym.Variable("cell")
+    out = mx.sym.RNN(data=data, parameters=params, state=state,
+                     state_cell=cell, state_size=H, num_layers=1, mode="lstm")
+    loss = mx.sym.sum(out)
+    psize = rnn_param_size(1, I, H, False, "lstm")
+    args = {"data": mx.nd.array(np.random.rand(T, N, I)),
+            "params": mx.nd.array(np.random.rand(psize) * 0.2),
+            "state": mx.nd.zeros((1, N, H)), "cell": mx.nd.zeros((1, N, H))}
+    grads = {"params": mx.nd.zeros((psize,))}
+    ex = loss.bind(mx.cpu(), args, args_grad=grads,
+                   grad_req={"params": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.abs(grads["params"].asnumpy()).sum() > 0

@@ -1,0 +1,136 @@
+"""Symbol composition / attr / serialization tests (mirrors reference
+tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=10, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_auto_naming():
+    with mx.name.NameManager():
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=3)
+        assert fc.name == "fullyconnected0"
+        fc2 = mx.sym.FullyConnected(fc, num_hidden=3)
+        assert fc2.name == "fullyconnected1"
+
+
+def test_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data2"), name="fc2",
+                                 num_hidden=10)
+    composed = net2(data2=net1)
+    args = composed.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc2_weight" in args
+    assert "data2" not in args
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(8, 100))
+    assert arg_shapes[1] == (10, 100)       # fc1_weight
+    assert arg_shapes[3] == (4, 10)         # fc2_weight
+    assert out_shapes == [(8, 4)]
+    # partial
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    assert out_shapes == [None]
+
+
+def test_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=32, pad=(1, 1),
+                              name="conv")
+    pool = mx.sym.Pooling(conv, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 28, 28))
+    assert arg_shapes[1] == (32, 3, 3, 3)
+    assert out_shapes == [(2, 32, 14, 14)]
+
+
+def test_batchnorm_aux():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    _, _, aux_shapes = bn.infer_shape(data=(4, 7, 5, 5))
+    assert aux_shapes == [(7,), (7,)]
+
+
+def test_symbol_arith():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a + b * 2 - 1) / 2
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([2.0]), "b": mx.nd.array([4.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [(2 + 8 - 1) / 2])
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    fc = mx.sym.FullyConnected(a, num_hidden=3, name="fc")
+    grp = mx.sym.Group([fc, a])
+    assert len(grp.list_outputs()) == 2
+    assert grp[0].list_outputs() == ["fc_output"]
+    assert grp["fc_output"].name == "fc"
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        fc = mx.sym.FullyConnected(a, num_hidden=3, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    b = mx.sym.Variable("b", shape=(3, 4), lr_mult=2.0)
+    assert b.attr("__shape__") == "(3, 4)"
+    assert b.attr("lr_mult") == "2.0"
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(8, 100))
+    assert out_shapes == [(8, 4)]
+
+
+def test_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_variable_inputs_concat():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Concat(a, b, dim=1, name="cc")
+    arg_shapes, out_shapes, _ = c.infer_shape(a=(2, 3), b=(2, 5))
+    assert out_shapes == [(2, 8)]
+    ex = c.bind(mx.cpu(), {"a": mx.nd.ones((2, 3)), "b": mx.nd.zeros((2, 5))})
+    assert ex.forward()[0].shape == (2, 8)
