@@ -33,3 +33,19 @@ symbol._init_symbol_module()
 
 from . import executor
 from .executor import Executor
+from . import io
+from . import initializer
+from .initializer import init_registry
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import kvstore
+from . import kvstore as kv
+from . import executor_manager
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import test_utils

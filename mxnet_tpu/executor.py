@@ -344,16 +344,20 @@ class Executor(object):
     # -- misc ---------------------------------------------------------------
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
+        from .ndarray import _to_device
         for name, arr in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._data = arr._data.astype(
-                    self.arg_dict[name]._data.dtype)
+                dst = self.arg_dict[name]
+                dst._data = _to_device(arr._data.astype(dst._data.dtype),
+                                       dst._ctx)
             elif not allow_extra_params:
                 raise MXNetError("unknown argument %r" % name)
         if aux_params:
             for name, arr in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._data = arr._data
+                    dst = self.aux_dict[name]
+                    dst._data = _to_device(arr._data.astype(dst._data.dtype),
+                                           dst._ctx)
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux state %r" % name)
 

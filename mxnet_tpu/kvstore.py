@@ -1,0 +1,140 @@
+"""KVStore — parameter synchronization.
+
+Re-design of the reference KVStore stack (include/mxnet/kvstore.h,
+src/kvstore/): ``local``/``device`` are single-process stores aggregating
+gradients across device copies (the reference's CommCPU/CommDevice tree
+reduction, src/kvstore/comm.h); ``dist_sync``/``tpu`` replace the entire
+ps-lite parameter-server column with XLA collectives over ICI/DCN
+(SURVEY §2.3 mapping note): the optimizer folds into a psum-based sharded
+update step (see parallel/ and kvstore 'tpu' in kvstore_dist.py) instead of
+running on remote server processes.
+
+API parity: create/init/push/pull/set_optimizer/rank/num_workers/barrier/
+save_optimizer_states/load_optimizer_states (python/mxnet/kvstore.py).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_value(keys, vals):
+    if isinstance(keys, (int, str)):
+        if isinstance(vals, NDArray):
+            return [keys], [[vals]]
+        return [keys], [list(vals)]
+    assert len(keys) == len(vals)
+    out_keys, out_vals = [], []
+    for k, v in zip(keys, vals):
+        ks, vs = _key_value(k, v)
+        out_keys += ks
+        out_vals += vs
+    return out_keys, out_vals
+
+
+class KVStore(object):
+    """Single-process store: 'local' (reduce on primary device) and 'device'
+    (reduce stays on the data's devices) — observable behavior matches
+    src/kvstore/kvstore_local.h."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def init(self, key, value):
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values (sum over device copies) into the store; if an
+        updater is set, run it on the merged gradient (kvstore_local.h Push)."""
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            # bring all device copies to the store's device before reducing
+            # (the reference's CommCPU copies to pinned CPU, comm.h:120-179)
+            store_ctx = self._store[k].context
+            merged = vlist[0].as_in_context(store_ctx).copy()
+            for v in vlist[1:]:
+                merged += v.as_in_context(store_ctx)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k]._data = merged._data
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def set_optimizer(self, optimizer):
+        """Install the optimizer as the store-side updater — the analog of
+        pickling the optimizer to dist servers (kvstore.py:set_optimizer)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    barrier = _barrier
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("updater is not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("updater is not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _updater_key(k):
+    return k if isinstance(k, int) else str(k)
+
+
+def create(name="local"):
+    """Factory (reference src/kvstore/kvstore.cc:17-44 name dispatch):
+    'local'/'device' → in-process store; 'dist_sync'/'dist_device_sync'/'tpu'
+    → collective store over the jax distributed runtime."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if "dist" in name or name == "tpu":
+        from .kvstore_dist import KVStoreTPU
+        return KVStoreTPU(name)
+    return KVStore(name)

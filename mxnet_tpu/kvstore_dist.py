@@ -1,0 +1,78 @@
+"""Distributed KVStore over XLA collectives.
+
+Replaces the reference's ps-lite parameter-server column
+(src/kvstore/kvstore_dist.h worker, kvstore_dist_server.h server; ZMQ/TCP)
+with the TPU-native design from SURVEY §2.3: gradients are all-reduced
+across workers with XLA collectives over ICI/DCN instead of being pushed to
+sharded server processes, and the optimizer ("updater on server") runs
+locally on the reduced gradient — numerically identical to the reference's
+``dist_sync`` protocol (sync servers aggregate all NumWorkers pushes, apply
+the updater once, broadcast).
+
+Process model: one JAX process per host (``jax.distributed.initialize`` —
+the tools/launch.py analog is tools/launch.py in this repo), every process
+sees its local chips; collectives ride ICI within a host / DCN across
+hosts.  ``dist_async`` has no ICI analog and raises (documented decision,
+SURVEY §7 hard parts).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .kvstore import KVStore
+from .ndarray import NDArray
+
+__all__ = ["KVStoreTPU"]
+
+
+class KVStoreTPU(KVStore):
+    """kvstore for 'tpu' / 'dist_sync' / 'dist_device_sync'."""
+
+    def __init__(self, kind="tpu"):
+        if "async" in kind:
+            raise MXNetError(
+                "dist_async has no ICI analog on TPU (no parameter server); "
+                "use 'tpu' / 'dist_sync'. (SURVEY §5.8 design decision)")
+        super().__init__(kind)
+        import jax
+        self._jax = jax
+
+    @property
+    def rank(self):
+        return self._jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._jax.process_count()
+
+    def _allreduce(self, arr):
+        """Sum an array across worker processes (ICI/DCN AllReduce)."""
+        if self.num_workers == 1:
+            return arr
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(arr._data)
+        return NDArray._from_jax(summed.sum(axis=0), arr._ctx)
+
+    def push(self, key, value, priority=0):
+        from .kvstore import _key_value, _updater_key
+        keys, vals = _key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r not initialized" % (k,))
+            store_ctx = self._store[k].context
+            merged = vlist[0].as_in_context(store_ctx).copy()
+            for v in vlist[1:]:
+                merged += v.as_in_context(store_ctx)
+            merged = self._allreduce(merged)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k]._data = merged._data
+
+    def barrier(self):
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    _barrier = barrier

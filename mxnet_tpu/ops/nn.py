@@ -244,10 +244,15 @@ def pooling(data, kernel=(), pool_type="max", stride=None, pad=None,
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
-                                 window, strides, pads)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+        # init must stay a python/numpy scalar literal: the reduce_window
+        # max-grad rule inspects it, and a jax-array constant becomes an
+        # opaque tracer under jit, killing the VJP
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = np.array(-np.inf, dtype=data.dtype)
+        else:
+            init = np.array(np.iinfo(data.dtype).min, dtype=data.dtype)
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    summed = lax.reduce_window(data, np.array(0, dtype=data.dtype), lax.add,
                                window, strides, pads)
     if pool_type == "sum":
         return summed

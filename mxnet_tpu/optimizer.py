@@ -1,0 +1,400 @@
+"""Optimizers (reference python/mxnet/optimizer.py, 702 LoC).
+
+The update rules are the registered optimizer ops (ops/tensor.py
+sgd_update/adam_update/... — the same ops the reference's dist server runs,
+src/operator/tensor/optimizer_op.cc:18-73), so the Python Optimizer classes
+here are thin state machines over jit-compiled updates; inside a fused
+training step (kvstore 'tpu') the identical rules run in-graph.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray, zeros
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "SGLD", "DCASGD", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+opt_registry = Registry("optimizer")
+register = opt_registry.register
+
+
+class Optimizer(object):
+    """Base optimizer (reference optimizer.py:Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        if sym is not None:
+            self.set_lr_mult({})
+            self.set_wd_mult({})
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return opt_registry.create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "lr_mult" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["lr_mult"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # the reference skips weight decay for biases/gammas/betas by
+            # name pattern (optimizer.py set_wd_mult)
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "wd_mult" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["wd_mult"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+def create(name, **kwargs):
+    return opt_registry.create(name, **kwargs)
+
+
+@register(aliases=("ccsgd",))
+class SGD(Optimizer):
+    """SGD with momentum (reference optimizer.py:279; update rule =
+    sgd_update / sgd_mom_update ops)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient if self.clip_gradient
+                      is not None else -1.0)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              momentum=self.momentum, **kwargs)
+        else:
+            nd.sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:380)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            state *= self.momentum
+            grad += wd * weight
+            state += grad
+            grad += self.momentum * state
+            weight -= lr * grad
+        else:
+            weight -= lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:416)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        noise = nd.normal(loc=0, scale=math.sqrt(lr), shape=weight.shape,
+                          ctx=weight.context)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:451; update = adam_update op with the
+    reference's bias-corrected effective lr)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
+                       lr=lr, beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, wd=wd,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient if self.clip_gradient
+                       is not None else -1.0)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:499)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        state += grad * grad
+        weight -= lr * (grad / nd.sqrt(state + self.float_stable_eps)
+                        + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference optimizer.py:536; centered=True uses Graves'
+    variant = rmspropalex_update, else rmsprop_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context))
+        return (zeros(weight.shape, ctx=weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=self.clip_gradient if self.clip_gradient
+                      is not None else -1.0,
+                      clip_weights=self.clip_weights if self.clip_weights
+                      is not None else -1.0)
+        if not self.centered:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, out=[weight, n], **kwargs)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  out=[weight, n, g, delta],
+                                  gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:605)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1.0 - self.rho) * grad * grad
+        current_delta = nd.sqrt(acc_delta + self.epsilon) / \
+            nd.sqrt(acc_g + self.epsilon) * grad
+        acc_delta[:] = self.rho * acc_delta + \
+            (1.0 - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:325)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        mom, previous_weight = state
+        if mom is not None:
+            mom[:] = self.momentum * mom - lr * (
+                grad + wd * weight +
+                self.lamda * grad * grad * (weight - previous_weight))
+            weight += mom
+        else:
+            weight += -lr * (grad + wd * weight + self.lamda * grad * grad *
+                             (weight - previous_weight))
+        previous_weight[:] = weight
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w -= rescale_grad * g (reference optimizer.py:653)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+class Updater(object):
+    """Stateful per-key updater closure used by KVStore (reference
+    optimizer.py:669 get_updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        loaded = pickle.loads(states)
+        self.states = {k: _state_from_numpy(v) for k, v in loaded.items()}
+
+    def get_states(self):
+        serializable = {}
+        for k, v in self.states.items():
+            serializable[k] = _state_to_numpy(v)
+        return pickle.dumps(serializable)
+
+
+def _state_to_numpy(v):
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, (tuple, list)):
+        return tuple(_state_to_numpy(x) for x in v)
+    return v
+
+
+def _state_from_numpy(v):
+    if isinstance(v, np.ndarray):
+        from .ndarray import array as nd_array
+        return nd_array(v, dtype=v.dtype)
+    if isinstance(v, (tuple, list)):
+        return tuple(_state_from_numpy(x) for x in v)
+    return v
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

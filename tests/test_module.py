@@ -1,0 +1,215 @@
+"""Module lifecycle + end-to-end convergence tests (mirrors reference
+tests/python/unittest/test_module.py and tests/python/train/test_mlp.py /
+test_conv.py — small convergence asserts with accuracy thresholds)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def make_blobs(n, d, c, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+
+def make_images(n, c=4, size=8, seed=0):
+    """Synthetic image classification: class = bright quadrant."""
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 1, size, size).astype("f") * 0.2
+    y = rs.randint(0, c, size=n)
+    h = size // 2
+    quads = [(0, 0), (0, h), (h, 0), (h, h)]
+    for i in range(n):
+        qy, qx = quads[y[i]]
+        X[i, 0, qy:qy + h, qx:qx + h] += 0.8
+    return X, y.astype("f")
+
+
+def mlp_sym(num_classes=3, nh=32):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def lenet_sym(num_classes=4):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = mx.sym.Flatten(p1)
+    fc1 = mx.sym.FullyConnected(f, num_hidden=32, name="fc1")
+    a2 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(a2, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_module_lifecycle():
+    net = mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 10))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    X, y = make_blobs(64, 10, 3)
+    batch = mx.io.DataBatch(data=[mx.nd.array(X[:16])],
+                            label=[mx.nd.array(y[:16])])
+    mod.forward_backward(batch)
+    mod.update()
+    outs = mod.get_outputs()
+    assert outs[0].shape == (16, 3)
+    arg_params, aux_params = mod.get_params()
+    assert "fc1_weight" in arg_params
+
+
+def test_module_fit_mlp():
+    X, y = make_blobs(480, 10, 3)
+    train = mx.io.NDArrayIter(X[:384], y[:384], batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X[384:], y[384:], batch_size=32)
+    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=5,
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_fit_lenet_e2e():
+    """LeNet end-to-end — BASELINE.json config #1 analog (train_mnist.py)."""
+    X, y = make_images(320)
+    train = mx.io.NDArrayIter(X[:256], y[:256], batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X[256:], y[256:], batch_size=32)
+    mod = mx.mod.Module(lenet_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=6,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_multi_device():
+    """Data-parallel across two fake devices (reference
+    test_module.py-style; cpu(0)/cpu(1) as in test_model_parallel.py)."""
+    X, y = make_blobs(480, 10, 3, seed=1)
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=4, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    X, y = make_blobs(96, 6, 3)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(mlp_sym(nh=8), context=mx.cpu())
+    mod.fit(train, num_epoch=2, initializer=mx.initializer.Xavier())
+    preds = mod.predict(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert preds.shape == (96, 3)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    # reload and verify identical predictions
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    preds2 = mod2.predict(mx.io.NDArrayIter(X, y, batch_size=16))
+    np.testing.assert_allclose(preds.asnumpy(), preds2.asnumpy(), rtol=1e-5)
+
+
+def test_module_kvstore_update_on_kvstore():
+    """update_on_kvstore path: optimizer runs in the store (reference
+    model.py:_update_params_on_kvstore)."""
+    X, y = make_blobs(128, 8, 2)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    kv = mx.kvstore.create("local")
+    mod = mx.mod.Module(mlp_sym(num_classes=2, nh=8), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    assert mod._update_on_kvstore
+    for _epoch in range(3):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_input_grads():
+    net = mlp_sym()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))], inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 6))],
+                            label=[mx.nd.array([0, 1, 2, 0])])
+    mod.forward_backward(batch)
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 6)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """Distinct shapes share parameters (reference BucketingModule)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer()
+    # same feature dim, two bucket keys → two compiled modules, shared params
+    b1 = mx.io.DataBatch(data=[mx.nd.ones((8, 10))],
+                         label=[mx.nd.zeros((8,))], bucket_key=10,
+                         provide_data=[mx.io.DataDesc("data", (8, 10))],
+                         provide_label=[mx.io.DataDesc("softmax_label", (8,))])
+    mod.forward_backward(b1)
+    mod.update()
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy()
+    mod.forward_backward(b1)
+    mod.update()
+    w2 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not np.allclose(w1, w2)
+
+
+def test_optimizers_converge():
+    X, y = make_blobs(192, 8, 2, seed=3)
+    for optimizer, params in [("sgd", {"learning_rate": 0.5}),
+                              ("adam", {"learning_rate": 0.05}),
+                              ("rmsprop", {"learning_rate": 0.05}),
+                              ("adagrad", {"learning_rate": 0.3}),
+                              ("nag", {"learning_rate": 0.3, "momentum": 0.5})]:
+        train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+        mod = mx.mod.Module(mlp_sym(num_classes=2, nh=8), context=mx.cpu())
+        mod.fit(train, num_epoch=4, optimizer=optimizer,
+                optimizer_params=params,
+                initializer=mx.initializer.Xavier())
+        score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
+        assert score[0][1] > 0.85, (optimizer, score)
+
+
+def test_feedforward_legacy_api():
+    X, y = make_blobs(128, 6, 2, seed=5)
+    model = mx.model.FeedForward(mlp_sym(num_classes=2, nh=8),
+                                 ctx=mx.cpu(), num_epoch=4,
+                                 learning_rate=0.5, numpy_batch_size=16)
+    model.fit(X, y)
+    preds = model.predict(X)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.9
