@@ -44,6 +44,9 @@ from . import callback
 from . import kvstore
 from . import kvstore as kv
 from . import executor_manager
+from . import parallel
+from . import models
+from . import rnn
 from . import model
 from .model import FeedForward
 from . import module

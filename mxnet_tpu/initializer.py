@@ -63,6 +63,10 @@ class Initializer(object):
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("parameters"):
+            self._init_rnn_parameters(name, arr)
+        elif "init_h" in name or "init_c" in name or "begin_state" in name:
+            self._init_zero(name, arr)
         elif name.endswith("moving_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var"):
@@ -102,6 +106,17 @@ class Initializer(object):
 
     def _init_weight(self, name, arr):
         raise NotImplementedError("Must override it")
+
+    def _init_rnn_parameters(self, name, arr):
+        """Fused-RNN packed 1-D parameter vectors: apply the subclass's
+        weight rule when it handles vectors (Zero/Constant/Uniform/Normal);
+        matrix-shaped inits (Xavier/Orthogonal) fall back to the classic
+        small-uniform RNN init.  Use initializer.FusedRNN for exact
+        per-gate-matrix initialization (reference initializer.py FusedRNN)."""
+        try:
+            self._init_weight(name, arr)
+        except ValueError:
+            _random.uniform(-0.07, 0.07, out=arr, shape=arr.shape)
 
     def _init_default(self, name, _):
         raise ValueError(
@@ -282,18 +297,71 @@ class Bilinear(Initializer):
         self._init_bilinear(name, arr)
 
 
+@register
+class LSTMBias(Initializer):
+    """Initialize LSTM stacked bias [i,f,c,o] with the forget gate set to
+    ``forget_bias`` and the rest zero (reference initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+    _init_bias = _init_weight
+
+
 class FusedRNN(Initializer):
-    """Initialize fused-RNN packed parameter vectors by delegating to an
-    inner initializer (reference initializer.py FusedRNN, simplified)."""
+    """Initialize fused-RNN packed parameter vectors by unpacking into
+    per-layer gate matrices, applying an inner initializer, and re-packing
+    (reference initializer.py FusedRNN)."""
 
     def __init__(self, init, num_hidden, num_layers, mode,
-                 bidirectional=False):
+                 bidirectional=False, forget_bias=1.0):
         super().__init__()
         self._init = init
         self._num_hidden = num_hidden
         self._num_layers = num_layers
         self._mode = mode
         self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_rnn_parameters(self, name, arr):
+        self._init_weight(name, arr)
 
     def _init_weight(self, name, arr):
-        self._init(name if name.endswith("weight") else name + "_weight", arr)
+        from .ops.nn import _RNN_GATES
+        gates = _RNN_GATES[self._mode]
+        dirs = 2 if self._bidirectional else 1
+        h = self._num_hidden
+        flat = np.zeros(arr.size, dtype="float32")
+        # solve input size from total (see rnn_param_size)
+        rest = arr.size - (self._num_layers - 1) * dirs * gates * h * \
+            (dirs * h + h + 2)
+        in_size = rest // (dirs * gates * h) - h - 2
+        p = 0
+        for layer in range(self._num_layers):
+            li = in_size if layer == 0 else h * dirs
+            for _d in range(dirs):
+                for kind_cols in (li, h):
+                    w = nd_zeros_like_np((gates * h, kind_cols))
+                    self._init("weight", w)
+                    flat[p:p + w.size] = w.asnumpy().reshape(-1)
+                    p += w.size
+        for layer in range(self._num_layers):
+            for _d in range(dirs):
+                for _kind in range(2):
+                    b = nd_zeros_like_np((gates * h,))
+                    if self._mode == "lstm":
+                        LSTMBias(self._forget_bias)._init_bias("bias", b)
+                    flat[p:p + b.size] = b.asnumpy().reshape(-1)
+                    p += b.size
+        arr[:] = flat
+
+
+def nd_zeros_like_np(shape):
+    from .ndarray import zeros
+    return zeros(shape)

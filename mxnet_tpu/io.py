@@ -9,6 +9,7 @@ augmentation in image.py).
 """
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading

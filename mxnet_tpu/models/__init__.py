@@ -1,0 +1,33 @@
+"""Model zoo — symbol builders for the reference's acceptance workloads
+(example/image-classification/symbols/, example/rnn/).
+
+``get_symbol(name, num_classes, **kwargs)`` dispatches by name like the
+reference's fit.py does (example/image-classification/common/fit.py).
+"""
+from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, mobilenet
+from . import lstm_lm
+
+_BUILDERS = {
+    "lenet": lenet.get_symbol,
+    "mlp": mlp.get_symbol,
+    "alexnet": alexnet.get_symbol,
+    "vgg": vgg.get_symbol,
+    "vgg16": lambda num_classes=1000, **kw: vgg.get_symbol(num_classes, 16, **kw),
+    "vgg19": lambda num_classes=1000, **kw: vgg.get_symbol(num_classes, 19, **kw),
+    "resnet": resnet.get_symbol,
+    "resnet-18": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 18, **kw),
+    "resnet-34": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 34, **kw),
+    "resnet-50": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 50, **kw),
+    "resnet-101": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 101, **kw),
+    "resnet-152": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 152, **kw),
+    "inception-bn": inception_bn.get_symbol,
+    "mobilenet": mobilenet.get_symbol,
+}
+
+
+def get_symbol(name, num_classes=1000, **kwargs):
+    key = name.lower()
+    if key not in _BUILDERS:
+        raise KeyError("unknown model %r; available: %s"
+                       % (name, sorted(_BUILDERS)))
+    return _BUILDERS[key](num_classes=num_classes, **kwargs)

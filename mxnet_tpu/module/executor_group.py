@@ -194,10 +194,13 @@ class DataParallelExecutorGroup(object):
                     if self.grad_req.get(name, "null") != "null":
                         grads[name] = nd_zeros(shape, ctx=ctx)
             else:
-                # data/label arrays can be shared across buckets if big enough
+                # the reference reuses one big data buffer across buckets
+                # (executor_group.py shared_data_arrays); with immutable XLA
+                # buffers there is nothing to save — share only exact-shape
+                # arrays (the NDArray cell), else allocate fresh
                 if name in shared_data and \
-                        np.prod(shared_data[name].shape) >= np.prod(shape):
-                    args[name] = shared_data[name].reshape(shape)
+                        shared_data[name].shape == tuple(shape):
+                    args[name] = shared_data[name]
                 else:
                     args[name] = nd_zeros(shape, ctx=ctx)
                     shared_data[name] = args[name]

@@ -47,9 +47,10 @@ class Optimizer(object):
             param_idx2name = {}
         self.idx2name = param_idx2name.copy()
         self.sym = sym
-        if sym is not None:
-            self.set_lr_mult({})
-            self.set_wd_mult({})
+        # unconditional: the bias/gamma wd exclusion must apply even without
+        # a symbol (reference optimizer.py also seeds wd_mult from idx2name)
+        self.set_lr_mult({})
+        self.set_wd_mult({})
 
     @staticmethod
     def create_optimizer(name, **kwargs):

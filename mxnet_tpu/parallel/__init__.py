@@ -1,0 +1,24 @@
+"""Parallelism over device meshes — the TPU-native replacement for the
+reference's multi-executor + parameter-server stack.
+
+The reference scales by running one executor per GPU and reducing gradients
+through KVStore/ps-lite (SURVEY §2.3).  Here the unit of scaling is a
+``jax.sharding.Mesh`` with named axes (dp/tp/sp/pp/ep): one jit-compiled
+training step is annotated with shardings and GSPMD partitions it across
+the mesh, inserting AllReduce/AllGather/ReduceScatter over ICI — the
+collectives the reference hand-wires through NCCL/ZMQ fall out of the
+compiler.
+
+Components:
+- mesh.py: mesh construction helpers
+- trainer.py: SPMDTrainer — fused fwd+bwd+optimizer-update step, sharded
+  over the mesh (the kvstore='tpu' fast path and the bench path)
+- spmd_module.py: SPMDModule — Module-API adapter over SPMDTrainer
+- ring_attention.py: ring attention over the 'sp' axis (sequence/context
+  parallelism — capability beyond the reference, SURVEY §5.7)
+"""
+from .mesh import build_mesh, default_mesh, local_mesh
+from .trainer import SPMDTrainer
+from .spmd_module import SPMDModule
+from . import ring_attention
+from .ring_attention import ring_attention as ring_attention_fn

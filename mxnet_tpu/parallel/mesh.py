@@ -1,0 +1,69 @@
+"""Device-mesh construction (jax.sharding.Mesh) with named axes.
+
+The mental model follows the public scaling playbook (jax-ml
+"How to Scale Your Model"): pick a mesh, annotate shardings, let XLA insert
+collectives.  Axis names used throughout the framework:
+``dp`` data, ``tp`` tensor, ``sp`` sequence/context, ``pp`` pipeline,
+``ep`` expert.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["build_mesh", "default_mesh", "local_mesh", "AXIS_DP", "AXIS_TP",
+           "AXIS_SP", "AXIS_PP", "AXIS_EP"]
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_PP = "pp"
+AXIS_EP = "ep"
+
+
+def build_mesh(axis_sizes, devices=None):
+    """Build a Mesh from {'dp': 4, 'tp': 2, ...}.
+
+    Axis order follows insertion order; sizes must multiply to the device
+    count.  Later axes are placed innermost so e.g. 'tp' lands on
+    adjacent chips (best ICI locality for the heaviest collectives).
+    """
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(int(s) for s in axis_sizes.values())
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise MXNetError(
+            "mesh axes %s multiply to %d but %d devices are available"
+            % (dict(axis_sizes), n, len(devices)))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def default_mesh(data_parallel=None, tensor_parallel=1, sequence_parallel=1,
+                 devices=None):
+    """Default mesh: everything not claimed by tp/sp goes to dp."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if data_parallel is None:
+        data_parallel = n // (tensor_parallel * sequence_parallel)
+    axes = {AXIS_DP: data_parallel}
+    if sequence_parallel > 1:
+        axes[AXIS_SP] = sequence_parallel
+    if tensor_parallel > 1:
+        axes[AXIS_TP] = tensor_parallel
+    return build_mesh(axes, devices)
+
+
+def local_mesh(axis_name=AXIS_DP, devices=None):
+    """1-D mesh over all local devices (the kvstore='tpu' default)."""
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    return build_mesh({axis_name: len(devices)}, devices)

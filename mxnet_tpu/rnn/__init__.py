@@ -1,0 +1,6 @@
+"""RNN toolkit (reference python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ZoneoutCell, ResidualCell, RNNParams)
+from .io import BucketSentenceIter
+from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint
