@@ -33,6 +33,8 @@ symbol._init_symbol_module()
 
 from . import executor
 from .executor import Executor
+from . import engine
+from . import recordio
 from . import io
 from . import initializer
 from .initializer import init_registry

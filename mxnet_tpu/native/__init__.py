@@ -1,0 +1,125 @@
+"""Loader for the native host-runtime library (libmxtpu.so).
+
+The native layer provides the host-side dependency engine and the RecordIO
+codec (see engine.cc / recordio.cc).  It is built on first import if a
+compiler is available; all Python callers degrade gracefully to pure-Python
+fallbacks when it is not (so the framework stays importable on minimal
+systems).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libmxtpu.so")
+_SRCS = ("engine.cc", "recordio.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    """Compile libmxtpu.so in-place.  Returns True on success.
+
+    Compiles to a per-pid temp name then renames atomically so concurrent
+    first-use from multiple processes cannot dlopen a half-written file.
+    """
+    srcs = [os.path.join(_DIR, s) for s in _SRCS]
+    tmp = _LIB_PATH + ".%d.tmp" % os.getpid()
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-o", tmp] + srcs
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=300)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return os.path.exists(_LIB_PATH)
+
+
+def _stale():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for s in _SRCS + ("mxtpu.h",):
+        p = os.path.join(_DIR, s)
+        if os.path.exists(p) and os.path.getmtime(p) > lib_mtime:
+            return True
+    return False
+
+
+def _configure(lib):
+    u64 = ctypes.c_uint64
+    p = ctypes.c_void_p
+    lib.MXTPUEngineCreate.restype = p
+    lib.MXTPUEngineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.MXTPUEngineShutdown.argtypes = [p]
+    lib.MXTPUEngineNewVar.restype = u64
+    lib.MXTPUEngineNewVar.argtypes = [p]
+    lib.MXTPUEngineDeleteVar.argtypes = [p, u64]
+    lib.MXTPUEnginePushAsync.restype = ctypes.c_int
+    lib.MXTPUEnginePushAsync.argtypes = [
+        p, ENGINE_CB, p, ctypes.POINTER(u64), ctypes.c_int,
+        ctypes.POINTER(u64), ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+    lib.MXTPUEngineWaitForVar.argtypes = [p, u64]
+    lib.MXTPUEngineWaitForAll.argtypes = [p]
+    lib.MXTPUEngineNumPending.restype = ctypes.c_int
+    lib.MXTPUEngineNumPending.argtypes = [p]
+    lib.MXTPUEngineLastError.restype = ctypes.c_char_p
+    lib.MXTPUEngineLastError.argtypes = [p]
+    lib.MXTPUProfilerSetState.argtypes = [p, ctypes.c_int]
+    lib.MXTPUProfilerDump.restype = p  # manually decoded + freed
+    lib.MXTPUProfilerDump.argtypes = [p]
+
+    lib.MXTPURecordIOWriterCreate.restype = p
+    lib.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTPURecordIOWriterWrite.restype = ctypes.c_int
+    lib.MXTPURecordIOWriterWrite.argtypes = [p, ctypes.c_char_p, u64]
+    lib.MXTPURecordIOWriterTell.restype = u64
+    lib.MXTPURecordIOWriterTell.argtypes = [p]
+    lib.MXTPURecordIOWriterClose.argtypes = [p]
+    lib.MXTPURecordIOReaderCreate.restype = p
+    lib.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTPURecordIOReaderRead.restype = ctypes.c_int
+    lib.MXTPURecordIOReaderRead.argtypes = [
+        p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(u64)]
+    lib.MXTPURecordIOReaderSeek.argtypes = [p, u64]
+    lib.MXTPURecordIOReaderTell.restype = u64
+    lib.MXTPURecordIOReaderTell.argtypes = [p]
+    lib.MXTPURecordIOReaderClose.argtypes = [p]
+    lib.MXTPUFree.argtypes = [p]
+    return lib
+
+
+ENGINE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def get_lib():
+    """Return the configured ctypes library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_NO_NATIVE", "0") == "1":
+            return None
+        if _stale() and not _build():
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+    return _lib

@@ -1,0 +1,68 @@
+// mxtpu native runtime — C ABI surface.
+//
+// TPU-native re-design of the reference's native runtime layer
+// (reference include/mxnet/engine.h:59-229, src/engine/threaded_engine.h,
+// dmlc-core recordio):  the XLA runtime owns device-side scheduling, so this
+// engine is the *host-side* concurrency authority — it orders IO, data
+// pipeline stages, checkpoint writes, kvstore host ops and Python callbacks
+// with the same read/write-variable dependency semantics the reference uses
+// for every NDArray mutation.
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <cstdint>
+
+#if defined(_WIN32)
+#define MXTPU_API __declspec(dllexport)
+#else
+#define MXTPU_API __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+typedef void (*mxtpu_engine_cb)(void* payload);
+
+// ---- engine ----
+// engine_type: 0 = naive (synchronous, debugging), 1 = threaded pool.
+MXTPU_API void* MXTPUEngineCreate(int engine_type, int num_workers);
+MXTPU_API void MXTPUEngineShutdown(void* handle);
+MXTPU_API uint64_t MXTPUEngineNewVar(void* handle);
+// Deletion is dependency-safe: performed after all pending ops on the var.
+MXTPU_API void MXTPUEngineDeleteVar(void* handle, uint64_t var);
+// Returns 0 on success, -1 on error (duplicate vars across lists).
+MXTPU_API int MXTPUEnginePushAsync(void* handle, mxtpu_engine_cb cb,
+                                   void* payload, const uint64_t* const_vars,
+                                   int n_const, const uint64_t* mutable_vars,
+                                   int n_mutable, int priority,
+                                   const char* opr_name);
+MXTPU_API void MXTPUEngineWaitForVar(void* handle, uint64_t var);
+MXTPU_API void MXTPUEngineWaitForAll(void* handle);
+MXTPU_API int MXTPUEngineNumPending(void* handle);
+MXTPU_API const char* MXTPUEngineLastError(void* handle);
+
+// ---- profiler (chrome://tracing traceEvents) ----
+// state: 0 = stop, 1 = run.  Dump returns a malloc'd JSON string.
+MXTPU_API void MXTPUProfilerSetState(void* handle, int state);
+MXTPU_API char* MXTPUProfilerDump(void* handle);
+
+// ---- recordio ----
+MXTPU_API void* MXTPURecordIOWriterCreate(const char* path);
+MXTPU_API int MXTPURecordIOWriterWrite(void* handle, const char* data,
+                                       uint64_t len);
+MXTPU_API uint64_t MXTPURecordIOWriterTell(void* handle);
+MXTPU_API void MXTPURecordIOWriterClose(void* handle);
+
+MXTPU_API void* MXTPURecordIOReaderCreate(const char* path);
+// Returns 1 if a record was read, 0 on EOF, -1 on corrupt stream.
+// *out is malloc'd; free with MXTPUFree.
+MXTPU_API int MXTPURecordIOReaderRead(void* handle, char** out,
+                                      uint64_t* out_len);
+MXTPU_API void MXTPURecordIOReaderSeek(void* handle, uint64_t pos);
+MXTPU_API uint64_t MXTPURecordIOReaderTell(void* handle);
+MXTPU_API void MXTPURecordIOReaderClose(void* handle);
+
+MXTPU_API void MXTPUFree(void* ptr);
+
+}  // extern "C"
+
+#endif  // MXTPU_H_

@@ -1,0 +1,343 @@
+"""RecordIO: sequential + indexed binary record files.
+
+API parity with the reference's python/mxnet/recordio.py (MXRecordIO,
+MXIndexedRecordIO, IRHeader, pack/unpack, pack_img/unpack_img); the on-disk
+format is byte-compatible with the reference's dmlc recordio framing
+(magic 0xced7230a, (cflag<<29)|len lrecords, 4-byte alignment, magic-elision
+record splitting), so .rec/.idx datasets move between the two frameworks
+unmodified.
+
+Two backends: the native codec (mxnet_tpu/native/recordio.cc) via ctypes,
+or a pure-Python implementation when the native library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from . import native
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+
+
+class _PyRecordWriter(object):
+    def __init__(self, path):
+        self._f = open(path, "wb")
+
+    def write(self, data):
+        if len(data) >= (1 << 29):
+            raise MXNetError("record too large")
+        n = len(data)
+        lower = (n >> 2) << 2
+        upper = ((n + 3) >> 2) << 2
+        out = bytearray()
+        dptr = 0
+        for i in range(0, lower, 4):
+            if data[i:i + 4] == _MAGIC_BYTES:
+                out += _MAGIC_BYTES
+                out += struct.pack("<I", ((1 if dptr == 0 else 2) << 29)
+                                   | (i - dptr))
+                out += data[dptr:i]
+                dptr = i + 4
+        out += _MAGIC_BYTES
+        out += struct.pack("<I", ((3 if dptr else 0) << 29) | (n - dptr))
+        out += data[dptr:n]
+        out += b"\x00" * (upper - n)
+        self._f.write(out)
+
+    def tell(self):
+        return self._f.tell()
+
+    def close(self):
+        self._f.close()
+
+
+class _PyRecordReader(object):
+    def __init__(self, path):
+        self._f = open(path, "rb")
+
+    def read(self):
+        """Returns record bytes or None at EOF."""
+        out = bytearray()
+        multipart = False
+        while True:
+            head = self._f.read(4)
+            if not head and not multipart:
+                return None
+            if len(head) != 4 or struct.unpack("<I", head)[0] != _MAGIC:
+                raise MXNetError("invalid record stream")
+            lrec = struct.unpack("<I", self._f.read(4))[0]
+            cflag, n = lrec >> 29, lrec & _LEN_MASK
+            upper = ((n + 3) >> 2) << 2
+            if multipart:
+                out += _MAGIC_BYTES
+            chunk = self._f.read(upper)
+            if len(chunk) != upper:
+                raise MXNetError("truncated record")
+            out += chunk[:n]
+            if cflag == 0 or cflag == 3:
+                return bytes(out)
+            multipart = True
+
+    def seek(self, pos):
+        self._f.seek(pos)
+
+    def tell(self):
+        return self._f.tell()
+
+    def close(self):
+        self._f.close()
+
+
+class _NativeRecordWriter(object):
+    def __init__(self, path):
+        self._lib = native.get_lib()
+        self._h = self._lib.MXTPURecordIOWriterCreate(path.encode())
+        if not self._h:
+            raise MXNetError("cannot open %s for writing" % path)
+
+    def write(self, data):
+        if self._lib.MXTPURecordIOWriterWrite(self._h, data, len(data)) != 0:
+            raise MXNetError("record write failed")
+
+    def tell(self):
+        return self._lib.MXTPURecordIOWriterTell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPURecordIOWriterClose(self._h)
+            self._h = None
+
+
+class _NativeRecordReader(object):
+    def __init__(self, path):
+        self._lib = native.get_lib()
+        self._h = self._lib.MXTPURecordIOReaderCreate(path.encode())
+        if not self._h:
+            raise MXNetError("cannot open %s for reading" % path)
+
+    def read(self):
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint64()
+        ret = self._lib.MXTPURecordIOReaderRead(
+            self._h, ctypes.byref(out), ctypes.byref(out_len))
+        if ret == 0:
+            return None
+        if ret < 0:
+            raise MXNetError("invalid record stream")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.MXTPUFree(out)
+
+    def seek(self, pos):
+        self._lib.MXTPURecordIOReaderSeek(self._h, pos)
+
+    def tell(self):
+        return self._lib.MXTPURecordIOReaderTell(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPURecordIOReaderClose(self._h)
+            self._h = None
+
+
+class MXRecordIO(object):
+    """Sequential RecordIO reader/writer (reference
+    python/mxnet/recordio.py:MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def _make(self):
+        use_native = native.get_lib() is not None
+        if self.flag == "w":
+            return (_NativeRecordWriter if use_native
+                    else _PyRecordWriter)(self.uri)
+        elif self.flag == "r":
+            return (_NativeRecordReader if use_native
+                    else _PyRecordReader)(self.uri)
+        raise MXNetError("invalid flag %r (use 'r' or 'w')" % self.flag)
+
+    def open(self):
+        self.record = self._make()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.flag == "w"
+        self.record.write(buf)
+
+    def read(self):
+        assert self.flag == "r"
+        return self.record.read()
+
+    def tell(self):
+        return self.record.tell()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with keyed random access (reference
+    python/mxnet/recordio.py:MXIndexedRecordIO; .idx = "key\\tpos" lines)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super(MXIndexedRecordIO, self).__init__(uri, flag)
+
+    def open(self):
+        super(MXIndexedRecordIO, self).open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if self.flag == "r":
+            for line in self.fidx.readlines():
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if self.is_open:
+            super(MXIndexedRecordIO, self).close()
+            self.fidx.close()
+
+    def seek(self, idx):
+        assert self.flag == "r"
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into a record string (reference
+    recordio.py:pack).  ``flag``>0 means ``label`` is an array of ``flag``
+    float32s stored after the fixed header."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(label=float(header.label))
+        s = struct.pack(_IR_FORMAT, *header) + s
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        s = struct.pack(_IR_FORMAT, *header) + label.tobytes() + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload) (reference recordio.py:unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack a header + image array into a record (reference
+    recordio.py:pack_img).  Uses cv2 when available, else PIL."""
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image ndarray) (reference
+    recordio.py:unpack_img)."""
+    header, s = unpack(s)
+    img = _imdecode(s, iscolor)
+    return header, img
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    try:
+        import cv2
+        fmt = img_fmt.lower()
+        if fmt in (".jpg", ".jpeg"):
+            params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif fmt == ".png":
+            params = [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
+        else:
+            params = []
+        ret, buf = cv2.imencode(img_fmt, img, params)
+        if not ret:
+            raise MXNetError("failed to encode image")
+        return buf.tobytes()
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        arr = np.asarray(img)
+        if arr.ndim == 3 and arr.shape[-1] == 3:
+            arr = arr[..., ::-1]  # BGR -> RGB (channel axis only)
+        pimg = Image.fromarray(arr)
+        bio = _io.BytesIO()
+        fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+        pimg.save(bio, format=fmt, quality=quality)
+        return bio.getvalue()
+
+
+def _imdecode(buf, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), iscolor)
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        pimg = Image.open(_io.BytesIO(buf))
+        if iscolor == 0:
+            return np.asarray(pimg.convert("L"))
+        img = np.asarray(pimg.convert("RGB"))
+        return img[..., ::-1]  # RGB -> BGR to match cv2 convention

@@ -1,0 +1,189 @@
+"""RecordIO tests (mirrors reference tests/python/unittest/test_recordio.py)."""
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu import native
+
+
+def _roundtrip(tmp_path, writer_cls, reader_cls, records):
+    path = str(tmp_path / "t.rec")
+    w = writer_cls(path)
+    for r in records:
+        w.write(r)
+    w.close()
+    r = reader_cls(path)
+    out = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        out.append(rec)
+    r.close()
+    assert out == records
+
+
+RECORDS = [
+    b"",
+    b"x",
+    b"hello world",
+    b"a" * 1000,
+    # payload containing the magic word at an aligned offset (split path)
+    struct.pack("<I", 0xced7230a),
+    b"1234" + struct.pack("<I", 0xced7230a) + b"tail",
+    struct.pack("<I", 0xced7230a) * 5,
+    b"off" + struct.pack("<I", 0xced7230a),  # magic at unaligned offset
+    os.urandom(4096),
+]
+
+
+def test_python_roundtrip(tmp_path):
+    _roundtrip(tmp_path, recordio._PyRecordWriter, recordio._PyRecordReader,
+               RECORDS)
+
+
+@pytest.mark.skipif(native.get_lib() is None, reason="no native lib")
+def test_native_roundtrip(tmp_path):
+    _roundtrip(tmp_path, recordio._NativeRecordWriter,
+               recordio._NativeRecordReader, RECORDS)
+
+
+@pytest.mark.skipif(native.get_lib() is None, reason="no native lib")
+def test_cross_backend_compat(tmp_path):
+    """Native-written files must parse with the pure-Python reader and
+    vice-versa (both must match the dmlc on-disk format)."""
+    pa = str(tmp_path / "a.rec")
+    w = recordio._NativeRecordWriter(pa)
+    for r in RECORDS:
+        w.write(r)
+    w.close()
+    r = recordio._PyRecordReader(pa)
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == RECORDS
+
+    pb = str(tmp_path / "b.rec")
+    w = recordio._PyRecordWriter(pb)
+    for rec in RECORDS:
+        w.write(rec)
+    w.close()
+    rn = recordio._NativeRecordReader(pb)
+    got = []
+    while True:
+        rec = rn.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == RECORDS
+
+
+def test_recordio_class(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(100):
+        w.write(("record%d" % i).encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(100):
+        assert r.read() == ("record%d" % i).encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(50):
+        w.write_idx(i, ("record%d" % i).encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(50))
+    # random access, out of order
+    for i in [31, 0, 49, 7, 7, 25]:
+        assert r.read_idx(i) == ("record%d" % i).encode()
+    r.close()
+
+
+def test_pack_unpack_scalar_label():
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 3.0
+    assert h2.id == 7
+    assert payload == b"payload"
+
+
+def test_pack_unpack_array_label():
+    label = np.array([1.0, 2.0, 3.5], dtype=np.float32)
+    header = recordio.IRHeader(0, label, 11, 0)
+    s = recordio.pack(header, b"data")
+    h2, payload = recordio.unpack(s)
+    assert h2.flag == 3
+    np.testing.assert_array_equal(h2.label, label)
+    assert payload == b"data"
+
+
+def test_pack_unpack_img():
+    yy, xx = np.mgrid[0:32, 0:32]
+    img = np.stack([yy * 8, xx * 8, (yy + xx) * 4], -1).astype(np.uint8)
+    header = recordio.IRHeader(0, 1.0, 0, 0)
+    s = recordio.pack_img(header, img, quality=95)
+    h2, img2 = recordio.unpack_img(s)
+    assert h2.label == 1.0
+    assert img2.shape == img.shape
+    # lossy jpeg: just require closeness
+    assert np.abs(img2.astype("f") - img.astype("f")).mean() < 15
+
+
+def test_im2rec_pipeline(tmp_path):
+    """End-to-end: build an image tree, --list it, pack it, read it back
+    through MXIndexedRecordIO."""
+    import cv2
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import im2rec
+
+    root = tmp_path / "imgs"
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            img = (rs.rand(40, 48, 3) * 255).astype(np.uint8)
+            cv2.imwrite(str(root / cls / ("%d.jpg" % i)), img)
+
+    prefix = str(tmp_path / "data")
+
+    class A:
+        pass
+
+    a = A()
+    a.prefix, a.root = prefix, str(root)
+    a.exts = [".jpg"]
+    a.recursive, a.shuffle = True, False
+    a.train_ratio, a.test_ratio = 1.0, 0.0
+    im2rec.make_list(a)
+    assert os.path.exists(prefix + ".lst")
+
+    a.resize, a.center_crop, a.quality = 32, True, 90
+    a.encoding, a.pass_through, a.color = ".jpg", False, 1
+    a.num_thread = 2
+    im2rec.make_record(a)
+
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6
+    labels = set()
+    for k in r.keys:
+        h, img = recordio.unpack_img(r.read_idx(k))
+        assert min(img.shape[:2]) == 32
+        labels.add(float(h.label))
+    assert labels == {0.0, 1.0}
+    r.close()
