@@ -35,6 +35,7 @@ from . import executor
 from .executor import Executor
 from . import engine
 from . import recordio
+from . import image
 from . import io
 from . import initializer
 from .initializer import init_registry

@@ -1,0 +1,607 @@
+"""Image loading + augmentation pipeline.
+
+API parity with the reference's python/mxnet/image.py (imdecode,
+resize_short, fixed/random/center/random_size crops, the *Aug factories,
+CreateAugmenter, ImageIter) plus ImageRecordIter — the reference's C++
+RecordIO image iterator (reference src/io/iter_image_recordio_2.cc) rebuilt
+on the host dependency engine.
+
+TPU-native design note: the reference augments on NDArrays so the GPU can
+help; on TPU, per-image augmentation is host work (tiny per-image XLA
+dispatches would be latency-bound), so augmenters operate on numpy HWC
+uint8/float32 arrays and whole batches transfer to device once per step.
+Decode/augment fan out across engine workers (reference's multithreaded
+ImageRecordIOParser2) while batch assembly serializes through a write var.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+
+import numpy as np
+
+from . import io as mxio
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+
+__all__ = [
+    "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "color_normalize", "random_size_crop",
+    "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+    "RandomOrderAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+    "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+    "ImageRecordIter",
+]
+
+
+def _cv2():
+    import cv2
+    return cv2
+
+
+def imdecode(buf, flag=1, to_rgb=1, out=None):
+    """Decode an image from bytes into an HWC uint8 array (reference
+    image.py:imdecode; to_rgb=1 gives RGB, the reference's default)."""
+    cv2 = _cv2()
+    if isinstance(buf, nd.NDArray):
+        buf = buf.asnumpy()
+    img = cv2.imdecode(np.frombuffer(bytes(buf), dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if to_rgb and img.ndim == 3:
+        img = img[..., ::-1]
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return np.ascontiguousarray(img)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize to exactly (w, h)."""
+    cv2 = _cv2()
+    out = cv2.resize(np.asarray(src), (int(w), int(h)), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out
+
+
+def scale_down(src_size, size):
+    """Scale down crop size if bigger than image size (reference
+    image.py:scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize the shorter edge to `size` keeping aspect ratio."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop at a fixed location, optionally resizing to `size` (w, h)."""
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp=interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of `size` (upsamples if src smaller). Returns
+    (img, (x0, y0, w, h))."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop of `size`. Returns (img, (x0, y0, w, h))."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = int((w - new_w) / 2)
+    y0 = int((h - new_h) / 2)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src.astype(np.float32) - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        src /= np.asarray(std, dtype=np.float32)
+    return src
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area + aspect-ratio crop (inception-style)."""
+    h, w = src.shape[:2]
+    new_ratio = pyrandom.uniform(*ratio)
+    if new_ratio * h > w:
+        max_area = w * int(w / new_ratio)
+    else:
+        max_area = h * int(h * new_ratio)
+    min_area = min_area * h * w
+    if max_area < min_area:
+        return random_crop(src, size, interp)
+    new_area = pyrandom.uniform(min_area, max_area)
+    new_w = int(np.sqrt(new_area * new_ratio))
+    new_h = int(np.sqrt(new_area / new_ratio))
+    new_w, new_h = min(new_w, w), min(new_h, h)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        src = [src]
+        ts_ = list(ts)
+        pyrandom.shuffle(ts_)
+        for t in ts_:
+            src = [j for i in src for j in t(i)]
+        return src
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random brightness/contrast/saturation jitter in random order."""
+    ts = []
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if brightness > 0:
+        def baug(src):
+            alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
+            return [src.astype(np.float32) * alpha]
+        ts.append(baug)
+    if contrast > 0:
+        def caug(src):
+            src = src.astype(np.float32)
+            alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
+            gray = (src * coef).sum(axis=2, keepdims=True)
+            return [src * alpha + gray.mean() * (1.0 - alpha)]
+        ts.append(caug)
+    if saturation > 0:
+        def saug(src):
+            src = src.astype(np.float32)
+            alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
+            gray = (src * coef).sum(axis=2, keepdims=True)
+            return [src * alpha + gray * (1.0 - alpha)]
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """PCA-based lighting noise (AlexNet style)."""
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        return [src.astype(np.float32) + rgb.astype(np.float32)]
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if pyrandom.random() < p:
+            src = src[:, ::-1]
+        return [src]
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [src.astype(np.float32)]
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Create the standard augmenter list (reference image.py:CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+
+    auglist.append(CastAug())
+
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(mxio.DataIter):
+    """Image iterator with augmentation, reading .rec files or raw images
+    listed in a .lst file (reference image.py:ImageIter).
+
+    Supports path_imgrec (+ optional path_imgidx for shuffle/partition),
+    or path_imglist + path_root, or an in-memory imglist.
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super(ImageIter, self).__init__()
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        self.imgrec = None
+        self.imgidx = None
+        if path_imgrec:
+            logging.info("loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+
+        self.imglist = None
+        if path_imglist:
+            logging.info("loading image list %s...", path_imglist)
+            imglist_d = {}
+            imgkeys = []
+            with open(path_imglist) as fin:
+                for line in fin:
+                    line = [i.strip() for i in line.strip().split("\t")]
+                    label = np.array(line[1:-1], dtype=np.float32)
+                    key = int(line[0])
+                    imglist_d[key] = (label, line[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif isinstance(imglist, list):
+            imglist_d = {}
+            imgkeys = []
+            for i, img in enumerate(imglist):
+                key = i
+                label = np.array(img[0], dtype=np.float32) \
+                    if not isinstance(img[0], (int, float)) \
+                    else np.array([img[0]], dtype=np.float32)
+                imglist_d[key] = (label, img[1])
+                imgkeys.append(key)
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+
+        self.path_root = path_root
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise MXNetError(
+                "data_shape must be (3, height, width), got %s"
+                % (data_shape,))
+        self.data_name = data_name
+        self.label_name = label_name
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        if self.seq is not None and num_parts > 1:
+            chunk = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * chunk:(part_index + 1) * chunk]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mxio.DataDesc(self.data_name,
+                              (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [mxio.DataDesc(self.label_name,
+                              (self.batch_size, self.label_width)
+                              if self.label_width > 1
+                              else (self.batch_size,))]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Returns (label, decoded image) for the next sample."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next_raw(self):
+        """(label, raw jpeg bytes or decoded array) — split out so threaded
+        iterators can separate serial IO from parallel decode."""
+        return self.next_sample()
+
+    def decode_augment(self, s):
+        """Decode (if raw bytes) + augment one sample into HWC float32."""
+        data = self.imdecode(s) if isinstance(s, bytes) else s
+        self.check_valid_image(data)
+        return self.augmentation_transform(data)
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((batch_size, self.label_width),
+                               dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                try:
+                    batch_data[i] = self.decode_augment(s)
+                except (RuntimeError, MXNetError) as e:
+                    logging.debug("Invalid image, skipping: %s", str(e))
+                    continue
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        data = nd.array(batch_data.transpose(0, 3, 1, 2))
+        label = nd.array(batch_label[:, 0] if self.label_width == 1
+                         else batch_label)
+        return mxio.DataBatch([data], [label], pad=pad,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)
+
+    __next__ = next
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects the input (h, w, 3)")
+
+    def check_valid_image(self, data):
+        if len(data.shape) == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        return imdecode(s)
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root, fname), "rb") as fin:
+            return imdecode(fin.read())
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = aug(data)[0]
+        return data
+
+
+class ImageRecordIter(mxio.DataIter):
+    """Threaded RecordIO image iterator — the reference's C++
+    ImageRecordIOParser2 pipeline (reference src/io/iter_image_recordio_2.cc:
+    parser -> augmenter -> batch loader -> prefetcher) rebuilt on the host
+    dependency engine: per-image decode+augment ops fan out across engine
+    workers, batch assembly serializes on a write var, and `prefetch_buffer`
+    assembled batches stay in flight ahead of the consumer.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False,
+                 shuffle_chunk_seed=0, seed=0, part_index=0, num_parts=1,
+                 prefetch_buffer=4, preprocess_threads=4, round_batch=True,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 **aug_kwargs):
+        super(ImageRecordIter, self).__init__(batch_size)
+        from . import engine as eng
+        self._engine = eng.Engine(num_workers=max(2, preprocess_threads))
+        self._it = ImageIter(
+            batch_size, data_shape, label_width=label_width,
+            path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+            shuffle=shuffle, part_index=part_index, num_parts=num_parts,
+            data_name=data_name, label_name=label_name, **aug_kwargs)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._dtype = dtype
+        self._prefetch = max(1, prefetch_buffer)
+        self._queue = []
+        self._drained = False
+        # Serializes raw record reads (the source is a sequential stream).
+        self._read_var = self._engine.new_variable()
+        self._start_prefetch()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def _produce_one(self):
+        """Pipeline one batch: a serial read op pulls batch_size raw records,
+        then per-image decode+augment ops fan out across engine workers, and
+        an assemble op (depending on all decode vars) builds the DataBatch."""
+        import threading
+
+        it = self._it
+        c, h, w = self.data_shape
+        slot = {}
+        done = threading.Event()
+        raw = {}
+
+        def read_raw():
+            samples = []
+            try:
+                for _ in range(self.batch_size):
+                    samples.append(it.next_raw())
+            except StopIteration:
+                pass
+            raw["samples"] = samples
+
+        decoded = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
+        valid = [False] * self.batch_size
+
+        def decode_i(i):
+            samples = raw["samples"]
+            if i >= len(samples):
+                return
+            try:
+                decoded[i] = it.decode_augment(samples[i][1])
+                valid[i] = True
+            except (RuntimeError, MXNetError) as e:
+                logging.debug("Invalid image, skipping: %s", str(e))
+
+        def assemble():
+            samples = raw["samples"]
+            if not samples:
+                slot["eof"] = True
+                done.set()
+                return
+            keep = [i for i in range(len(samples)) if valid[i]]
+            n = len(keep)
+            data = np.zeros_like(decoded)
+            label = np.zeros((self.batch_size, self.label_width), "f")
+            for j, i in enumerate(keep):
+                data[j] = decoded[i]
+                lab = samples[i][0]
+                label[j] = lab
+            batch = mxio.DataBatch(
+                [nd.array(data.transpose(0, 3, 1, 2)).astype(self._dtype)],
+                [nd.array(label[:, 0] if self.label_width == 1 else label)],
+                pad=self.batch_size - n,
+                provide_data=self.provide_data,
+                provide_label=self.provide_label)
+            slot["batch"] = batch
+            done.set()
+
+        read_done = self._engine.new_variable()
+        self._engine.push(read_raw, mutable_vars=(self._read_var, read_done),
+                          name="imagerec_read")
+        dec_vars = []
+        for i in range(self.batch_size):
+            dv = self._engine.new_variable()
+            self._engine.push(lambda i=i: decode_i(i),
+                              const_vars=(read_done,), mutable_vars=(dv,),
+                              name="imagerec_decode")
+            dec_vars.append(dv)
+        self._engine.push(assemble, const_vars=tuple(dec_vars),
+                          name="imagerec_assemble")
+        # Dependency-ordered deletion: vars reclaim after their consumers.
+        self._engine.delete_variable(read_done)
+        for dv in dec_vars:
+            self._engine.delete_variable(dv)
+        self._queue.append((slot, done))
+
+    def _start_prefetch(self):
+        while len(self._queue) < self._prefetch and not self._drained:
+            self._produce_one()
+
+    def reset(self):
+        self._engine.wait_for_all()
+        self._queue = []
+        self._drained = False
+        self._it.reset()
+        self._start_prefetch()
+
+    def next(self):
+        if not self._queue:
+            raise StopIteration
+        slot, done = self._queue.pop(0)
+        done.wait()
+        if "eof" in slot:
+            self._drained = True
+            self._queue = []
+            raise StopIteration
+        self._start_prefetch()
+        return slot["batch"]
+
+    __next__ = next
+
+    def close(self):
+        self._engine.wait_for_all()
+        self._engine.shutdown()

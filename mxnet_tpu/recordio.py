@@ -86,8 +86,16 @@ class _PyRecordReader(object):
             if len(chunk) != upper:
                 raise MXNetError("truncated record")
             out += chunk[:n]
-            if cflag == 0 or cflag == 3:
+            if cflag == 0:
                 return bytes(out)
+            if cflag == 3:
+                # 'last part' is only valid inside a multipart record
+                # (same strictness as the native reader).
+                if not multipart:
+                    raise MXNetError("invalid record stream")
+                return bytes(out)
+            if cflag == 1 and multipart:
+                raise MXNetError("invalid record stream")
             multipart = True
 
     def seek(self, pos):

@@ -1,0 +1,170 @@
+"""Image pipeline tests (mirrors reference tests for image.py / the
+ImageRecordIter path of tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+def _gradient_img(h=60, w=80, seed=0):
+    rs = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    img = np.stack([(yy * 3) % 256, (xx * 2) % 256,
+                    ((yy + xx) * 2) % 256], -1).astype(np.uint8)
+    img += rs.randint(0, 10, img.shape).astype(np.uint8)
+    return img
+
+
+@pytest.fixture(scope="module")
+def rec_dataset(tmp_path_factory):
+    """A 20-image .rec/.idx with scalar labels."""
+    import cv2
+    td = tmp_path_factory.mktemp("imgrec")
+    path = str(td / "data.rec")
+    idx = str(td / "data.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(20):
+        img = _gradient_img(seed=i)
+        header = recordio.IRHeader(0, float(i % 4), i, 0)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        w.write_idx(i, recordio.pack(header, buf.tobytes()))
+    w.close()
+    return path, idx
+
+
+def test_imdecode_imresize():
+    import cv2
+    img = _gradient_img()
+    ok, buf = cv2.imencode(".png", img)
+    out = image.imdecode(buf.tobytes(), to_rgb=1)
+    assert out.shape == (60, 80, 3)
+    # png is lossless; to_rgb flips channels vs cv2's BGR read
+    np.testing.assert_array_equal(out, img[..., ::-1])
+    small = image.imresize(out, 40, 30)
+    assert small.shape == (30, 40, 3)
+
+
+def test_crops():
+    img = _gradient_img(100, 120)
+    out, (x0, y0, w, h) = image.center_crop(img, (64, 48))
+    assert out.shape == (48, 64, 3)
+    assert (w, h) == (64, 48)
+    out, _ = image.random_crop(img, (64, 48))
+    assert out.shape == (48, 64, 3)
+    out, _ = image.random_size_crop(img, (32, 32), 0.3, (0.75, 1.333))
+    assert out.shape == (32, 32, 3)
+    # crop bigger than source upsamples
+    out, _ = image.center_crop(img, (200, 300))
+    assert out.shape == (300, 200, 3)
+
+
+def test_resize_short():
+    img = _gradient_img(60, 80)
+    out = image.resize_short(img, 30)
+    assert min(out.shape[:2]) == 30
+    assert out.shape[1] == 40
+
+
+def test_augmenter_list():
+    augs = image.CreateAugmenter((3, 32, 32), resize=40, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, pca_noise=0.1)
+    img = _gradient_img()
+    out = img
+    for a in augs:
+        out = a(out)[0]
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+
+
+def test_color_jitter_and_lighting():
+    img = _gradient_img().astype(np.float32)
+    aug = image.ColorJitterAug(0.5, 0.5, 0.5)
+    out = aug(img)[0]
+    assert out.shape == img.shape
+    eigval = np.array([55.46, 4.794, 1.148])
+    eigvec = np.random.RandomState(0).rand(3, 3)
+    out = image.LightingAug(0.5, eigval, eigvec)(img)[0]
+    assert out.shape == img.shape
+
+
+def test_image_iter_from_rec(rec_dataset):
+    path, idx = rec_dataset
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=path, path_imgidx=idx, shuffle=False)
+    nbatch = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert batch.label[0].shape == (4,)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        nbatch += 1
+    assert nbatch == 5
+    assert labels == [float(i % 4) for i in range(20)]
+
+
+def test_image_iter_from_files(tmp_path):
+    import cv2
+    root = tmp_path / "raw"
+    root.mkdir()
+    imglist = []
+    for i in range(6):
+        fname = "img%d.jpg" % i
+        cv2.imwrite(str(root / fname), _gradient_img(seed=i))
+        imglist.append([float(i % 2), fname])
+    it = image.ImageIter(batch_size=3, data_shape=(3, 24, 24),
+                         imglist=imglist, path_root=str(root))
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 3, 24, 24)
+
+
+def test_image_record_iter(rec_dataset):
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=4, preprocess_threads=4, prefetch_buffer=2)
+    seen = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        seen.append(batch.label[0].asnumpy())
+    assert len(seen) == 5
+    np.testing.assert_allclose(np.concatenate(seen),
+                               [float(i % 4) for i in range(20)])
+    # reset + second epoch
+    it.reset()
+    seen2 = [b.label[0].asnumpy() for b in it]
+    assert len(seen2) == 5
+    it.close()
+
+
+def test_image_record_iter_partition(rec_dataset):
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=2, num_parts=2, part_index=1)
+    n = sum(1 for _ in it)
+    assert n == 5  # 10 of 20 images in this partition
+    it.close()
+
+
+def test_image_record_iter_trains(rec_dataset):
+    """End-to-end: ImageRecordIter feeds Module.fit."""
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=4, mean=True, std=True)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=4)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.initializer.Xavier())
+    it.close()

@@ -89,15 +89,15 @@ def read_list(path_in):
 
 def image_encode(args, item, path):
     """Read + transform + encode one image; returns packed record bytes."""
-    import cv2
     from mxnet_tpu import recordio
 
     header = recordio.IRHeader(
         0, item[2] if len(item) == 3 else np.array(item[2:], "f"),
         item[0], 0)
-    if args.pass_through:
+    if args.pass_through:  # raw bytes: no decoder needed
         with open(path, "rb") as fin:
             return recordio.pack(header, fin.read())
+    import cv2
     img = cv2.imread(path, args.color)
     if img is None:
         raise IOError("cannot read %s" % path)
@@ -167,6 +167,8 @@ def make_record(args):
         engine.push(lambda i=i, item=item: write_one(i, item),
                     const_vars=(enc_var,), mutable_vars=(write_var,),
                     name="record_write")
+        # Dependency-ordered: reclaimed after its consumers complete.
+        engine.delete_variable(enc_var)
     engine.wait_for_all()
     engine.shutdown()
     record.close()
@@ -183,7 +185,10 @@ def parse_args():
     p.add_argument("--exts", nargs="+",
                    default=[".jpeg", ".jpg", ".png"])
     p.add_argument("--recursive", action="store_true")
-    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--shuffle",
+                   type=lambda s: s.strip().lower() in
+                   ("1", "true", "yes", "on"),
+                   default=True)
     p.add_argument("--train-ratio", type=float, default=1.0)
     p.add_argument("--test-ratio", type=float, default=0.0)
     p.add_argument("--resize", type=int, default=0)
