@@ -48,6 +48,8 @@ from . import kvstore
 from . import kvstore as kv
 from . import executor_manager
 from . import parallel
+from . import autograd
+from . import contrib
 from . import models
 from . import rnn
 from . import model

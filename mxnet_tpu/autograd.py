@@ -1,0 +1,279 @@
+"""Imperative autograd — record-and-replay differentiation of NDArray code.
+
+Re-design of the reference's AutogradRuntime (src/ndarray/autograd.cc:27-215)
+and its Python surface (python/mxnet/contrib/autograd.py:22-120).
+
+The reference records imperative ops as NNVM nodes while ``train_section`` is
+active, then ``ComputeGradient`` builds a symbol from the tape, binds a fresh
+GraphExecutor and runs backward with ones head-grads (autograd.cc:123-200).
+
+Here the tape records (opdef, attrs, inputs, outputs) per imperative op (hook
+installed in ndarray._RECORD_HOOK); ``compute_gradient`` replays the tape as a
+*pure JAX function* of the marked variables and differentiates it with
+``jax.vjp`` — one traced+jit-compiled XLA program instead of a fresh
+executor, which is the idiomatic TPU equivalent: the whole backward fuses.
+
+Random ops (Dropout etc.) replay with the PRNG key captured at record time,
+so the replayed forward is bit-identical to what the user observed.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as _nd
+from .ndarray import NDArray
+
+__all__ = [
+    "set_is_training", "train_section", "test_section", "mark_variables",
+    "unmark_variables", "backward", "compute_gradient", "grad_and_loss",
+    "grad", "is_recording", "is_training",
+]
+
+
+class _TapeEntry(object):
+    __slots__ = ("opdef", "attrs", "inputs", "input_values", "outputs",
+                 "is_train", "rng")
+
+    def __init__(self, opdef, attrs, inputs, outputs, is_train, rng):
+        self.opdef = opdef
+        self.attrs = dict(attrs)
+        self.inputs = tuple(inputs)       # strong refs — keep tape alive
+        # values at record time: replay constants for unmarked, possibly
+        # later-mutated arrays (handle swaps don't retro-change the tape)
+        self.input_values = tuple(a._data for a in inputs)
+        self.outputs = tuple(outputs)
+        self.is_train = is_train
+        self.rng = rng
+
+
+class _AutogradState(object):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.depth = 0          # train_section nesting
+        self.tape = []
+        # id(NDArray) -> (variable, gradient holder, grad_req)
+        self.marked = {}
+
+    def record_hook(self, opdef, attrs, inputs, outputs, is_train, rng):
+        self.tape.append(_TapeEntry(opdef, attrs, inputs, outputs,
+                                    is_train, rng))
+
+
+_STATE = _AutogradState()
+
+
+def is_recording():
+    return _STATE.recording
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_is_training(is_train):
+    """Turn recording on/off; returns previous state (reference
+    MXAutogradSetIsTraining, contrib/autograd.py:22-36)."""
+    prev = _STATE.recording
+    if prev == bool(is_train):
+        return prev
+    _STATE.recording = bool(is_train)
+    _STATE.training = bool(is_train)
+    if is_train:
+        _nd._RECORD_HOOK[0] = _STATE.record_hook
+        _nd._TRAIN_MODE[0] = True
+    else:
+        _nd._RECORD_HOOK[0] = None
+        _nd._TRAIN_MODE[0] = None
+        _STATE.tape = []
+        # marked variables persist across sections (the reference's marks
+        # live on the NDArray itself, autograd.cc:35-50)
+    return prev
+
+
+@contextlib.contextmanager
+def train_section():
+    """Scope in which imperative ops are recorded for gradient computation
+    (reference contrib/autograd.py TrainingStateScope/train_section).
+    Nested sections (even across a test_section) share one tape; only the
+    outermost exit clears it."""
+    _STATE.depth += 1
+    prev = set_is_training(True)
+    try:
+        yield
+    finally:
+        _STATE.depth -= 1
+        if _STATE.depth == 0 and not prev:
+            set_is_training(False)
+
+
+@contextlib.contextmanager
+def test_section():
+    """Scope that pauses recording inside a train_section."""
+    prev = _STATE.recording
+    _STATE.recording = False
+    hook = _nd._RECORD_HOOK[0]
+    mode = _nd._TRAIN_MODE[0]
+    _nd._RECORD_HOOK[0] = None
+    _nd._TRAIN_MODE[0] = False
+    try:
+        yield
+    finally:
+        _STATE.recording = prev
+        _nd._RECORD_HOOK[0] = hook
+        _nd._TRAIN_MODE[0] = mode
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Declare NDArrays as differentiation leaves with paired gradient
+    holders (reference AutogradRuntime::MarkVariables, autograd.cc:35-50)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    if not (len(variables) == len(gradients) == len(grad_reqs)):
+        raise MXNetError("variables/gradients/grad_reqs length mismatch")
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        if not isinstance(var, NDArray) or not isinstance(g, NDArray):
+            raise MXNetError("mark_variables expects NDArrays")
+        _STATE.marked[id(var)] = (var, g, req)
+
+
+def unmark_variables(variables):
+    """Remove marks set by mark_variables (frees the tape's strong refs)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    for var in variables:
+        _STATE.marked.pop(id(var), None)
+
+
+def _replay(leaves, outputs):
+    """Build the pure replay function f(leaf values) -> output values."""
+    tape = list(_STATE.tape)
+    leaf_ids = [id(v) for v in leaves]
+    out_ids = [id(o) for o in outputs]
+
+    def f(leaf_vals):
+        env = dict(zip(leaf_ids, leaf_vals))
+        for entry in tape:
+            op = entry.opdef
+            attrs = op.normalize_attrs(entry.attrs)
+            kw = {}
+            if op.needs_is_train:
+                kw["is_train"] = entry.is_train
+            if op.needs_rng:
+                kw["rng"] = entry.rng
+            vals = [env.get(id(a), rec)
+                    for a, rec in zip(entry.inputs, entry.input_values)]
+            res = op.fn(*vals, **attrs, **kw)
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            for out_nd, out_val in zip(entry.outputs, res):
+                env[id(out_nd)] = out_val
+        missing = [i for i in out_ids if i not in env]
+        if missing:
+            raise MXNetError(
+                "compute_gradient: an output is not on the autograd tape "
+                "(was it created outside a train_section?)")
+        return [env[i] for i in out_ids]
+
+    return f
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Compute gradients of ``outputs`` w.r.t. the marked variables used by
+    the current tape and accumulate them into the paired gradient holders
+    (reference MXAutogradBackward / ComputeGradient, autograd.cc:65-215)."""
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    if not _STATE.marked:
+        raise MXNetError("no variables marked — call mark_variables first")
+    if not _STATE.tape:
+        raise MXNetError("autograd tape is empty — record inside "
+                         "train_section()")
+    # only vars this tape actually reads participate — stale marks from
+    # earlier backwards must not have their holders zero-overwritten.
+    # A leaf's linearization point is its value at FIRST tape read (the
+    # reference differentiates the recorded computation, autograd.cc:172) —
+    # in-place mutations after that must not shift it.
+    first_val = {}
+    for entry in _STATE.tape:
+        for a, rec in zip(entry.inputs, entry.input_values):
+            first_val.setdefault(id(a), rec)
+    active = [(v, g, r) for (v, g, r) in _STATE.marked.values()
+              if id(v) in first_val]
+    if not active:
+        raise MXNetError("no marked variable is used by the recorded tape")
+    leaves = [v for (v, _g, _r) in active]
+    grads_out = [g for (_v, g, _r) in active]
+    reqs = [r for (_v, _g, r) in active]
+
+    f = _replay(leaves, outputs)
+    leaf_vals = [first_val[id(v)] for v in leaves]
+    _outs, vjp_fn = jax.vjp(f, leaf_vals)
+    if out_grads is None:
+        cotangents = [jax.numpy.ones_like(o) for o in _outs]
+    else:
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        cotangents = [g._data if isinstance(g, NDArray)
+                      else jax.numpy.asarray(g) for g in out_grads]
+    (leaf_grads,) = vjp_fn(cotangents)
+    for g_holder, g_val, req in zip(grads_out, leaf_grads, reqs):
+        if req == "null":
+            continue
+        g_val = g_val.astype(g_holder._data.dtype)
+        if req == "add":
+            g_holder._data = g_holder._data + g_val
+        else:
+            g_holder._data = g_val
+    if not retain_graph:
+        _STATE.tape = []
+
+
+def compute_gradient(outputs):
+    """Reference contrib/autograd.py compute_gradient: ones head-grads."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator returning (gradients, loss) of ``func`` w.r.t. its NDArray
+    arguments (reference contrib/autograd.py:60-97)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else list(argnum)
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            if not isinstance(v, NDArray):
+                raise MXNetError("grad_and_loss arguments must be NDArrays")
+        grads = [_nd.zeros(v.shape, ctx=v.context,
+                           dtype=np.dtype(v.dtype).name) for v in variables]
+        try:
+            with train_section():
+                mark_variables(variables, grads)
+                outputs = func(*args)
+                compute_gradient(
+                    [outputs] if isinstance(outputs, NDArray) else outputs)
+        finally:
+            unmark_variables(variables)
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Decorator returning only the gradients (reference
+    contrib/autograd.py:100-120)."""
+    grad_with_loss_func = grad_and_loss(func, argnum)
+
+    @functools.wraps(grad_with_loss_func)
+    def wrapped(*args):
+        return grad_with_loss_func(*args)[0]
+    return wrapped

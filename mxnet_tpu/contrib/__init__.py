@@ -1,0 +1,2 @@
+"""contrib namespace (reference python/mxnet/contrib/): experimental APIs."""
+from . import autograd

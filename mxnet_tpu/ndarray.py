@@ -50,6 +50,55 @@ _FLAG_TO_DTYPE[100] = np.dtype(jnp.bfloat16)
 
 _LIVE = weakref.WeakSet()
 
+# autograd tape hook — set by mxnet_tpu.autograd while recording; called as
+# hook(opdef, attrs, input_ndarrays, output_ndarrays, is_train, rng) after
+# every imperative op (the TPU analog of AutogradRuntime::RecordImperative*,
+# src/ndarray/autograd.cc:85-114).
+_RECORD_HOOK = [None]
+
+# is_train default override for imperative ops: None = op default (train
+# behavior, matching this package's historical imperative semantics); set to
+# True/False by autograd train_section/test_section (the reference derives
+# imperative is_train from AutogradRuntime::IsTraining, c_api_ndarray.cc).
+_TRAIN_MODE = [None]
+
+
+class _MutationOp(object):
+    """Pseudo-op for tape entries that rebind/mutate an existing NDArray
+    (in-place ops, __setitem__, out=) — the reference versions the engine
+    var instead (ThreadedVar write dependency); here the tape replays the
+    mutation functionally."""
+    needs_is_train = False
+    needs_rng = False
+    name = "_mutation"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def normalize_attrs(self, attrs):
+        return {}
+
+
+def _record_mutation(fn, inputs, outputs):
+    hook = _RECORD_HOOK[0]
+    if hook is not None:
+        hook(_MutationOp(fn), {}, inputs, outputs, False, None)
+
+
+def _invoke(opdef, nd_inputs, attrs, is_train=False, ctx=None):
+    """Centralized imperative op invocation: jit-cached apply + tape record."""
+    rng = None
+    if opdef.needs_rng:
+        from . import random as _random
+        rng = _random.next_key()
+    arrays = tuple(a._data for a in nd_inputs)
+    results = apply_op(opdef, arrays, attrs, is_train=is_train, rng=rng)
+    outs = tuple(NDArray._from_jax(r, ctx) for r in results)
+    hook = _RECORD_HOOK[0]
+    if hook is not None:
+        hook(opdef, attrs, nd_inputs, outs, is_train, rng)
+    return outs
+
 
 def waitall():
     """Block until all outstanding computation on live arrays finishes
@@ -110,7 +159,7 @@ class NDArray(object):
 
     @property
     def T(self):
-        return NDArray._from_jax(jnp.transpose(self._data))
+        return self._traced_view(jnp.transpose)
 
     @property
     def handle(self):
@@ -132,7 +181,8 @@ class NDArray(object):
         return self.asnumpy().reshape(())[()]
 
     def astype(self, dtype):
-        return NDArray._from_jax(self._data.astype(np.dtype(dtype)), self._ctx)
+        dt = np.dtype(dtype)
+        return self._traced_view(lambda v: v.astype(dt))
 
     def copyto(self, other):
         """Copy into another NDArray or to a Context (ndarray.py:copyto)."""
@@ -152,51 +202,69 @@ class NDArray(object):
             return self
         return self.copyto(context)
 
+    def _traced_view(self, fn):
+        """Apply a pure array fn, recording it on the autograd tape so
+        gradients flow through views/reshapes taken inside train_section."""
+        out = NDArray._from_jax(fn(self._data), self._ctx)
+        _record_mutation(fn, (self,), (out,))
+        return out
+
     # -- shape manipulation ----------------------------------------------
     def reshape(self, shape, reverse=False):
         from .ops.tensor import infer_reshape
         if isinstance(shape, int):
             shape = (shape,)
         new_shape = infer_reshape(self.shape, tuple(shape), reverse)
-        return NDArray._from_jax(jnp.reshape(self._data, new_shape), self._ctx)
+        return self._traced_view(lambda v: jnp.reshape(v, new_shape))
 
     def broadcast_to(self, shape):
-        return NDArray._from_jax(jnp.broadcast_to(self._data, tuple(shape)),
-                                 self._ctx)
+        shape = tuple(shape)
+        return self._traced_view(lambda v: jnp.broadcast_to(v, shape))
 
     def expand_dims(self, axis):
-        return NDArray._from_jax(jnp.expand_dims(self._data, axis), self._ctx)
+        return self._traced_view(lambda v: jnp.expand_dims(v, axis))
 
     def flatten(self):
-        return NDArray._from_jax(
-            jnp.reshape(self._data, (self.shape[0], -1)), self._ctx)
+        n = self.shape[0]
+        return self._traced_view(lambda v: jnp.reshape(v, (n, -1)))
 
     def transpose(self, axes=None):
-        return NDArray._from_jax(jnp.transpose(self._data, axes), self._ctx)
+        return self._traced_view(lambda v: jnp.transpose(v, axes))
 
     def slice(self, start, stop):
         return self[start:stop]
 
     def slice_axis(self, axis, begin, end):
-        return NDArray._from_jax(
-            jax.lax.slice_in_dim(self._data, begin, end, axis=axis), self._ctx)
+        return self._traced_view(
+            lambda v: jax.lax.slice_in_dim(v, begin, end, axis=axis))
 
     # -- indexing ---------------------------------------------------------
     def __getitem__(self, key):
         if isinstance(key, NDArray):
             key = key._data.astype(jnp.int32)
-        return NDArray._from_jax(self._data[key], self._ctx)
+        return self._traced_view(lambda v: v[key])
 
     def __setitem__(self, key, value):
+        value_nd = value if isinstance(value, NDArray) else None
         if isinstance(value, NDArray):
             value = value._data
         value = jnp.asarray(value, dtype=self._data.dtype)
+        shape, dtype = self.shape, self._data.dtype
+        val_in = value_nd if value_nd is not None else NDArray._from_jax(value)
         if isinstance(key, builtins.slice) and key == builtins.slice(None):
+            # record before the handle swap so the tape input is this array's
+            # pre-mutation version (the reference bumps the var version)
+            _record_mutation(
+                lambda _old, v: jnp.broadcast_to(v.astype(dtype), shape),
+                (self, val_in), (self,))
             self._data = _to_device(jnp.broadcast_to(value, self.shape),
                                     self._ctx)
         else:
             if isinstance(key, NDArray):
                 key = key._data.astype(jnp.int32)
+            _record_mutation(
+                lambda old, v, _k=key: old.at[_k].set(v.astype(dtype)),
+                (self, val_in), (self,))
             self._data = self._data.at[key].set(value)
 
     def __len__(self):
@@ -232,13 +300,13 @@ class NDArray(object):
     def _binary(self, other, op_name, scalar_op, reverse=False):
         if isinstance(other, NDArray):
             a, b = (other, self) if reverse else (self, other)
-            out = apply_op(get_op(op_name), (a._data, b._data), {})[0]
+            out = _invoke(get_op(op_name), (a, b), {}, ctx=self._ctx)[0]
         elif isinstance(other, (int, float, np.number)):
-            out = apply_op(get_op(scalar_op), (self._data,),
-                           {"scalar": float(other)})[0]
+            out = _invoke(get_op(scalar_op), (self,),
+                          {"scalar": float(other)}, ctx=self._ctx)[0]
         else:
             return NotImplemented
-        return NDArray._from_jax(out, self._ctx)
+        return out
 
     def __add__(self, o):
         return self._binary(o, "broadcast_add", "_plus_scalar")
@@ -279,10 +347,10 @@ class NDArray(object):
         return self._binary(o, "broadcast_power", "_rpower_scalar", reverse=True)
 
     def __neg__(self):
-        return NDArray._from_jax(-self._data, self._ctx)
+        return self._traced_view(jnp.negative)
 
     def __abs__(self):
-        return NDArray._from_jax(jnp.abs(self._data), self._ctx)
+        return self._traced_view(jnp.abs)
 
     def __eq__(self, o):
         if o is None:
@@ -315,6 +383,7 @@ class NDArray(object):
         if res is NotImplemented:
             return res
         self._data = res._data
+        _record_mutation(lambda v: v, (res,), (self,))
         return self
 
     def __iadd__(self, o):
@@ -500,29 +569,32 @@ def _make_ndarray_function(opdef, func_name):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)
         ctx = kwargs.pop("ctx", None)
-        is_train = kwargs.pop("is_train", True if opdef.needs_is_train else False)
-        arrays = []
+        default_train = _TRAIN_MODE[0] if _TRAIN_MODE[0] is not None else \
+            bool(opdef.needs_is_train)
+        is_train = kwargs.pop("is_train", default_train)
+        nd_inputs = []
         for a in args:
             if isinstance(a, NDArray):
-                arrays.append(a._data)
+                nd_inputs.append(a)
             elif isinstance(a, (int, float)) and "scalar" not in kwargs and \
                     not opdef.get_input_names(kwargs):
                 kwargs["scalar"] = a
             else:
-                arrays.append(jnp.asarray(a))
+                nd_inputs.append(NDArray._from_jax(jnp.asarray(a)))
         # named tensor inputs (data=..., weight=...)
         in_names = opdef.get_input_names(kwargs) + opdef.get_aux_names(kwargs)
         for nm in in_names:
             if nm in kwargs and isinstance(kwargs[nm], NDArray):
-                arrays.append(kwargs.pop(nm)._data)
-        results = apply_op(opdef, tuple(arrays), kwargs, is_train=is_train)
+                nd_inputs.append(kwargs.pop(nm))
         if ctx is not None:
             ctx = ctx if isinstance(ctx, Context) else Context(ctx)
-            results = tuple(_to_device(r, ctx) for r in results)
-        ndarrays = tuple(NDArray._from_jax(r, ctx) for r in results)
+        ndarrays = _invoke(opdef, tuple(nd_inputs), kwargs, is_train=is_train,
+                           ctx=ctx)
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else (out,)
             for o, r in zip(outs, ndarrays):
+                dt = o._data.dtype
+                _record_mutation(lambda v, _dt=dt: v.astype(_dt), (r,), (o,))
                 o._data = _to_device(r._data.astype(o._data.dtype), o._ctx)
             return out
         if len(ndarrays) == 1:

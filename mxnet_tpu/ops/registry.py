@@ -159,7 +159,10 @@ def apply_op(op, arrays, attrs, is_train=False, rng=None):
     attrs = op.normalize_attrs(attrs)
     items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     with_rng = op.needs_rng
-    fn = _jitted(op.name, items, bool(is_train), with_rng)
+    # is_train only keys the cache for ops whose behavior depends on it —
+    # otherwise autograd's train-mode default would double-compile every op
+    is_train = bool(is_train) and op.needs_is_train
+    fn = _jitted(op.name, items, is_train, with_rng)
     if with_rng:
         if rng is None:
             from .. import random as _random

@@ -1,0 +1,249 @@
+"""Autograd tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.autograd import (
+    train_section, mark_variables, compute_gradient, backward,
+    grad_and_loss, grad,
+)
+from mxnet_tpu.autograd import test_section as _test_scope
+
+
+def same(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+def autograd_assert(*args, **kwargs):
+    func = kwargs["func"]
+    grad_f = kwargs["grad_func"]
+    argnum = kwargs.get("argnum", None)
+    grad_func = grad_and_loss(func, argnum)
+    grad_vals, output = grad_func(*args)
+    res = func(*args)
+    same(output.asnumpy(), res.asnumpy())
+    grad_res = grad_f(*args)
+    if not isinstance(grad_res, (list, tuple)):
+        grad_res = [grad_res]
+    assert len(grad_vals) == len(grad_res)
+    for a, b in zip(grad_vals, grad_res):
+        same(a.asnumpy(), b.asnumpy())
+
+
+def test_unary_func():
+    x = mx.nd.uniform(shape=(4, 5)) if hasattr(mx.nd, "uniform") else \
+        mx.nd.array(np.random.uniform(1, 2, (4, 5)).astype(np.float32))
+    autograd_assert(x, func=lambda x: x + 1,
+                    grad_func=lambda x: mx.nd.ones_like(x))
+    autograd_assert(x, func=lambda x: x + x,
+                    grad_func=lambda x: mx.nd.ones_like(x) * 2)
+    autograd_assert(x, func=lambda x: x * 3,
+                    grad_func=lambda x: mx.nd.ones_like(x) * 3)
+
+
+def test_binary_func():
+    x = mx.nd.array(np.random.uniform(1, 2, (3, 4)).astype(np.float32))
+    y = mx.nd.array(np.random.uniform(1, 2, (3, 4)).astype(np.float32))
+    autograd_assert(x, y, func=lambda x, y: x * y,
+                    grad_func=lambda x, y: (y, x))
+    autograd_assert(x, y, func=lambda x, y: x / y,
+                    grad_func=lambda x, y: (1 / y, -x / (y * y)))
+
+
+def test_operator_with_state():
+    def f_fc(a, b, weight, bias):
+        x = a * b
+        fc = mx.nd.FullyConnected(x, weight, bias, num_hidden=32)
+        return fc
+
+    a = mx.nd.array(np.random.uniform(size=(10, 64)).astype(np.float32))
+    b = mx.nd.array(np.random.uniform(size=(10, 64)).astype(np.float32))
+    weight = mx.nd.array(np.random.uniform(size=(32, 64)).astype(np.float32))
+    bias = mx.nd.array(np.random.uniform(size=(32,)).astype(np.float32))
+
+    grad_func = grad_and_loss(f_fc)
+    grad_vals, outputs = grad_func(a, b, weight, bias)
+    assert outputs.shape == (10, 32)
+    assert grad_vals[0].shape == (10, 64)
+    assert grad_vals[2].shape == (32, 64)
+    # dL/da with ones head-grad = (ones @ W) * b
+    expect_da = (np.ones((10, 32), np.float32) @ weight.asnumpy()) * b.asnumpy()
+    same(grad_vals[0].asnumpy(), expect_da, rtol=1e-4, atol=1e-4)
+
+
+def test_argnum():
+    def f_with_mode(a, b, mode):
+        if mode:
+            return a + b
+        return a * b
+
+    a = mx.nd.array(np.random.uniform(size=(3, 2)).astype(np.float32))
+    b = mx.nd.array(np.random.uniform(size=(3, 2)).astype(np.float32))
+    f_add_grad = lambda a, b, mode: [mx.nd.ones_like(a)]
+    autograd_assert(a, b, True, argnum=0,
+                    func=f_with_mode, grad_func=f_add_grad)
+
+
+def test_training_dropout():
+    x = mx.nd.ones((10, 10))
+    with train_section():
+        y = mx.nd.Dropout(x, p=0.5)
+        assert not np.array_equal(y.asnumpy(), x.asnumpy())
+        with _test_scope():
+            y = mx.nd.Dropout(x, p=0.5)
+            assert np.array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_out_grads():
+    x = mx.nd.ones((3, 5))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    da = None
+    db = mx.nd.array(np.array([1, 2, 3, 4, 5], np.float32))
+    dc = mx.nd.array(np.array([5, 4, 3, 2, 1], np.float32))
+    with train_section():
+        a, b, c = [x[i] for i in range(3)]  # not taped: indexing
+        # use SliceChannel which is taped
+        parts = mx.nd.SliceChannel(x, num_outputs=3, axis=0, squeeze_axis=True)
+        backward(list(parts), out_grads=[da if da is not None else
+                                         mx.nd.ones((5,)), db, dc])
+    expect = np.stack([np.ones(5, np.float32), db.asnumpy(), dc.asnumpy()])
+    same(dx.asnumpy(), expect)
+
+
+def test_detach_updated_grad():
+    x = mx.nd.ones((2, 2))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = x * x
+        compute_gradient([y])
+    same(dx.asnumpy(), 2 * np.ones((2, 2), np.float32))
+    # grad_req add accumulates
+    x2 = mx.nd.ones((2, 2))
+    dx2 = mx.nd.zeros_like(x2)
+    mark_variables([x2], [dx2], grad_reqs="add")
+    with train_section():
+        y = x2 * 3
+        compute_gradient([y])
+        y = x2 * 5
+        compute_gradient([y])
+    same(dx2.asnumpy(), 8 * np.ones((2, 2), np.float32))
+
+
+def test_retain_graph():
+    x = mx.nd.ones((2, 2))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = x * x
+        backward([y], retain_graph=True)
+        first = dx.asnumpy().copy()
+        backward([y])
+    same(first, dx.asnumpy())
+
+
+def test_grad_decorator():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+
+    @grad
+    def f(x):
+        return mx.nd.sum(x * x)
+
+    g = f(x)[0]
+    same(g.asnumpy(), 2 * x.asnumpy())
+
+
+def test_rng_replay_deterministic():
+    """Dropout replay must use the recorded PRNG key: gradient mask equals
+    the observed forward mask."""
+    x = mx.nd.ones((50, 50))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = mx.nd.Dropout(x, p=0.5)
+        y_np = y.asnumpy()
+        compute_gradient([y])
+    # grad is 1/(1-p) where kept, 0 where dropped — identical support to y
+    same((dx.asnumpy() > 0), (y_np > 0))
+
+
+def test_is_recording():
+    assert not autograd.is_recording()
+    with train_section():
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_grads_through_views_and_inplace():
+    """Review regressions: views (reshape/transpose/getitem), in-place ops,
+    and __setitem__ must participate in the tape."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = (x * 2).reshape((6,))
+        loss = mx.nd.sum(y * y)
+        backward([loss])
+    same(dx.asnumpy(), 8 * x.asnumpy())
+    autograd.unmark_variables([x])
+
+    # in-place op on a leaf
+    x2 = mx.nd.ones((2, 2))
+    dx2 = mx.nd.zeros_like(x2)
+    mark_variables([x2], [dx2])
+    with train_section():
+        x2 += 1
+        loss = mx.nd.sum(x2 * x2)
+        backward([loss])
+    same(dx2.asnumpy(), 2 * 2 * np.ones((2, 2), np.float32))  # d/dx (x+1)^2 = 2(x+1) = 4
+    autograd.unmark_variables([x2])
+
+    # __setitem__ with taped value
+    a = mx.nd.ones((3,))
+    da = mx.nd.zeros_like(a)
+    mark_variables([a], [da])
+    with train_section():
+        b = mx.nd.zeros((3,))
+        b[1] = mx.nd.sum(a * 3)
+        loss = mx.nd.sum(b)
+        backward([loss])
+    same(da.asnumpy(), 3 * np.ones(3, np.float32))
+    autograd.unmark_variables([a])
+
+
+def test_stale_marks_not_clobbered():
+    """A second grad_and_loss must not zero gradients already returned."""
+    x1 = mx.nd.ones((2,))
+    x2 = mx.nd.ones((2,)) * 2
+    f = lambda v: mx.nd.sum(v * v)
+    g1 = grad_and_loss(f)(x1)[0][0]
+    first = g1.asnumpy().copy()
+    grad_and_loss(f)(x2)
+    same(g1.asnumpy(), first)
+
+
+def test_single_ndarray_out_grads():
+    x = mx.nd.ones((3, 4))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = x * 2
+        backward(y, out_grads=mx.nd.ones((3, 4)) * 5)
+    same(dx.asnumpy(), 10 * np.ones((3, 4), np.float32))
+    autograd.unmark_variables([x])
+
+
+def test_nested_train_in_test_preserves_tape():
+    x = mx.nd.ones((2,))
+    dx = mx.nd.zeros_like(x)
+    mark_variables([x], [dx])
+    with train_section():
+        y = x * 3
+        with _test_scope():
+            with train_section():
+                pass
+        compute_gradient([y])
+    same(dx.asnumpy(), 3 * np.ones(2, np.float32))
+    autograd.unmark_variables([x])
