@@ -14,6 +14,7 @@ __version__ = "0.1.0"
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ops
+from . import operator
 from . import ndarray
 from . import ndarray as nd
 from . import random

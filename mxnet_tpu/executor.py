@@ -147,8 +147,19 @@ class Executor(object):
             if self.grad_req.get(n, "null") != "null" and n in self.grad_dict)
 
         self._eval = _build_eval(symbol)
-        self._jit_fwd = jax.jit(lambda a, x, r: self._eval(a, x, r, False)[0])
-        self._jit_fwd_train = jax.jit(lambda a, x, r: self._eval(a, x, r, True))
+        # graphs holding host-callback ops (Custom) can only be whole-graph
+        # jitted if the backend supports callbacks under jit; otherwise run
+        # eagerly — the reference likewise executes CustomOp host-side
+        # between kernel launches (src/operator/custom/custom-inl.h)
+        has_no_jit = any(n.op is not None and getattr(n.op, "no_jit", False)
+                         for n in symbol._nodes())
+        from .ops.registry import callbacks_under_jit_supported
+        use_jit = not has_no_jit or callbacks_under_jit_supported()
+        _maybe_jit = jax.jit if use_jit else (lambda f: f)
+        self._jit_fwd = _maybe_jit(
+            lambda a, x, r: self._eval(a, x, r, False)[0])
+        self._jit_fwd_train = _maybe_jit(
+            lambda a, x, r: self._eval(a, x, r, True))
         diff_names = self._diff_names
 
         def train_fn(args, aux, rng, heads):
@@ -165,7 +176,7 @@ class Executor(object):
             grads, = vjp_fn(tuple(heads))
             return list(outs), grads, auxu
 
-        self._jit_train = jax.jit(train_fn)
+        self._jit_train = _maybe_jit(train_fn)
 
         self._outputs = None      # list[NDArray]
         self._grads = None        # dict name -> jax array

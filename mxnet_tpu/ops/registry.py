@@ -36,12 +36,13 @@ class OpDef(object):
         "name", "fn", "input_names", "aux_names", "num_outputs",
         "infer_shape", "needs_is_train", "needs_rng", "variable_inputs",
         "aliases", "output_names", "hidden", "param_indices", "doc",
+        "no_jit",
     )
 
     def __init__(self, name, fn, input_names=("data",), aux_names=(),
                  num_outputs=1, infer_shape=None, needs_is_train=False,
                  needs_rng=False, variable_inputs=False, aliases=(),
-                 output_names=None, hidden=False):
+                 output_names=None, hidden=False, no_jit=False):
         self.name = name
         self.fn = fn
         self.input_names = input_names          # tuple | callable(attrs)->tuple
@@ -54,6 +55,7 @@ class OpDef(object):
         self.aliases = tuple(aliases)
         self.output_names = output_names        # tuple | callable(attrs)->tuple
         self.hidden = hidden
+        self.no_jit = no_jit    # host-callback ops: run eagerly, never jit
         self.doc = fn.__doc__
 
     # -- resolved-per-attrs accessors ------------------------------------
@@ -143,6 +145,24 @@ def _jitted(op_name, attr_items, is_train, with_rng):
     return jax.jit(call)
 
 
+@functools.lru_cache(maxsize=1)
+def callbacks_under_jit_supported():
+    """Whether the active backend can run host callbacks inside compiled
+    programs (axon/TPU PJRT may not support host send/recv — then graphs
+    containing Custom ops execute eagerly, mirroring the reference where
+    CustomOp is always a host-side engine callback)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    try:
+        f = jax.jit(lambda x: jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct((), jnp.float32), x))
+        jax.block_until_ready(f(jnp.float32(0.0)))
+        return True
+    except Exception:
+        return False
+
+
 def _hashable(v):
     if isinstance(v, list):
         return tuple(_hashable(x) for x in v)
@@ -157,11 +177,24 @@ def apply_op(op, arrays, attrs, is_train=False, rng=None):
     Returns a tuple of jax.Arrays (outputs, then updated aux if any).
     """
     attrs = op.normalize_attrs(attrs)
-    items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     with_rng = op.needs_rng
     # is_train only keys the cache for ops whose behavior depends on it —
     # otherwise autograd's train-mode default would double-compile every op
     is_train = bool(is_train) and op.needs_is_train
+    if op.no_jit:
+        kw = {}
+        if op.needs_is_train:
+            kw["is_train"] = is_train
+        if with_rng:
+            if rng is None:
+                from .. import random as _random
+                rng = _random.next_key()
+            kw["rng"] = rng
+        out = op.fn(*arrays, **attrs, **kw)
+        if isinstance(out, (tuple, list)):
+            return tuple(out)
+        return (out,)
+    items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
     fn = _jitted(op.name, items, is_train, with_rng)
     if with_rng:
         if rng is None:

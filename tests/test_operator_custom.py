@@ -1,0 +1,147 @@
+"""CustomOp tests (reference tests/python/unittest/test_operator.py
+test_custom_op and example/numpy-ops/)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+def test_custom_op_imperative():
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = mx.nd.Custom(x, op_type="sqr")
+    assert_almost_equal(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_op_symbolic_forward_backward():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr", name="sqr")
+    x_np = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    gx = mx.nd.zeros(x.shape)
+    ex = y.bind(mx.current_context(), {"data": x}, args_grad={"data": gx})
+    out = ex.forward(is_train=True)[0]
+    assert_almost_equal(out.asnumpy(), x_np ** 2, rtol=1e-5, atol=1e-6)
+    ex.backward([mx.nd.ones(x.shape)])
+    assert_almost_equal(gx.asnumpy(), 2 * x_np, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_in_larger_graph():
+    """Custom op composed with registry ops, gradient flows through."""
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data * 2, op_type="sqr")
+    loss = mx.sym.MakeLoss(mx.sym.sum(y))
+    x_np = np.random.uniform(0.5, 1, (3, 3)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    gx = mx.nd.zeros(x.shape)
+    ex = loss.bind(mx.current_context(), {"data": x}, args_grad={"data": gx})
+    ex.forward(is_train=True)
+    ex.backward()
+    # d/dx sum((2x)^2) = 8x
+    assert_almost_equal(gx.asnumpy(), 8 * x_np, rtol=1e-4, atol=1e-5)
+
+
+@mx.operator.register("scale_by")
+class ScaleProp(mx.operator.CustomOpProp):
+    def __init__(self, factor="1"):
+        super().__init__(need_top_grad=True)
+        self.factor = float(factor)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        factor = self.factor
+
+        class Scale(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * factor)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0], out_grad[0] * factor)
+        return Scale()
+
+
+def test_custom_op_with_kwargs():
+    x = mx.nd.ones((2, 3))
+    y = mx.nd.Custom(x, factor=2.5, op_type="scale_by")
+    assert_almost_equal(y.asnumpy(), 2.5 * np.ones((2, 3), np.float32))
+
+
+def test_numpy_op():
+    class NumpySqr(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = 2 * in_data[0] * out_grad[0]
+
+    sqr = NumpySqr()
+    data = mx.sym.Variable("data")
+    y = sqr(data)
+    x_np = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    x = mx.nd.array(x_np)
+    gx = mx.nd.zeros(x.shape)
+    ex = y.bind(mx.current_context(), {"data": x}, args_grad={"data": gx})
+    out = ex.forward(is_train=True)[0]
+    assert_almost_equal(out.asnumpy(), x_np ** 2, rtol=1e-5, atol=1e-6)
+    ex.backward([mx.nd.ones(x.shape)])
+    assert_almost_equal(gx.asnumpy(), 2 * x_np, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_module_training():
+    """CustomOp inside a Module fit loop (the reference's Faster R-CNN
+    pattern: Python proposal layer in a trained graph)."""
+    np.random.seed(0)
+    n, d = 200, 10
+    x = np.random.uniform(-1, 1, (n, d)).astype(np.float32)
+    w_true = np.random.uniform(-1, 1, (d,)).astype(np.float32)
+    yl = (x @ w_true > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    # custom op in the gradient path (scale factor 1.0 = identity)
+    net = mx.sym.Custom(net, factor=1.0, op_type="scale_by")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(x, yl, batch_size=50, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"],
+                        context=mx.current_context())
+    mod.fit(it, num_epoch=10,
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(it, mx.metric.Accuracy())
+    acc = dict(score)["accuracy"] if isinstance(score, list) else score
+    assert acc > 0.85, acc
