@@ -51,6 +51,12 @@ from . import executor_manager
 from . import parallel
 from . import autograd
 from . import contrib
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from .profiler import profiler_set_config, profiler_set_state, dump_profile
+from . import visualization
+from . import visualization as viz
 from . import models
 from . import rnn
 from . import model

@@ -1,2 +1,11 @@
-"""contrib namespace (reference python/mxnet/contrib/): experimental APIs."""
+"""contrib namespace (reference python/mxnet/contrib/): experimental APIs.
+
+``mx.contrib.symbol`` / ``mx.contrib.ndarray`` expose the ``_contrib_*``
+ops under their short names, matching the reference's contrib namespaces
+(e.g. mx.contrib.symbol.MultiBoxPrior, example/ssd/symbol/common.py:175).
+"""
 from . import autograd
+from . import symbol
+from . import symbol as sym
+from . import ndarray
+from . import ndarray as nd

@@ -1,0 +1,16 @@
+"""mx.contrib.ndarray — _contrib_* ops under short names."""
+from ..ops.registry import OP_REGISTRY as _REG
+from .. import ndarray as _ndarray
+
+
+def _populate():
+    g = globals()
+    for name, opdef in list(_REG.items()):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            fn = getattr(_ndarray, name, None)
+            if fn is not None:
+                g[short] = fn
+
+
+_populate()

@@ -1,0 +1,17 @@
+"""mx.contrib.symbol — _contrib_* ops under short names (reference
+python/mxnet/contrib structure; ops from src/operator/contrib/)."""
+from ..ops.registry import OP_REGISTRY as _REG
+from .. import symbol as _symbol
+
+
+def _populate():
+    g = globals()
+    for name, opdef in list(_REG.items()):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            creator = getattr(_symbol, name, None)
+            if creator is not None:
+                g[short] = creator
+
+
+_populate()

@@ -1,0 +1,102 @@
+"""Monitor: tap intermediate outputs/weights during training (reference
+python/mxnet/monitor.py, wired through MXExecutorSetMonitorCallback /
+src/executor/graph_executor.cc:69-72,770-790).
+
+``Monitor.install`` hooks an executor's per-node tap; with our executors the
+tap runs the graph eagerly (unfused) while installed, so values match what a
+fused run computes but each node is observable — the TPU analog of the
+reference's engine-callback tap.
+"""
+from __future__ import annotations
+
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor(object):
+    """Collect per-node statistics every ``interval`` batches (reference
+    monitor.py:Monitor).
+
+    Parameters
+    ----------
+    interval : int
+        Sample every N calls of ``tic()``.
+    stat_func : callable(NDArray) -> NDArray, optional
+        Statistic; default mean(|x|) like the reference.
+    pattern : str
+        Regex on node names to include.
+    sort : bool
+        Sort stats by name in ``toc()``.
+    monitor_all : bool
+        Also tap arguments/aux states, not just op outputs.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return abs(x).asnumpy().mean()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach to an executor (reference monitor.py:install)."""
+        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if it is a sampled one
+        (reference monitor.py:tic)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish the batch; return list of (step, name, stat)
+        (reference monitor.py:toc)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+        # re-tap weights too when monitor_all is requested via queue —
+        # the executor tap already reported args; nothing extra to do here
+        self.activated = False
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            res.append((n, k, str(v_list)))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and print stats (reference monitor.py:toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            print("Batch: {:7d} {:30s} {}".format(n, k, v))
+        return res

@@ -38,6 +38,17 @@ from .ops.registry import register as _register_op
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop",
            "NumpyOp", "NDArrayOp"]
 
+
+def _assign(dst, req, src):
+    """Write src into dst honoring the grad_req (reference operator.py
+    CustomOp.assign semantics, shared by all op base classes)."""
+    if req == "null":
+        return
+    if req in ("write", "inplace"):
+        dst[:] = src
+    elif req == "add":
+        dst[:] += src
+
 # op_type -> CustomOpProp subclass (reference CustomOpProp registry,
 # src/operator/custom/custom.cc CustomOpPropRegistry)
 _PROP_REGISTRY = {}
@@ -55,12 +66,7 @@ class CustomOp(object):
 
     def assign(self, dst, req, src):
         """Write src to dst honoring the grad_req (operator.py:assign)."""
-        if req == "null":
-            return
-        if req in ("write", "inplace"):
-            dst[:] = src
-        elif req == "add":
-            dst[:] += src
+        _assign(dst, req, src)
 
 
 class CustomOpProp(object):
@@ -397,12 +403,7 @@ class PythonOp(object):
         return ["output"]
 
     def assign(self, dst, req, src):
-        if req == "null":
-            return
-        if req in ("write", "inplace"):
-            dst[:] = src
-        elif req == "add":
-            dst[:] += src
+        _assign(dst, req, src)
 
 
 class NumpyOp(PythonOp):

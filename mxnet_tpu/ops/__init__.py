@@ -2,11 +2,6 @@
 from .registry import OP_REGISTRY, OpDef, apply_op, get_op, list_ops, register
 from . import tensor  # noqa: F401 — registers tensor ops
 
-try:  # neural layer ops (registered on import)
-    from . import nn  # noqa: F401
-except ImportError:  # pragma: no cover - during bootstrap
-    pass
-try:
-    from . import contrib  # noqa: F401
-except ImportError:  # pragma: no cover
-    pass
+from . import nn       # noqa: F401 — registers neural layer ops
+from . import vision   # noqa: F401 — ROIPooling/SpatialTransformer/...
+from . import contrib  # noqa: F401 — MultiBox/Proposal/fft/count_sketch

@@ -1,0 +1,467 @@
+"""contrib ops: SSD MultiBox family, Faster R-CNN Proposal, FFT/IFFT,
+count_sketch (reference src/operator/contrib/, 4.4k LoC CUDA/C++).
+
+TPU-native re-design: anchor generation / target matching / NMS are dense
+fixed-shape computations (masking instead of dynamic lists) so they stay
+inside XLA programs; the reference's CUDA NMS loops become a
+``lax.fori_loop`` over score-sorted candidates with a suppression mask.
+Detection-style outputs are gradient-free (wrapped in stop_gradient), like
+the reference layers that declare no backward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _flist(v, default):
+    if v is None:
+        return tuple(default)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior — contrib/multibox_prior-inl.h
+# ---------------------------------------------------------------------------
+
+def _mbp_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return list(in_shapes), [None], []
+    sizes = _flist(attrs.get("sizes"), (1.0,))
+    ratios = _flist(attrs.get("ratios"), (1.0,))
+    na = len(sizes) + len(ratios) - 1
+    h, w = data[2], data[3]
+    return [tuple(data)], [(1, h * w * na, 4)], []
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          infer_shape=_mbp_infer)
+def multibox_prior(data, sizes=None, ratios=None, clip=False, steps=None,
+                   offsets=None):
+    """Generate SSD prior (anchor) boxes for each feature-map cell
+    (multibox_prior-inl.h MultiBoxPriorForward).  Output (1, H*W*A, 4) with
+    corners (x1,y1,x2,y2) normalized to [0,1]."""
+    sizes = _flist(sizes, (1.0,))
+    ratios = _flist(ratios, (1.0,))
+    offsets = _flist(offsets, (0.5, 0.5))
+    h, w = data.shape[2], data.shape[3]
+    steps = _flist(steps, (-1.0, -1.0))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")     # [h, w]
+
+    # anchors: all sizes with ratio[0], then size[0] with ratios[1:]
+    whs = [(s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])) for s in sizes]
+    whs += [(sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r))
+            for r in ratios[1:]]
+    boxes = []
+    for bw, bh in whs:
+        x1 = cxg - bw / 2
+        y1 = cyg - bh / 2
+        x2 = cxg + bw / 2
+        y2 = cyg + bh / 2
+        boxes.append(jnp.stack([x1, y1, x2, y2], axis=-1))  # [h, w, 4]
+    out = jnp.stack(boxes, axis=2).reshape(1, -1, 4)        # [1, h*w*A, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return lax.stop_gradient(out)
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+
+def _iou(a, b):
+    """IoU between [A,4] and [B,4] corner boxes -> [A,B]."""
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[None, :, 0], b[None, :, 1], b[None, :, 2], \
+        b[None, :, 3]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0) * jnp.maximum(ay2 - ay1, 0)
+    area_b = jnp.maximum(bx2 - bx1, 0) * jnp.maximum(by2 - by1, 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def _encode(anchors, gt, variances):
+    """Corner gt vs corner anchors -> center-form regression targets
+    (multibox_target-inl.h encoding)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / variances[1]
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-12), 1e-12)) / \
+        variances[2]
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-12), 1e-12)) / \
+        variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+def _decode(anchors, deltas, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(deltas[:, 2] * variances[2]) * aw
+    h = jnp.exp(deltas[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget — contrib/multibox_target-inl.h
+# ---------------------------------------------------------------------------
+
+def _mbt_infer(attrs, in_shapes):
+    anchor, label, cls_pred = in_shapes[:3]
+    if anchor is None or label is None or cls_pred is None:
+        return list(in_shapes), [None, None, None], []
+    a = anchor[1]
+    n = label[0]
+    return ([tuple(anchor), tuple(label), tuple(cls_pred)],
+            [(n, a * 4), (n, a * 4), (n, a)], [])
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          input_names=("anchor", "label", "cls_pred"), num_outputs=3,
+          output_names=("loc_target", "loc_mask", "cls_target"),
+          infer_shape=_mbt_infer)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment (multibox_target-inl.h): match each
+    anchor to ground truth (best-anchor-per-gt plus IoU>threshold), emit
+    localization targets/masks and classification targets."""
+    variances = _flist(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor[0]                                # [A, 4]
+    a = anchors.shape[0]
+
+    mine = float(negative_mining_ratio) > 0
+
+    def per_sample(lbl, pred):
+        # lbl: [O, 5] rows (cls, x1, y1, x2, y2), cls<0 = padding
+        valid = lbl[:, 0] >= 0                         # [O]
+        gt = lbl[:, 1:5]
+        iou = _iou(anchors, gt)                        # [A, O]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)              # [A]
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: the best anchor of each VALID gt; padded rows
+        # scatter out of bounds and are dropped
+        best_anchor = jnp.argmax(iou, axis=0)          # [O]
+        scatter_idx = jnp.where(valid, best_anchor, a)
+        forced = jnp.zeros((a,), bool).at[scatter_idx].set(
+            True, mode="drop")
+        forced_gt = jnp.zeros((a,), jnp.int32).at[scatter_idx].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        pos = forced | (best_iou >= overlap_threshold)
+        match = jnp.where(forced, forced_gt, best_gt)
+        matched_gt = gt[match]                         # [A, 4]
+        loc_t = _encode(anchors, matched_gt, variances)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.broadcast_to(pos[:, None],
+                                 (a, 4)).astype(jnp.float32).reshape(-1)
+        cls_t = jnp.where(pos, lbl[match, 0] + 1, 0.0)  # 0 = background
+        if mine:
+            # hard negative mining (multibox_target-inl.h NegativeMining):
+            # candidates = anchors below the mining IoU threshold, ranked by
+            # background cross-entropy (-log p_bg from cls_pred softmax);
+            # keep ratio*num_pos (>= minimum_negative_samples), rest ignored
+            p = jax.nn.softmax(pred, axis=0)           # [cls, A]
+            neg_score = -jnp.log(jnp.maximum(p[0], 1e-12))
+            cand = (~pos) & (best_iou < negative_mining_thresh)
+            num_pos = pos.sum()
+            num_neg = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                int(minimum_negative_samples))
+            score = jnp.where(cand, neg_score, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.argsort(order)
+            selected = cand & (rank < num_neg)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(selected, 0.0, ignore_label))
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label, cls_pred)
+    return (lax.stop_gradient(loc_t), lax.stop_gradient(loc_m),
+            lax.stop_gradient(cls_t))
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection — contrib/multibox_detection-inl.h
+# ---------------------------------------------------------------------------
+
+def _mbd_infer(attrs, in_shapes):
+    cls_prob, loc_pred, anchor = in_shapes[:3]
+    if cls_prob is None or anchor is None:
+        return list(in_shapes), [None], []
+    return ([tuple(cls_prob), tuple(loc_pred), tuple(anchor)],
+            [(cls_prob[0], anchor[1], 6)], [])
+
+
+def _nms_mask(boxes, scores, valid, nms_threshold, topk):
+    """Greedy NMS via fori_loop over the topk score-sorted candidates;
+    returns keep mask [A]."""
+    order = jnp.argsort(-scores)
+    keep = valid
+
+    rank = jnp.argsort(order)                          # score rank per box
+
+    def body(i, keep):
+        idx = order[i]
+        alive = keep[idx]
+        ious = _iou(boxes[idx][None, :], boxes)[0]     # [A]
+        # suppress strictly-lower-ranked boxes overlapping idx
+        suppress = (ious > nms_threshold) & (rank > rank[idx])
+        return jnp.where(alive, keep & ~suppress, keep)
+
+    return lax.fori_loop(0, topk, body, keep)
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          input_names=("cls_prob", "loc_pred", "anchor"),
+          infer_shape=_mbd_infer)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """SSD detection output (multibox_detection-inl.h): decode loc
+    predictions against anchors, take per-anchor best non-background class,
+    score-threshold, per-class greedy NMS.  Output [N, A, 6] rows
+    (class_id, score, x1, y1, x2, y2); suppressed rows have class_id=-1."""
+    variances = _flist(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor[0]
+    a = anchors.shape[0]
+    topk = a if nms_topk is None or int(nms_topk) <= 0 else \
+        min(int(nms_topk), a)
+
+    def per_sample(probs, deltas):
+        # probs [cls, A]; deltas [A*4]
+        boxes = _decode(anchors, deltas.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        mask = jnp.ones(probs.shape[0], bool).at[background_id].set(False)
+        fg = jnp.where(mask[:, None], probs, -1.0)
+        cls_id = jnp.argmax(fg, axis=0)                # [A]
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        if force_suppress:
+            keep = _nms_mask(boxes, jnp.where(valid, score, -1.0), valid,
+                             nms_threshold, topk)
+        else:
+            keep = valid
+            n_cls = probs.shape[0]
+            for c in range(n_cls):
+                if c == background_id:
+                    continue
+                sel = valid & (cls_id == c)
+                k = _nms_mask(boxes, jnp.where(sel, score, -1.0), sel,
+                              nms_threshold, topk)
+                keep = jnp.where(sel, k, keep)
+        # class ids in output are 0-based foreground ids: classes above
+        # background_id shift down by one (reference drops background)
+        fg_id = jnp.where(cls_id > background_id, cls_id - 1, cls_id)
+        out_id = jnp.where(keep, fg_id.astype(jnp.float32), -1.0)
+        rows = jnp.concatenate([out_id[:, None], score[:, None], boxes],
+                               axis=1)
+        return rows
+
+    out = jax.vmap(per_sample)(cls_prob, loc_pred)
+    return lax.stop_gradient(out)
+
+
+# ---------------------------------------------------------------------------
+# Proposal — contrib/proposal-inl.h (Faster R-CNN RPN proposals)
+# ---------------------------------------------------------------------------
+
+def _gen_base_anchors(base_size, scales, ratios):
+    """Standard RPN base anchors around (0,0) (proposal-inl.h
+    GenerateAnchor)."""
+    px = (base_size - 1) * 0.5
+    py = (base_size - 1) * 0.5
+    anchors = []
+    area = base_size * base_size
+    for r in ratios:
+        size_r = area / r
+        ws = int(round(np.sqrt(size_r)))
+        hs = int(round(ws * r))
+        for s in scales:
+            w = ws * s
+            h = hs * s
+            anchors.append([px - (w - 1) * 0.5, py - (h - 1) * 0.5,
+                            px + (w - 1) * 0.5, py + (h - 1) * 0.5])
+    return np.array(anchors, np.float32)
+
+
+def _proposal_infer(attrs, in_shapes):
+    cls_prob = in_shapes[0]
+    if cls_prob is None:
+        return list(in_shapes), [None], []
+    post = int(attrs.get("rpn_post_nms_top_n", 300))
+    n = cls_prob[0]
+    outs = [(n * post, 5)]
+    if attrs.get("output_score"):
+        outs.append((n * post, 1))
+    return list(in_shapes), outs, []
+
+
+def _proposal_num_outputs(attrs):
+    return 2 if attrs.get("output_score") else 1
+
+
+@register("_contrib_Proposal", aliases=("Proposal",),
+          input_names=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=_proposal_num_outputs, infer_shape=_proposal_infer)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposal generation (proposal-inl.h ProposalOp): slide base
+    anchors over the feature map, decode bbox_pred, clip to image, drop
+    small boxes, take pre-NMS top-N by score, NMS, pad to post-NMS top-N."""
+    n, two_a, h, w = cls_prob.shape
+    scales = tuple(float(s) for s in (scales if isinstance(scales, (list, tuple)) else (scales,)))
+    ratios = tuple(float(r) for r in (ratios if isinstance(ratios, (list, tuple)) else (ratios,)))
+    base = _gen_base_anchors(int(feature_stride), scales, ratios)  # [A0, 4]
+    a0 = base.shape[0]
+    sy = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([sxg, syg, sxg, syg], axis=-1)   # [h, w, 4]
+    anchors = (shift[:, :, None, :] + base[None, None]).reshape(-1, 4)
+
+    post = int(rpn_post_nms_top_n)
+    pre = min(int(rpn_pre_nms_top_n), anchors.shape[0])
+
+    def per_sample(probs, deltas, info):
+        # probs [2*A0, h, w] (bg scores first A0 channels, fg last);
+        # deltas [4*A0, h, w]
+        fg = probs[a0:].transpose(1, 2, 0).reshape(-1)         # [h*w*A0]
+        d = deltas.reshape(a0, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # decode (unnormalized RPN parameterization: variances = 1)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        ww = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        hh = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - (ww - 1) * 0.5, cy - (hh - 1) * 0.5,
+                           cx + (ww - 1) * 0.5, cy + (hh - 1) * 0.5],
+                          axis=-1)
+        im_h, im_w = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1),
+                           jnp.clip(boxes[:, 1], 0, im_h - 1),
+                           jnp.clip(boxes[:, 2], 0, im_w - 1),
+                           jnp.clip(boxes[:, 3], 0, im_h - 1)], axis=-1)
+        min_size = rpn_min_size * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+                    ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        score = jnp.where(keep_size, fg, -1.0)
+        top_score, top_idx = lax.top_k(score, pre)
+        top_boxes = boxes[top_idx]
+        valid = top_score > 0
+        keep = _nms_mask(top_boxes, top_score, valid, threshold, pre)
+        # order survivors by score, take post
+        rank_score = jnp.where(keep, top_score, -1.0)
+        sel_score, sel = lax.top_k(rank_score, post)
+        out_boxes = jnp.where((sel_score > 0)[:, None], top_boxes[sel], 0.0)
+        out_score = jnp.maximum(sel_score, 0.0)
+        return out_boxes, out_score
+
+    boxes, scores = jax.vmap(per_sample)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.float32), post)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(n * post, 4)], axis=1)
+    rois = lax.stop_gradient(rois)
+    if output_score:
+        return rois, lax.stop_gradient(scores.reshape(n * post, 1))
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT — contrib/fft-inl.h (cuFFT): real input -> interleaved
+# real/imag output of length 2d
+# ---------------------------------------------------------------------------
+
+def _fft_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return list(in_shapes), [None], []
+    return [tuple(d)], [d[:-1] + (d[-1] * 2,)], []
+
+
+@register("_contrib_fft", aliases=("fft",), infer_shape=_fft_infer)
+def fft(data, compute_size=128):
+    """FFT along the last dim; complex output interleaved [re, im, re, im...]
+    (fft-inl.h output layout, 2*d)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (data.shape[-1] * 2,)) \
+        .astype(jnp.float32)
+
+
+def _ifft_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return list(in_shapes), [None], []
+    return [tuple(d)], [d[:-1] + (d[-1] // 2,)], []
+
+
+@register("_contrib_ifft", aliases=("ifft",), infer_shape=_ifft_infer)
+def ifft(data, compute_size=128):
+    """Inverse of _contrib_fft: interleaved complex -> real (the reference
+    scales by n like cuFFT's unnormalized inverse divided in python)."""
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2))
+    z = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(jnp.float32) * d
+
+
+# ---------------------------------------------------------------------------
+# count_sketch — contrib/count_sketch-inl.h
+# ---------------------------------------------------------------------------
+
+def _cs_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    out_dim = int(attrs["out_dim"])
+    if d is None:
+        return list(in_shapes), [None], []
+    return list(in_shapes), [(d[0], out_dim)], []
+
+
+@register("_contrib_count_sketch", aliases=("count_sketch",),
+          input_names=("data", "h", "s"), infer_shape=_cs_infer)
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (count_sketch-inl.h): out[:, h[j]] +=
+    s[j] * data[:, j].  h in [0, out_dim), s in {+1, -1}.  Linear, so the
+    gradient falls out of autodiff through the scatter-add."""
+    out_dim = int(out_dim)
+    hj = h.reshape(-1).astype(jnp.int32)
+    sj = s.reshape(-1).astype(data.dtype)
+    vals = data * sj[None, :]
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
+    return out.at[..., hj].add(vals)

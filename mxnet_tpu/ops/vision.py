@@ -1,0 +1,260 @@
+"""Vision ops: ROIPooling, SpatialTransformer, GridGenerator,
+BilinearSampler, Correlation.
+
+TPU-native re-design of the reference's CUDA vision layers
+(src/operator/roi_pooling.cc, spatial_transformer.cc, bilinear_sampler.cc,
+grid_generator.cc, correlation.cc).  Everything is expressed as dense
+masked reductions / gathers over static shapes so XLA can tile them; the
+gradients fall out of autodiff instead of the reference's hand-written
+backward kernels (e.g. ROIPoolBackwardAcc, roi_pooling.cc:133-199).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _pair(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(int(v) for v in x)
+    return (int(x), int(x))
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling — src/operator/roi_pooling-inl.h (pooled_size, spatial_scale)
+# ---------------------------------------------------------------------------
+
+def _roi_infer(attrs, in_shapes):
+    data, rois = in_shapes[0], in_shapes[1]
+    ph, pw = _pair(attrs["pooled_size"])
+    if data is None or rois is None:
+        return list(in_shapes), [None], []
+    out = (rois[0], data[1], ph, pw)
+    return [tuple(data), tuple(rois)], [out], []
+
+
+@register("ROIPooling", input_names=("data", "rois"), infer_shape=_roi_infer)
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
+    """Max-pool regions of interest to a fixed size (reference
+    roi_pooling-inl.h ROIPoolForward).  rois are [batch_idx, x1, y1, x2, y2]
+    in image coordinates; coordinates are scaled by spatial_scale and
+    rounded, matching the reference."""
+    ph, pw = _pair(pooled_size)
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        img = data[bidx]                              # [C, H, W]
+
+        ys = jnp.arange(h)[None, :]                   # [1, H]
+        ih = jnp.arange(ph, dtype=jnp.float32)[:, None]
+        hstart = jnp.floor(ih * bin_h).astype(jnp.int32) + y1
+        hend = jnp.ceil((ih + 1) * bin_h).astype(jnp.int32) + y1
+        hstart = jnp.clip(hstart, 0, h)
+        hend = jnp.clip(hend, 0, h)
+        mask_h = (ys >= hstart) & (ys < hend)         # [ph, H]
+
+        xs = jnp.arange(w)[None, :]
+        iw = jnp.arange(pw, dtype=jnp.float32)[:, None]
+        wstart = jnp.floor(iw * bin_w).astype(jnp.int32) + x1
+        wend = jnp.ceil((iw + 1) * bin_w).astype(jnp.int32) + x1
+        wstart = jnp.clip(wstart, 0, w)
+        wend = jnp.clip(wend, 0, w)
+        mask_w = (xs >= wstart) & (xs < wend)         # [pw, W]
+
+        neg = jnp.finfo(data.dtype).min
+        # max over W per output column: [C, H, pw]
+        t = jnp.where(mask_w[None, None, :, :], img[:, :, None, :], neg)
+        t = t.max(axis=-1)
+        # then max over H per output row: [C, ph, pw]
+        o = jnp.where(mask_h[None, :, None, :],
+                      jnp.swapaxes(t, 1, 2)[:, None, :, :], neg)
+        o = o.max(axis=-1)
+        return jnp.where(o == neg, 0.0, o).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler — src/operator/bilinear_sampler-inl.h
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, grid):
+    """Sample data [C,H,W] at normalized grid [2,Ho,Wo] ((x,y) in [-1,1]),
+    zero padding outside (bilinear_sampler-inl.h between_bounds)."""
+    c, h, w = data.shape
+    gx = (grid[0] + 1) * (w - 1) / 2
+    gy = (grid[1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+    out = 0.0
+    for dy, dx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        xi = x0 + dx
+        yi = y0 + dy
+        wgt = (wx if dx else (1 - wx)) * (wy if dy else (1 - wy))
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        val = data[:, yc, xc]                         # [C, Ho, Wo]
+        out = out + jnp.where(inb, wgt, 0.0)[None] * val
+    return out
+
+
+def _bs_infer(attrs, in_shapes):
+    data, grid = in_shapes[:2]
+    if data is None or grid is None:
+        return list(in_shapes), [None], []
+    out = (data[0], data[1], grid[2], grid[3])
+    return [tuple(data), tuple(grid)], [out], []
+
+
+@register("BilinearSampler", input_names=("data", "grid"),
+          infer_shape=_bs_infer)
+def bilinear_sampler(data, grid):
+    """data [N,C,H,W], grid [N,2,Ho,Wo] normalized to [-1,1]."""
+    return jax.vmap(_bilinear_sample)(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator — src/operator/grid_generator-inl.h
+# ---------------------------------------------------------------------------
+
+def _grid_infer(attrs, in_shapes):
+    (data,) = in_shapes[:1]
+    tt = attrs.get("transform_type", "affine")
+    if data is None:
+        return list(in_shapes), [None], []
+    if tt == "affine":
+        th, tw = _pair(attrs["target_shape"])
+        return [tuple(data)], [(data[0], 2, th, tw)], []
+    return [tuple(data)], [tuple(data)], []
+
+
+@register("GridGenerator", infer_shape=_grid_infer)
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """affine: data [N,6] -> sampling grid [N,2,H,W]; warp: data is an
+    [N,2,H,W] optical flow added to the identity grid (grid_generator-inl.h)."""
+    if transform_type == "affine":
+        th, tw = _pair(target_shape)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, th),
+                              jnp.linspace(-1, 1, tw), indexing="ij")
+        base = jnp.stack([xs.ravel(), ys.ravel(),
+                          jnp.ones(th * tw)])        # [3, H*W]
+        theta = data.reshape(-1, 2, 3)               # [N, 2, 3]
+        grid = jnp.einsum("nij,jk->nik", theta, base)
+        return grid.reshape(-1, 2, th, tw)
+    if transform_type == "warp":
+        n, _two, h, w = data.shape
+        ys, xs = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                              jnp.arange(w, dtype=data.dtype), indexing="ij")
+        gx = (data[:, 0] + xs) * 2 / max(w - 1, 1) - 1
+        gy = (data[:, 1] + ys) * 2 / max(h - 1, 1) - 1
+        return jnp.stack([gx, gy], axis=1)
+    raise MXNetError("unknown transform_type %r" % (transform_type,))
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer — src/operator/spatial_transformer-inl.h
+# ---------------------------------------------------------------------------
+
+def _st_infer(attrs, in_shapes):
+    data, loc = in_shapes[:2]
+    th, tw = _pair(attrs["target_shape"])
+    if data is None:
+        return list(in_shapes), [None], []
+    return [tuple(data), (data[0], 6)], [(data[0], data[1], th, tw)], []
+
+
+@register("SpatialTransformer", input_names=("data", "loc"),
+          infer_shape=_st_infer)
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear"):
+    """Affine spatial transformer network layer: loc [N,6] predicts an
+    affine transform; output is data sampled on the transformed grid."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear only "
+                         "(as the reference, spatial_transformer-inl.h)")
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation — src/operator/correlation-inl.h (FlowNet cost volume)
+# ---------------------------------------------------------------------------
+
+def _corr_infer(attrs, in_shapes):
+    d1 = in_shapes[0]
+    if d1 is None:
+        return list(in_shapes), [None], []
+    pad = int(attrs.get("pad_size", 0))
+    ks = int(attrs.get("kernel_size", 1))
+    md = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    n, c, h, w = d1
+    ph, pw = h + 2 * pad, w + 2 * pad
+    kr = ks // 2
+    br = (md // s2) * s2 + kr         # border_size
+    oh = int(np.ceil(float(ph - br * 2) / s1))
+    ow = int(np.ceil(float(pw - br * 2) / s1))
+    nd = md // s2 * 2 + 1
+    top_c = nd * nd
+    return [tuple(d1), tuple(d1)], [(n, top_c, oh, ow)], []
+
+
+@register("Correlation", input_names=("data1", "data2"),
+          infer_shape=_corr_infer)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Correlation cost volume between two feature maps (correlation-inl.h).
+    For each output position and displacement (di,dj), the mean over channels
+    and the kernel window of data1*shift(data2) (or |data1-shift(data2)| when
+    is_multiply=False)."""
+    n, c, h, w = data1.shape
+    ks = int(kernel_size)
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    pad = int(pad_size)
+    kr = ks // 2
+    br = (md // s2) * s2 + kr
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = int(np.ceil(float(ph - br * 2) / s1))
+    ow = int(np.ceil(float(pw - br * 2) / s1))
+    nd_half = md // s2
+    disp = [i * s2 for i in range(-nd_half, nd_half + 1)]
+    maps = []
+    ys = br + s1 * jnp.arange(oh)
+    xs = br + s1 * jnp.arange(ow)
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                prod = p1 * shifted
+            else:
+                prod = jnp.abs(p1 - shifted)
+            if ks > 1:
+                prod = lax.reduce_window(
+                    prod, 0.0, lax.add, (1, 1, ks, ks), (1, 1, 1, 1),
+                    "SAME") / (ks * ks)
+            m = prod.mean(axis=1)                     # [N, ph, pw]
+            maps.append(m[:, ys][:, :, xs])
+    return jnp.stack(maps, axis=1)

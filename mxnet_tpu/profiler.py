@@ -1,0 +1,85 @@
+"""Profiler control (reference python/mxnet/profiler.py over MXSetProfilerConfig/
+MXSetProfilerState/MXDumpProfile, src/engine/profiler.{h,cc}).
+
+Two collectors feed one Chrome ``traceEvents`` dump, matching the reference's
+format (profiler.cc:134-216):
+- the host dependency engine's per-op timings (data pipeline, engine ops) via
+  the native profiler (mxnet_tpu/native/engine.cc);
+- XLA device traces via ``jax.profiler`` when a trace_dir is configured
+  (mode='all_xla') — viewable in TensorBoard/Perfetto, the TPU analog of the
+  reference's per-kernel GPU stats.
+
+Env parity: MXNET_PROFILER_AUTOSTART=1 starts profiling at import
+(docs/how_to/env_var.md:66-73).
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "State", "Mode"]
+
+
+class Mode(object):
+    SYMBOLIC = "symbolic"       # kOnlySymbolic
+    ALL = "all"                 # kAllOperator
+    ALL_XLA = "all_xla"         # + device-side XLA trace via jax.profiler
+
+
+class State(object):
+    STOP = "stop"               # kNotRunning
+    RUN = "run"                 # kRunning
+
+
+_config = {"mode": Mode.ALL, "filename": "profile.json", "trace_dir": None}
+_state = [State.STOP]
+_xla_tracing = [False]
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json",
+                        trace_dir=None):
+    """Set profiler mode and output file (reference profiler.py:
+    profiler_set_config / MXSetProfilerConfig)."""
+    if mode not in (Mode.SYMBOLIC, Mode.ALL, Mode.ALL_XLA):
+        raise MXNetError("invalid profiler mode %r" % (mode,))
+    _config["mode"] = mode
+    _config["filename"] = filename
+    _config["trace_dir"] = trace_dir
+
+
+def profiler_set_state(state="stop"):
+    """Start/stop profiling (reference profiler.py:profiler_set_state /
+    MXSetProfilerState)."""
+    from . import engine
+    if state not in (State.RUN, State.STOP):
+        raise MXNetError("invalid profiler state %r" % (state,))
+    running = state == State.RUN
+    engine.get().set_profiler_state(running)
+    if _config["mode"] == Mode.ALL_XLA:
+        import jax
+        trace_dir = _config["trace_dir"] or \
+            os.path.splitext(_config["filename"])[0] + "_xla"
+        if running and not _xla_tracing[0]:
+            jax.profiler.start_trace(trace_dir)
+            _xla_tracing[0] = True
+        elif not running and _xla_tracing[0]:
+            jax.profiler.stop_trace()
+            _xla_tracing[0] = False
+    _state[0] = state
+
+
+def dump_profile(finished=True):
+    """Write the collected host-engine trace as Chrome traceEvents JSON to
+    the configured filename (reference profiler.py:dump_profile /
+    MXDumpProfile)."""
+    from . import engine
+    data = engine.get().dump_profile()
+    with open(_config["filename"], "w") as f:
+        f.write(data)
+    return _config["filename"]
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_state(State.RUN)

@@ -1,0 +1,90 @@
+"""Monitor, profiler, visualization tests (reference test_profiler.py,
+test_viz.py, monitor usage in examples)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_monitor_collects_stats():
+    net = _mlp()
+    x = np.random.uniform(-1, 1, (8, 10)).astype(np.float32)
+    ex = net.simple_bind(mx.current_context(), data=(8, 10),
+                         softmax_label=(8,))
+    for k, v in ex.arg_dict.items():
+        if k != "data" and not k.endswith("label"):
+            v[:] = np.random.uniform(-0.1, 0.1, v.shape)
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True, data=x)
+    res = mon.toc()
+    names = [k for _n, k, _v in res]
+    assert any("fc1" in n for n in names)
+    assert any("fc2" in n for n in names)
+    assert not any("relu" in n for n in names)  # pattern filtered
+    # interval: second batch not sampled with interval=2
+    mon2 = mx.Monitor(interval=2)
+    mon2.install(ex)
+    mon2.tic(); ex.forward(is_train=False); first = mon2.toc()
+    mon2.tic(); ex.forward(is_train=False); second = mon2.toc()
+    assert first and not second
+
+
+def test_monitor_in_module_fit():
+    net = _mlp()
+    x = np.random.uniform(-1, 1, (40, 10)).astype(np.float32)
+    y = np.random.randint(0, 4, (40,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=20, label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=["softmax_label"],
+                        context=mx.current_context())
+    mon = mx.Monitor(interval=1)
+    mod.fit(it, num_epoch=1, monitor=mon)
+
+
+def test_profiler_dump(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler_set_config(mode="all", filename=fname)
+    mx.profiler_set_state("run")
+    eng = mx.engine.get()
+    done = []
+    for i in range(4):
+        v = eng.new_variable()
+        eng.push(lambda i=i: done.append(i), const_vars=(), mutable_vars=(v,),
+                 name="testop%d" % i)
+    eng.wait_for_all()
+    mx.profiler_set_state("stop")
+    out = mx.dump_profile()
+    assert out == fname and os.path.exists(fname)
+    data = json.load(open(fname))
+    assert "traceEvents" in data
+    names = {e["name"] for e in data["traceEvents"]}
+    assert any("testop" in n for n in names)
+
+
+def test_print_summary(capsys):
+    net = _mlp()
+    total = mx.viz.print_summary(net, shape={"data": (8, 10)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # fc1: 10*16+16, fc2: 16*4+4
+    assert total == 10 * 16 + 16 + 16 * 4 + 4
+
+
+def test_plot_network():
+    pytest.importorskip("graphviz")
+    net = _mlp()
+    dot = mx.viz.plot_network(net, shape={"data": (8, 10)})
+    src = dot.source
+    assert "fc1" in src and "softmax" in src

@@ -1,0 +1,259 @@
+"""Vision + contrib op tests (reference test_operator.py ROI/ST/bilinear
+sections and example/ssd, example/rcnn usage)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_roi_pooling_forward():
+    # 1x1x6x6 ramp image, one ROI covering the full image, 2x2 pool
+    data = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0)
+    # bins rows 0-2/3-5, cols 0-2/3-5 -> maxes 14,17,32,35
+    assert_almost_equal(out.asnumpy().reshape(2, 2),
+                        np.array([[14, 17], [32, 35]], np.float32))
+
+
+def test_roi_pooling_batch_and_grad():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    pooled = mx.sym.ROIPooling(data, rois, pooled_size=(3, 3),
+                               spatial_scale=0.5)
+    x = np.random.uniform(0, 1, (2, 4, 12, 12)).astype(np.float32)
+    r = np.array([[0, 0, 0, 11, 11], [1, 2, 2, 9, 9],
+                  [0, 4, 4, 20, 20]], np.float32)
+    _, out_shapes, _ = pooled.infer_shape(data=x.shape, rois=r.shape)
+    assert out_shapes[0] == (3, 4, 3, 3)
+    gx = mx.nd.zeros(x.shape)
+    ex = pooled.bind(mx.current_context(),
+                     {"data": mx.nd.array(x), "rois": mx.nd.array(r)},
+                     args_grad={"data": gx}, grad_req={"data": "write",
+                                                       "rois": "null"})
+    out = ex.forward(is_train=True)[0]
+    assert out.shape == (3, 4, 3, 3)
+    ex.backward([mx.nd.ones(out.shape)])
+    # gradient scatters ones to max positions: total = #output elements
+    assert abs(gx.asnumpy().sum() - 3 * 4 * 3 * 3) < 1e-3
+
+
+def test_bilinear_sampler_identity():
+    x = np.random.uniform(-1, 1, (2, 3, 5, 7)).astype(np.float32)
+    h, w = 5, 7
+    ys, xs = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+                         indexing="ij")
+    grid = np.stack([xs, ys])[None].repeat(2, axis=0).astype(np.float32)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), mx.nd.array(grid))
+    assert_almost_equal(out.asnumpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_identity_affine():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)  # identity transform
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape=(4, 6))
+    g = grid.asnumpy()
+    assert g.shape == (1, 2, 4, 6)
+    assert_almost_equal(g[0, 0, 0], np.linspace(-1, 1, 6), rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(g[0, 1, :, 0], np.linspace(-1, 1, 4), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_spatial_transformer_identity():
+    x = np.random.uniform(-1, 1, (2, 3, 8, 8)).astype(np.float32)
+    loc = np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(loc),
+                                   target_shape=(8, 8),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    assert_almost_equal(out.asnumpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_grad_flows():
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    st = mx.sym.SpatialTransformer(data, loc, target_shape=(4, 4),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    x = np.random.uniform(0, 1, (1, 2, 4, 4)).astype(np.float32)
+    theta = np.array([[0.9, 0.1, 0.05, -0.1, 0.8, 0.0]], np.float32)
+    gl = mx.nd.zeros(theta.shape)
+    ex = st.bind(mx.current_context(),
+                 {"data": mx.nd.array(x), "loc": mx.nd.array(theta)},
+                 args_grad={"loc": gl},
+                 grad_req={"data": "null", "loc": "write"})
+    out = ex.forward(is_train=True)[0]
+    ex.backward([mx.nd.ones(out.shape)])
+    assert np.abs(gl.asnumpy()).sum() > 0
+
+
+def test_correlation_shapes_and_self_match():
+    x = np.random.uniform(0, 1, (1, 8, 10, 10)).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x),
+                            kernel_size=1, max_displacement=2,
+                            stride1=1, stride2=1, pad_size=2)
+    o = out.asnumpy()
+    assert o.shape == (1, 25, 10, 10)
+    # center displacement (0,0) equals mean over channels of x*x
+    center = o[0, 12]
+    assert_almost_equal(center, (x[0] ** 2).mean(axis=0), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.contrib.nd.MultiBoxPrior(data, sizes=[0.5, 0.25],
+                                          ratios=[1, 2])
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4 * 4 * 3, 4)
+    # first anchor centered at (0.125, 0.125) with size 0.5
+    assert_almost_equal(a[0, 0], np.array(
+        [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+        np.float32), rtol=1e-5, atol=1e-6)
+    # shapes via symbol
+    d = mx.sym.Variable("data")
+    s = mx.contrib.sym.MultiBoxPrior(d, sizes=[0.5], ratios=[1])
+    _, out_shapes, _ = s.infer_shape(data=(1, 3, 4, 4))
+    assert out_shapes[0] == (1, 16, 4)
+
+
+def test_multibox_target():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt box matching anchor 0 well
+    label = np.array([[[1.0, 0.05, 0.05, 0.45, 0.45],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    loc_t, loc_m, cls_t = mx.contrib.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold=0.5)
+    assert cls_t.shape == (1, 3)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 2.0          # class 1 -> target 2 (bg=0)
+    assert ct[1] == 0.0 and ct[2] == 0.0
+    lm = loc_m.asnumpy().reshape(1, 3, 4)[0]
+    assert lm[0].all() and not lm[1].any()
+
+
+def test_multibox_detection():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.52, 0.52, 1.0, 1.0],
+                         [0.01, 0.01, 0.51, 0.51]]], np.float32)
+    # class probs: anchors 0 and 2 strongly class-1; anchor 1 background
+    cls_prob = np.array([[[0.1, 0.9, 0.1], [0.9, 0.1, 0.9]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.contrib.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5, threshold=0.2)
+    o = out.asnumpy()[0]
+    assert o.shape == (3, 6)
+    kept = o[o[:, 0] >= 0]
+    # NMS suppresses anchor 2 (overlaps anchor 0, same class, lower score)
+    assert len(kept) == 1
+    assert kept[0][0] == 0.0     # foreground class id 0 (was class 1)
+    assert abs(kept[0][1] - 0.9) < 1e-5
+
+
+def test_proposal():
+    np.random.seed(0)
+    h, w, a0 = 4, 4, 12          # 4 scales x 3 ratios
+    cls_prob = np.random.uniform(0, 1, (1, 2 * a0, h, w)).astype(np.float32)
+    bbox_pred = (np.random.uniform(-0.1, 0.1, (1, 4 * a0, h, w))
+                 .astype(np.float32))
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.contrib.nd.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10, threshold=0.7,
+        rpn_min_size=4, feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (10, 5)
+    assert (r[:, 0] == 0).all()
+    # boxes clipped to image
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.uniform(-1, 1, (3, 8)).astype(np.float32)
+    f = mx.nd.fft(mx.nd.array(x))
+    assert f.shape == (3, 16)
+    back = mx.nd.ifft(f) / 8
+    assert_almost_equal(back.asnumpy(), x, rtol=1e-4, atol=1e-5)
+    # parity with numpy fft
+    ref = np.fft.fft(x, axis=-1)
+    inter = np.empty((3, 16), np.float32)
+    inter[:, 0::2] = ref.real
+    inter[:, 1::2] = ref.imag
+    assert_almost_equal(f.asnumpy().reshape(3, 8, 2).reshape(3, 16),
+                        inter, rtol=1e-3, atol=1e-3)
+
+
+def test_count_sketch():
+    d, out_dim = 6, 4
+    x = np.random.uniform(-1, 1, (2, d)).astype(np.float32)
+    h = np.array([[0, 1, 2, 3, 0, 1]], np.float32)
+    s = np.array([[1, -1, 1, 1, -1, 1]], np.float32)
+    out = mx.nd.count_sketch(mx.nd.array(x), mx.nd.array(h), mx.nd.array(s),
+                             out_dim=out_dim)
+    expect = np.zeros((2, out_dim), np.float32)
+    for j in range(d):
+        expect[:, int(h[0, j])] += s[0, j] * x[:, j]
+    assert_almost_equal(out.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_nondivisible_displacement():
+    """Review regression: max_displacement not divisible by stride2 must
+    still match inferred channel count."""
+    x = np.random.uniform(0, 1, (1, 4, 8, 8)).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x),
+                            kernel_size=1, max_displacement=5, stride1=1,
+                            stride2=2, pad_size=5)
+    d = mx.sym.Variable("a")
+    s = mx.sym.Correlation(d, mx.sym.Variable("b"), kernel_size=1,
+                           max_displacement=5, stride1=1, stride2=2,
+                           pad_size=5)
+    _, out_shapes, _ = s.infer_shape(a=(1, 4, 8, 8), b=(1, 4, 8, 8))
+    assert out.shape == out_shapes[0]
+    assert out.shape[1] == 25  # (2*(5//2)+1)^2
+
+
+def test_multibox_detection_nonzero_background():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)
+    cls_prob = np.array([[[0.9], [0.05], [0.05]]], np.float32)  # class 0 wins
+    loc_pred = np.zeros((1, 4), np.float32)
+    out = mx.contrib.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        background_id=1, threshold=0.2)
+    o = out.asnumpy()[0]
+    kept = o[o[:, 0] >= 0]
+    assert len(kept) == 1 and kept[0][0] == 0.0  # class 0 survives as id 0
+
+
+def test_multibox_target_padded_rows_dont_clobber():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       np.float32)
+    # valid gt best-matches anchor 0; padding row must not erase it
+    label = np.array([[[0.0, 0.0, 0.0, 0.45, 0.45],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    _lt, _lm, cls_t = mx.contrib.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0 and ct[1] == 0.0
+
+
+def test_multibox_target_negative_mining():
+    a = 8
+    anchors = np.zeros((1, a, 4), np.float32)
+    for i in range(a):
+        anchors[0, i] = [i / a, i / a, i / a + 0.1, i / a + 0.1]
+    label = np.array([[[0.0, 0.0, 0.0, 0.12, 0.12]]], np.float32)
+    cls_pred = np.random.uniform(-1, 1, (1, 3, a)).astype(np.float32)
+    _lt, _lm, cls_t = mx.contrib.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        negative_mining_ratio=2.0, negative_mining_thresh=0.3,
+        ignore_label=-1.0)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 1.0).sum() == 1          # one positive
+    assert (ct == 0.0).sum() == 2          # ratio 2 -> two mined negatives
+    assert (ct == -1.0).sum() == a - 3     # rest ignored
