@@ -63,4 +63,5 @@ from . import model
 from .model import FeedForward
 from . import module
 from . import module as mod
+from . import predict
 from . import test_utils

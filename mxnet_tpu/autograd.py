@@ -116,7 +116,9 @@ def train_section():
 def test_section():
     """Scope that pauses recording inside a train_section."""
     prev = _STATE.recording
+    prev_training = _STATE.training
     _STATE.recording = False
+    _STATE.training = False
     hook = _nd._RECORD_HOOK[0]
     mode = _nd._TRAIN_MODE[0]
     _nd._RECORD_HOOK[0] = None
@@ -125,6 +127,7 @@ def test_section():
         yield
     finally:
         _STATE.recording = prev
+        _STATE.training = prev_training
         _nd._RECORD_HOOK[0] = hook
         _nd._TRAIN_MODE[0] = mode
 
@@ -222,8 +225,16 @@ def backward(outputs, out_grads=None, retain_graph=False):
     else:
         if isinstance(out_grads, NDArray):
             out_grads = [out_grads]
-        cotangents = [g._data if isinstance(g, NDArray)
-                      else jax.numpy.asarray(g) for g in out_grads]
+        if len(out_grads) != len(_outs):
+            raise MXNetError(
+                "backward: %d head gradients for %d outputs"
+                % (len(out_grads), len(_outs)))
+        # cast to each output's dtype: float16/bfloat16 outputs with
+        # float32 head grads would make jax.vjp raise a dtype mismatch
+        cotangents = [
+            (g._data if isinstance(g, NDArray)
+             else jax.numpy.asarray(g)).astype(o.dtype)
+            for g, o in zip(out_grads, _outs)]
     (leaf_grads,) = vjp_fn(cotangents)
     for g_holder, g_val, req in zip(grads_out, leaf_grads, reqs):
         if req == "null":

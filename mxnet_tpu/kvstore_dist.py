@@ -9,11 +9,13 @@ locally on the reduced gradient — numerically identical to the reference's
 ``dist_sync`` protocol (sync servers aggregate all NumWorkers pushes, apply
 the updater once, broadcast).
 
-Process model: one JAX process per host (``jax.distributed.initialize`` —
-the tools/launch.py analog is tools/launch.py in this repo), every process
-sees its local chips; collectives ride ICI within a host / DCN across
-hosts.  ``dist_async`` has no ICI analog and raises (documented decision,
-SURVEY §7 hard parts).
+Process model: one JAX process per host (``jax.distributed.initialize``),
+every process sees its local chips; collectives ride ICI within a host /
+DCN across hosts.  Clusters are launched with ``tools/launch.py`` (the
+reference launcher's analog: it spawns N worker processes with
+coordinator/rank envs the way tools/launch.py:46-70 forks
+scheduler/server/worker roles with DMLC_* envs).  ``dist_async`` has no
+ICI analog and raises (documented decision, SURVEY §7 hard parts).
 """
 from __future__ import annotations
 

@@ -102,12 +102,29 @@ def _invoke(opdef, nd_inputs, attrs, is_train=False, ctx=None):
 
 def waitall():
     """Block until all outstanding computation on live arrays finishes
-    (Engine::WaitForAll analog, include/mxnet/engine.h:180)."""
+    (Engine::WaitForAll analog, include/mxnet/engine.h:180).
+
+    An async compute error surfaces here and propagates, like the
+    reference engine's loud fatal (threaded_engine.h:329-337) — after
+    draining the remaining arrays so state isn't left half-synced.
+    Arrays whose buffers were deleted (e.g. donated) are skipped.
+    """
+    first_err = None
     for arr in list(_LIVE):
         try:
             arr._data.block_until_ready()
-        except Exception:
-            pass
+        except RuntimeError as e:
+            if "deleted" in str(e).lower() or "donat" in str(e).lower():
+                continue  # freed/donated buffer, not a compute failure
+            if first_err is None:
+                first_err = e
+        except Exception as e:  # noqa: BLE001 — propagate after drain
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise MXNetError(
+            "async computation failed during waitall: %s" % first_err) \
+            from first_err
 
 
 class NDArray(object):
@@ -534,19 +551,26 @@ def save(fname, data):
             fo.write(encoded)
 
 
+def _load_stream(fi, ctx=None):
+    """Parse a ``.params``-format stream -> (names, arrays); names is empty
+    for unnamed lists.  Shared by nd.load and predict.load_ndarray_file."""
+    magic, _ = struct.unpack("<QQ", fi.read(16))
+    if magic != _LIST_MAGIC:
+        raise MXNetError("Invalid NDArray stream format")
+    num, = struct.unpack("<Q", fi.read(8))
+    arrays = [_load_one(fi, ctx) for i in range(num)]
+    num_names, = struct.unpack("<Q", fi.read(8))
+    names = []
+    for _i in range(num_names):
+        ln, = struct.unpack("<Q", fi.read(8))
+        names.append(fi.read(ln).decode("utf-8"))
+    return names, arrays
+
+
 def load(fname, ctx=None):
     """Load a reference-format ``.params`` file → dict or list of NDArray."""
     with open(fname, "rb") as fi:
-        magic, _ = struct.unpack("<QQ", fi.read(16))
-        if magic != _LIST_MAGIC:
-            raise MXNetError("Invalid NDArray file format: " + fname)
-        num, = struct.unpack("<Q", fi.read(8))
-        arrays = [_load_one(fi, ctx) for i in range(num)]
-        num_names, = struct.unpack("<Q", fi.read(8))
-        names = []
-        for _i in range(num_names):
-            ln, = struct.unpack("<Q", fi.read(8))
-            names.append(fi.read(ln).decode("utf-8"))
+        names, arrays = _load_stream(fi, ctx)
     if names:
         return dict(zip(names, arrays))
     return arrays

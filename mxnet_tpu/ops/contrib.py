@@ -62,8 +62,10 @@ def multibox_prior(data, sizes=None, ratios=None, clip=False, steps=None,
     cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
     cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")     # [h, w]
 
-    # anchors: all sizes with ratio[0], then size[0] with ratios[1:]
-    whs = [(s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])) for s in sizes]
+    # anchors: square (s, s) boxes for every size (the reference's
+    # multibox_prior.cc uses w=h=size/2 half-extents for all size anchors,
+    # ignoring ratios), then size[0] stretched by sqrt(ratio) for ratios[1:]
+    whs = [(s, s) for s in sizes]
     whs += [(sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r))
             for r in ratios[1:]]
     boxes = []
@@ -168,14 +170,29 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         iou = jnp.where(valid[None, :], iou, -1.0)
         best_gt = jnp.argmax(iou, axis=1)              # [A]
         best_iou = jnp.max(iou, axis=1)
-        # force-match: the best anchor of each VALID gt; padded rows
-        # scatter out of bounds and are dropped
-        best_anchor = jnp.argmax(iou, axis=0)          # [O]
-        scatter_idx = jnp.where(valid, best_anchor, a)
-        forced = jnp.zeros((a,), bool).at[scatter_idx].set(
-            True, mode="drop")
-        forced_gt = jnp.zeros((a,), jnp.int32).at[scatter_idx].set(
-            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        # force-match: iterative bipartite matching, one distinct anchor
+        # per valid gt (multibox_target-inl.h greedy matching): each round
+        # takes the globally-best remaining (anchor, gt) pair, then masks
+        # that anchor row and gt column so no anchor or gt matches twice.
+        n_gt = gt.shape[0]
+
+        def match_round(_, state):
+            iou_m, forced, forced_gt = state
+            flat = iou_m.reshape(-1)
+            idx = jnp.argmax(flat)
+            ai = idx // n_gt
+            gi = (idx % n_gt).astype(jnp.int32)
+            ok = flat[idx] >= 0.0          # invalid/exhausted entries < 0
+            forced = forced.at[ai].set(forced[ai] | ok)
+            forced_gt = forced_gt.at[ai].set(
+                jnp.where(ok, gi, forced_gt[ai]))
+            iou_m = iou_m.at[ai, :].set(-2.0)
+            iou_m = iou_m.at[:, gi].set(-2.0)
+            return iou_m, forced, forced_gt
+
+        _, forced, forced_gt = lax.fori_loop(
+            0, n_gt, match_round,
+            (iou, jnp.zeros((a,), bool), jnp.zeros((a,), jnp.int32)))
         pos = forced | (best_iou >= overlap_threshold)
         match = jnp.where(forced, forced_gt, best_gt)
         matched_gt = gt[match]                         # [A, 4]
@@ -286,7 +303,11 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         out_id = jnp.where(keep, fg_id.astype(jnp.float32), -1.0)
         rows = jnp.concatenate([out_id[:, None], score[:, None], boxes],
                                axis=1)
-        return rows
+        # compact: valid detections first, sorted by confidence descending
+        # (multibox_detection.cc sorts kept rows by score before writing,
+        # so consumers can read the first k rows)
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        return rows[order]
 
     out = jax.vmap(per_sample)(cls_prob, loc_pred)
     return lax.stop_gradient(out)

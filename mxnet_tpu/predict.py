@@ -1,0 +1,154 @@
+"""Standalone inference API — the reference's predict-only ABI rebuilt for
+TPU (include/mxnet/c_predict_api.h:1-210, src/c_api/c_predict_api.cc).
+
+The reference ships a minimal C surface (MXPredCreate / MXPredSetInput /
+MXPredForward / MXPredGetOutput / MXPredReshape) so mobile/amalgamation
+builds can run a trained model without the full framework.  Here the same
+lifecycle is a small class over the Symbol/Executor stack: create from a
+``-symbol.json`` string + ``.params`` blob, set named inputs, run one
+jit-compiled XLA forward, read outputs.  Like MXPredCreate, auxiliary
+states come from the params blob and the forward runs in inference mode
+(is_train=False).
+
+TPU-native notes: the forward is ONE cached XLA program per input-shape
+signature — ``reshape`` (MXPredReshape analog) just rebinds, hitting the
+jit cache when shapes repeat.  Weights stay device-resident across calls.
+"""
+from __future__ import annotations
+
+import io as _pyio
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+from .context import current_context
+
+__all__ = ["Predictor", "load_ndarray_file", "create"]
+
+
+def load_ndarray_file(blob, ctx=None):
+    """Parse a ``.params``-format byte blob -> dict of NDArray
+    (MXNDListCreate analog, c_predict_api.h:139-155)."""
+    fi = _pyio.BytesIO(blob if isinstance(blob, (bytes, bytearray))
+                       else bytes(blob))
+    names, arrays = nd._load_stream(fi, ctx)
+    if names:
+        return dict(zip(names, arrays))
+    return {str(i): a for i, a in enumerate(arrays)}
+
+
+def _strip_prefix(params):
+    """Split a checkpoint dict with ``arg:``/``aux:`` prefixes (the
+    save_checkpoint convention, python/mxnet/model.py) into (args, auxs)."""
+    args, auxs = {}, {}
+    for k, v in params.items():
+        if k.startswith("arg:"):
+            args[k[4:]] = v
+        elif k.startswith("aux:"):
+            auxs[k[4:]] = v
+        else:
+            args[k] = v
+    return args, auxs
+
+
+class Predictor(object):
+    """Inference-only executor with the MXPred lifecycle
+    (c_predict_api.h:43-137: Create/SetInput/Forward/GetOutput/Reshape)."""
+
+    def __init__(self, symbol_json, param_blob, input_shapes, ctx=None,
+                 output_name=None):
+        if isinstance(symbol_json, sym.Symbol):
+            net = symbol_json
+        else:
+            net = sym.load_json(symbol_json)
+        if output_name is not None:
+            # MXPredCreatePartialOut analog: predict up to a named output
+            net = net.get_internals()[output_name]
+        self._sym = net
+        self._ctx = ctx if ctx is not None else current_context()
+        if isinstance(param_blob, dict):
+            params = param_blob
+        else:
+            params = load_ndarray_file(param_blob, self._ctx)
+        self._arg_params, self._aux_params = _strip_prefix(params)
+        self._inputs = {}
+        self._exec = None
+        self.reshape(dict(input_shapes))
+
+    def reshape(self, input_shapes):
+        """Rebind for new input shapes (MXPredReshape, c_predict_api.h:107).
+        Weights are reused; a repeated shape signature hits the jit cache.
+        Staged inputs are cleared — like MXPredReshape, inputs must be
+        re-set afterwards."""
+        self._inputs = {}
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        arg_names = self._sym.list_arguments()
+        unknown = [n for n in self._input_shapes if n not in arg_names]
+        if unknown:
+            raise MXNetError("input name(s) %s not in symbol arguments"
+                             % (unknown,))
+        kwargs = dict(self._input_shapes)
+        arg_shapes, _, aux_shapes = self._sym.infer_shape(**kwargs)
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self._input_shapes:
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+            elif name in self._arg_params:
+                if tuple(self._arg_params[name].shape) != tuple(shape):
+                    raise MXNetError(
+                        "param %s shape mismatch: file %s vs inferred %s"
+                        % (name, self._arg_params[name].shape, shape))
+                args[name] = self._arg_params[name]
+            else:
+                # args absent from the blob (e.g. loss labels at inference)
+                # are allocated, not errors — c_predict_api.cc:190-196
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+        auxs = {}
+        for name, shape in zip(self._sym.list_auxiliary_states(),
+                               aux_shapes):
+            if name in self._aux_params:
+                auxs[name] = self._aux_params[name]
+            else:
+                auxs[name] = nd.zeros(shape, ctx=self._ctx)
+        self._exec = self._sym.bind(self._ctx, args, args_grad=None,
+                                    grad_req="null", aux_states=auxs)
+        return self
+
+    def set_input(self, name, data):
+        """MXPredSetInput: stage a named input for the next forward."""
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %r (declared: %s)"
+                             % (name, sorted(self._input_shapes)))
+        data = np.asarray(data, dtype=np.float32)
+        if tuple(data.shape) != self._input_shapes[name]:
+            raise MXNetError("input %r shape %s != declared %s"
+                             % (name, data.shape, self._input_shapes[name]))
+        self._inputs[name] = data
+        return self
+
+    def forward(self, **inputs):
+        """MXPredForward: run one inference-mode forward pass."""
+        for name, data in inputs.items():
+            self.set_input(name, data)
+        missing = set(self._input_shapes) - set(self._inputs)
+        if missing:
+            raise MXNetError("inputs not set: %s" % sorted(missing))
+        self._exec.forward(is_train=False, **self._inputs)
+        return self
+
+    def get_output(self, index=0):
+        """MXPredGetOutput: fetch output ``index`` as numpy."""
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def output_names(self):
+        return self._sym.list_outputs()
+
+
+def create(symbol_json, param_blob, input_shapes, ctx=None,
+           output_name=None):
+    """MXPredCreate analog."""
+    return Predictor(symbol_json, param_blob, input_shapes, ctx,
+                     output_name)

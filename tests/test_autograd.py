@@ -247,3 +247,27 @@ def test_nested_train_in_test_preserves_tape():
         compute_gradient([y])
     same(dx.asnumpy(), 3 * np.ones(2, np.float32))
     autograd.unmark_variables([x])
+
+
+def test_test_section_clears_training_flag():
+    """ADVICE regression: is_training() must be False inside test_section."""
+    with mx.autograd.train_section():
+        assert mx.autograd.is_training()
+        with mx.autograd.test_section():
+            assert not mx.autograd.is_training()
+            assert not mx.autograd.is_recording()
+        assert mx.autograd.is_training()
+
+
+def test_backward_casts_head_grads_to_output_dtype():
+    """ADVICE regression: float32 head grads against a bfloat16 output must
+    not raise a vjp dtype mismatch."""
+    x = mx.nd.array(np.ones((2, 3), np.float32)).astype("bfloat16")
+    gx = mx.nd.zeros((2, 3))
+    with mx.autograd.train_section():
+        mx.autograd.mark_variables([x], [gx])
+        y = x * 2.0
+        mx.autograd.backward([y], out_grads=[mx.nd.ones((2, 3)) * 3.0])
+    np.testing.assert_allclose(gx.asnumpy(),
+                               np.full((2, 3), 6.0, np.float32),
+                               rtol=1e-2, atol=1e-2)

@@ -1,0 +1,89 @@
+"""Predict API tests (reference include/mxnet/c_predict_api.h lifecycle:
+MXPredCreate / SetInput / Forward / GetOutput / Reshape)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _small_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _init_mod(net, batch=5, dim=6):
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (batch, dim))], [("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    return mod
+
+
+def test_predictor_matches_module(tmp_path):
+    net = _small_net()
+    mod = _init_mod(net)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0001.params", "rb") as f:
+        blob = f.read()
+
+    pred = mx.predict.Predictor(sym_json, blob, {"data": (5, 6)},
+                                ctx=mx.cpu())
+    x = np.random.RandomState(0).uniform(-1, 1, (5, 6)).astype(np.float32)
+    out = pred.forward(data=x).get_output(0)
+
+    batch = mx.io.DataBatch([mx.nd.array(x)], [])
+    mod.forward(batch, is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape_and_partial_out(tmp_path):
+    net = _small_net()
+    mod = _init_mod(net)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        blob = f.read()
+
+    # MXPredCreatePartialOut analog: fetch an internal layer
+    pred = mx.predict.Predictor(sym_json, blob, {"data": (3, 6)},
+                                ctx=mx.cpu(), output_name="fc1_output")
+    x = np.ones((3, 6), np.float32)
+    out = pred.forward(data=x).get_output(0)
+    assert out.shape == (3, 8)
+
+    # MXPredReshape analog: new batch size, same weights
+    pred.reshape({"data": (7, 6)})
+    out2 = pred.forward(data=np.ones((7, 6), np.float32)).get_output(0)
+    assert out2.shape == (7, 8)
+    np.testing.assert_allclose(out2[0], out[0], rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_errors(tmp_path):
+    net = _small_net()
+    mod = _init_mod(net)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        blob = f.read()
+    pred = mx.predict.Predictor(sym_json, blob, {"data": (2, 6)},
+                                ctx=mx.cpu())
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("nope", np.zeros((2, 6), np.float32))
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("data", np.zeros((9, 9), np.float32))
+    with pytest.raises(mx.MXNetError):
+        mx.predict.Predictor(sym_json, blob, {"bogus": (2, 6)}, ctx=mx.cpu())

@@ -257,3 +257,53 @@ def test_multibox_target_negative_mining():
     assert (ct == 1.0).sum() == 1          # one positive
     assert (ct == 0.0).sum() == 2          # ratio 2 -> two mined negatives
     assert (ct == -1.0).sum() == a - 3     # rest ignored
+
+
+def test_multibox_prior_square_size_anchors():
+    """ADVICE regression: size anchors are square (s, s) regardless of
+    ratios[0] (multibox_prior.cc uses w=h=size/2 half-extents)."""
+    data = mx.nd.zeros((1, 3, 4, 4))
+    a = mx.contrib.nd.MultiBoxPrior(data, sizes=[0.5],
+                                    ratios=[2, 1]).asnumpy()
+    # first anchor at cell (0,0): center 0.125, square side 0.5
+    assert_almost_equal(a[0, 0], np.array(
+        [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+        np.float32), rtol=1e-5, atol=1e-6)
+    # second anchor: size 0.5 stretched by sqrt(ratio=1) -> also square
+    assert_almost_equal(a[0, 1], a[0, 0], rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_detection_compacted_sorted():
+    """ADVICE regression: valid detections are compacted to the front,
+    sorted by confidence descending (multibox_detection.cc layout)."""
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2], [0.7, 0.7, 0.9, 0.9],
+                         [0.4, 0.4, 0.6, 0.6]]], np.float32)
+    # disjoint boxes, no NMS interaction; scores 0.6, 0.9, background
+    cls_prob = np.array([[[0.4, 0.1, 0.9], [0.6, 0.9, 0.1]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.contrib.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold=0.5, threshold=0.2)
+    o = out.asnumpy()[0]
+    assert abs(o[0, 1] - 0.9) < 1e-5 and o[0, 0] == 0.0
+    assert abs(o[1, 1] - 0.6) < 1e-5 and o[1, 0] == 0.0
+    assert o[2, 0] == -1.0                 # suppressed row last
+    assert_almost_equal(o[0, 2:], np.array([0.7, 0.7, 0.9, 0.9]),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_target_shared_best_anchor():
+    """ADVICE regression: two gts whose best anchor coincides must both be
+    force-matched to DISTINCT anchors (iterative bipartite matching)."""
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.05, 0.05, 0.55, 0.55]]], np.float32)
+    # both gts best-match anchor 0 (IoU 1.0 and ~0.86)
+    label = np.array([[[0.0, 0.0, 0.0, 0.5, 0.5],
+                       [1.0, 0.02, 0.02, 0.52, 0.52]]], np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    _lt, _lm, cls_t = mx.contrib.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold=0.95)
+    ct = cls_t.asnumpy()[0]
+    # anchor 0 -> gt0 (class 0 -> target 1), anchor 1 -> gt1 (class 1 -> 2)
+    assert ct[0] == 1.0 and ct[1] == 2.0
