@@ -12,6 +12,14 @@ Usage mirrors the reference's ``import mxnet as mx``::
 __version__ = "0.1.0"
 
 from .base import MXNetError
+
+# Join the process group BEFORE anything can touch a JAX backend: under
+# tools/launch.py the MXTPU_* envs are set, and jax.distributed.initialize
+# must precede backend creation (it also pins the worker platform).  This is
+# the analog of the reference consulting DMLC_ROLE at import
+# (python/mxnet/kvstore_server.py:58-68); a no-op when unlaunched.
+from . import distributed
+distributed.initialize()
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import ops
 from . import operator

@@ -58,14 +58,21 @@ class Context(object):
     # -- JAX mapping ------------------------------------------------------
     @property
     def jax_device(self):
-        """The jax.Device this context denotes."""
+        """The jax.Device this context denotes.
+
+        Always a device addressable by THIS process: under the multi-process
+        runtime (distributed.py) ``jax.devices()`` also lists peers' devices,
+        but a worker's ``tpu(i)`` means its own i-th chip, exactly as a
+        reference worker's ``gpu(i)`` is its local GPU i.
+        """
         import jax
         if self.device_typeid in (1, 3):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = (jax.local_devices(backend="cpu") if _has_platform("cpu")
+                    else jax.local_devices())
         else:
             # gpu is an accelerator alias: use the default backend's devices
             # (TPU under axon; host-platform CPU devices in tests).
-            devs = jax.devices()
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "%s: device_id %d out of range (%d %s devices visible)"
@@ -115,7 +122,7 @@ def tpu(device_id=0):
 
 def num_gpus():
     import jax
-    return 0 if jax.default_backend() == "cpu" else len(jax.devices())
+    return 0 if jax.default_backend() == "cpu" else len(jax.local_devices())
 
 
 def num_tpus():

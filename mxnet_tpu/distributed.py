@@ -1,0 +1,170 @@
+"""Multi-process distributed runtime (the ps-lite/tracker replacement).
+
+The reference builds clusters from three process roles — scheduler, server,
+worker — wired over ZMQ with ``DMLC_*`` envs (``tools/launch.py:46-70``,
+``python/mxnet/kvstore_server.py:58-68``, ``ps-lite``).  The TPU-native
+design needs exactly one role: N symmetric JAX processes joined into one
+global device topology by ``jax.distributed.initialize``; reductions then
+ride XLA collectives over ICI/DCN instead of RPC to server shards
+(SURVEY §2.3).
+
+This module owns process-group bring-up and the low-level collective
+primitives used by :class:`mxnet_tpu.kvstore_dist.KVStoreTPU`:
+
+- :func:`initialize` — join the process group.  Reads the ``MXTPU_*`` envs
+  planted by ``tools/launch.py`` (the launcher analog), so worker scripts
+  run unmodified single- or multi-process, exactly as reference scripts
+  only consult ``DMLC_ROLE``/``DMLC_PS_ROOT_URI`` when present.
+- :class:`Collective` — a one-axis global mesh over one designated device
+  per process, with jitted AllReduce/Broadcast lowered by GSPMD to real
+  XLA collectives (``kvstore_dist.h:190-240``'s wire-level reduction,
+  minus the wire).
+
+On CPU (tests / the virtual-cluster path) the collectives ride Gloo; on
+TPU pods they ride ICI/DCN.  Either way the graph is the same jitted HLO.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["initialize", "is_initialized", "rank", "num_workers",
+           "Collective", "barrier"]
+
+_INITIALIZED = False
+
+ENV_COORDINATOR = "MXTPU_COORDINATOR"
+ENV_NUM_WORKERS = "MXTPU_NUM_WORKERS"
+ENV_RANK = "MXTPU_WORKER_RANK"
+ENV_PLATFORM = "MXTPU_PLATFORM"
+
+
+def is_initialized():
+    return _INITIALIZED
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               platform=None):
+    """Join (or create) the process group.
+
+    Arguments default to the ``MXTPU_*`` envs set by ``tools/launch.py``.
+    Single-process (no env, no args) is a no-op so every code path works
+    unlaunched.  Must run before the first JAX backend touch — like the
+    reference, where ``DMLC_*`` envs must be set before ``kv.create``
+    spawns the ps-lite van (``kvstore_server.py:58-68``).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    if num_processes is None:
+        num_processes = int(os.environ.get(ENV_NUM_WORKERS, "0") or 0)
+    if process_id is None:
+        process_id = int(os.environ.get(ENV_RANK, "-1") or -1)
+    platform = platform or os.environ.get(ENV_PLATFORM)
+    if not coordinator_address or num_processes <= 1:
+        return  # single-process; nothing to join
+    if process_id < 0:
+        raise MXNetError(
+            "distributed.initialize: %s is set but %s is not — launch with "
+            "tools/launch.py or pass process_id" % (ENV_COORDINATOR, ENV_RANK))
+    import jax
+    from jax._src import xla_bridge
+    if xla_bridge.backends_are_initialized():
+        raise MXNetError(
+            "distributed.initialize must run before the first JAX backend "
+            "touch (importing mxnet_tpu under tools/launch.py does it "
+            "automatically; if you initialize manually, do it before "
+            "creating any NDArray)")
+    if platform:
+        # The TPU plugin platform wins over the JAX_PLATFORMS env var, so
+        # the override must go through jax.config (see tests/conftest.py).
+        jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # Cross-process XLA collectives on the CPU backend need an explicit
+        # collectives implementation; TPU has ICI natively.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
+def rank():
+    import jax
+    return jax.process_index()
+
+
+def num_workers():
+    import jax
+    return jax.process_count()
+
+
+def barrier(tag="mxtpu_barrier"):
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+class Collective:
+    """Jitted cross-process collectives over a 1-axis global device mesh.
+
+    One designated device per process forms a ``("worker",)`` mesh; a value
+    contributed by each process becomes one shard of a global
+    ``(num_workers, *shape)`` array, and a jitted reduction with replicated
+    ``out_shardings`` makes GSPMD emit a device-side AllReduce.  This is
+    the reference's push-side tree reduction (``comm.h:120-179``) and
+    server aggregation (``kvstore_dist_server.h``) collapsed into one XLA
+    collective — no host staging, no O(num_workers) host memory.
+    """
+
+    def __init__(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        self._jax = jax
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        self._devices = [per_proc[i] for i in sorted(per_proc)]
+        self.num_workers = len(self._devices)
+        self.rank = jax.process_index()
+        self._local = per_proc[self.rank]
+        self._mesh = Mesh(np.asarray(self._devices), ("worker",))
+        self._in_sharding = NamedSharding(self._mesh, PartitionSpec("worker"))
+        self._rep_sharding = NamedSharding(self._mesh, PartitionSpec())
+        self._sum = jax.jit(lambda x: x.sum(axis=0),
+                            out_shardings=self._rep_sharding)
+
+    def _global(self, x):
+        """Lay out this process's contribution as one mesh shard."""
+        jnp = self._jax.numpy
+        local = self._jax.device_put(jnp.asarray(x), self._local)
+        local = local.reshape((1,) + local.shape)
+        return self._jax.make_array_from_single_device_arrays(
+            (self.num_workers,) + tuple(x.shape), self._in_sharding, [local])
+
+    def _local_view(self, out):
+        """The replicated result's addressable copy on this process."""
+        return out.addressable_shards[0].data
+
+    def allreduce_sum(self, x):
+        """Sum a same-shaped array across all worker processes."""
+        if self.num_workers == 1:
+            return x
+        return self._local_view(self._sum(self._global(x)))
+
+    def broadcast(self, x, root=0):
+        """Every process receives root's value (shape/dtype must agree).
+
+        Lowered as mask-and-AllReduce: exact, since ``x*1 + 0*y == x``.
+        The analog of init-time weight broadcast from worker 0's push
+        (``kvstore_dist.h`` Init + pull).
+        """
+        if self.num_workers == 1:
+            return x
+        contrib = x if self.rank == root else np.zeros_like(x)
+        return self._local_view(self._sum(self._global(contrib)))

@@ -9,21 +9,23 @@ locally on the reduced gradient — numerically identical to the reference's
 ``dist_sync`` protocol (sync servers aggregate all NumWorkers pushes, apply
 the updater once, broadcast).
 
-Process model: one JAX process per host (``jax.distributed.initialize``),
+Process model: one JAX process per host (``distributed.initialize``),
 every process sees its local chips; collectives ride ICI within a host /
 DCN across hosts.  Clusters are launched with ``tools/launch.py`` (the
 reference launcher's analog: it spawns N worker processes with
-coordinator/rank envs the way tools/launch.py:46-70 forks
-scheduler/server/worker roles with DMLC_* envs).  ``dist_async`` has no
-ICI analog and raises (documented decision, SURVEY §7 hard parts).
+coordinator/rank envs the way the reference's launcher forks
+scheduler/server/worker roles with DMLC_* envs, tools/launch.py:46-70).
+Closed-form multi-worker semantics are asserted by
+``tests/dist/dist_sync_kvstore.py`` (port of the reference's
+tests/nightly/dist_sync_kvstore.py).  ``dist_async`` has no ICI analog and
+raises (documented decision, SURVEY §5.8).
 """
 from __future__ import annotations
-
-import numpy as np
 
 from .base import MXNetError
 from .kvstore import KVStore
 from .ndarray import NDArray
+from . import distributed
 
 __all__ = ["KVStoreTPU"]
 
@@ -37,8 +39,16 @@ class KVStoreTPU(KVStore):
                 "dist_async has no ICI analog on TPU (no parameter server); "
                 "use 'tpu' / 'dist_sync'. (SURVEY §5.8 design decision)")
         super().__init__(kind)
+        distributed.initialize()  # no-op unless launched via tools/launch.py
         import jax
         self._jax = jax
+        self._coll = None  # built lazily, after the backend is up
+
+    @property
+    def _collective(self):
+        if self._coll is None:
+            self._coll = distributed.Collective()
+        return self._coll
 
     @property
     def rank(self):
@@ -49,12 +59,28 @@ class KVStoreTPU(KVStore):
         return self._jax.process_count()
 
     def _allreduce(self, arr):
-        """Sum an array across worker processes (ICI/DCN AllReduce)."""
+        """Sum an NDArray across worker processes (device-side AllReduce)."""
         if self.num_workers == 1:
             return arr
-        from jax.experimental import multihost_utils
-        summed = multihost_utils.process_allgather(arr._data)
-        return NDArray._from_jax(summed.sum(axis=0), arr._ctx)
+        summed = self._collective.allreduce_sum(arr._data)
+        return NDArray._from_jax(summed, arr._ctx)
+
+    def init(self, key, value):
+        """Init + broadcast rank 0's value so all workers start identical
+        (the reference's init-push lands on servers once and every worker
+        pulls the same bytes, kvstore_dist.h Init)."""
+        super().init(key, value)
+        if self.num_workers > 1:
+            from .kvstore import _key_value
+            from .ndarray import _to_device
+            keys, _ = _key_value(key, value)
+            for k in keys:
+                stored = self._store[k]
+                # keep the stored array committed to the store's context
+                # device (the collective's result lives on its designated
+                # per-process device, which may differ on multi-chip hosts)
+                stored._data = _to_device(
+                    self._collective.broadcast(stored._data), stored._ctx)
 
     def push(self, key, value, priority=0):
         from .kvstore import _key_value, _updater_key
@@ -73,8 +99,6 @@ class KVStoreTPU(KVStore):
                 self._store[k]._data = merged._data
 
     def barrier(self):
-        if self.num_workers > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        distributed.barrier("kvstore_barrier")
 
     _barrier = barrier

@@ -570,7 +570,10 @@ def _load_stream(fi, ctx=None):
 def load(fname, ctx=None):
     """Load a reference-format ``.params`` file → dict or list of NDArray."""
     with open(fname, "rb") as fi:
-        names, arrays = _load_stream(fi, ctx)
+        try:
+            names, arrays = _load_stream(fi, ctx)
+        except MXNetError as e:
+            raise MXNetError("%s: %s" % (e, fname))
     if names:
         return dict(zip(names, arrays))
     return arrays

@@ -75,15 +75,22 @@ class Predictor(object):
         self._arg_params, self._aux_params = _strip_prefix(params)
         self._inputs = {}
         self._exec = None
+        self._exec_cache = {}  # shape signature -> bound Executor
         self.reshape(dict(input_shapes))
 
     def reshape(self, input_shapes):
         """Rebind for new input shapes (MXPredReshape, c_predict_api.h:107).
-        Weights are reused; a repeated shape signature hits the jit cache.
-        Staged inputs are cleared — like MXPredReshape, inputs must be
-        re-set afterwards."""
+        Weights are reused; executors are cached per shape signature so a
+        repeated signature reuses its compiled XLA program instead of
+        recompiling.  Staged inputs are cleared — like MXPredReshape,
+        inputs must be re-set afterwards."""
         self._inputs = {}
         self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        signature = tuple(sorted(self._input_shapes.items()))
+        cached = self._exec_cache.get(signature)
+        if cached is not None:
+            self._exec = cached
+            return self
         arg_names = self._sym.list_arguments()
         unknown = [n for n in self._input_shapes if n not in arg_names]
         if unknown:
@@ -114,6 +121,7 @@ class Predictor(object):
                 auxs[name] = nd.zeros(shape, ctx=self._ctx)
         self._exec = self._sym.bind(self._ctx, args, args_grad=None,
                                     grad_req="null", aux_states=auxs)
+        self._exec_cache[signature] = self._exec
         return self
 
     def set_input(self, name, data):

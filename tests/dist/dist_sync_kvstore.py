@@ -1,0 +1,89 @@
+"""Closed-form dist-sync kvstore worker (run under tools/launch.py).
+
+Port of the reference's nightly cluster test
+(tests/nightly/dist_sync_kvstore.py:30-45): every worker pushes
+rank-dependent values ``nrepeat`` times; the synced store must equal the
+closed form ``(n+1)*n/2 * rate * nrepeat + 1`` on every worker — including
+a big-array key (the reference's BIGARRAY_BOUND sharded path), list keys,
+string keys, and multi-device-copy pushes.
+
+Launch:  python tools/launch.py -n 2 --platform cpu \
+             python tests/dist/dist_sync_kvstore.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np
+
+from mxnet_tpu import distributed
+
+distributed.initialize()  # reads MXTPU_* envs planted by the launcher
+
+import mxnet_tpu as mx  # noqa: E402  (backend config must precede first use)
+
+keys = [3, 5, 7]
+rate = 2
+shape = (2, 2)
+big_shape = (1200, 1200)  # larger than the reference's BIGARRAY_BOUND
+
+
+def check_diff_to_scalar(arr, x):
+    np.testing.assert_array_equal(arr.asnumpy(), np.full(arr.shape, x, "f"))
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    kv.init(keys, [mx.nd.ones(shape)] * len(keys))
+    kv.init(99, mx.nd.ones(big_shape))
+    kv.init("str_key", mx.nd.ones(shape))
+    def updater(key, g, w):
+        w += rate * g  # the reference's 'test' optimizer: w += rate * grad
+
+    kv._set_updater(updater)
+
+    my_rank = kv.rank
+    nworker = kv.num_workers
+    assert nworker == int(os.environ["MXTPU_NUM_WORKERS"]), nworker
+
+    nrepeat = 3
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * (my_rank + 1))
+        kv.push(99, mx.nd.ones(big_shape) * (my_rank + 1))
+        kv.push("str_key", mx.nd.ones(shape) * (my_rank + 1))
+        # multi-device-copy push: two local copies summed before the
+        # cross-worker reduce (comm.h local aggregation + wire reduce)
+        kv.push(5, [mx.nd.ones(shape) * (my_rank + 1) * 0.5] * 2)
+
+    num = (nworker + 1) * nworker * rate / 2 * nrepeat + 1
+
+    val = mx.nd.zeros(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, num)
+
+    val2 = mx.nd.zeros(big_shape)
+    kv.pull(99, out=val2)
+    check_diff_to_scalar(val2, num)
+
+    val3 = mx.nd.zeros(shape)
+    kv.pull("str_key", out=val3)
+    check_diff_to_scalar(val3, num)
+
+    val4 = mx.nd.zeros(shape)
+    kv.pull(5, out=val4)
+    check_diff_to_scalar(val4, num)
+
+    # init broadcast: rank-dependent init values must converge to rank 0's
+    kv.init(11, mx.nd.ones(shape) * (my_rank + 41))
+    val5 = mx.nd.zeros(shape)
+    kv.pull(11, out=val5)
+    check_diff_to_scalar(val5, 41)
+
+    kv.barrier()
+    print("dist_sync_kvstore rank %d/%d: OK" % (my_rank, nworker))
+
+
+if __name__ == "__main__":
+    main()
