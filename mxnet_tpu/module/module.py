@@ -68,6 +68,15 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
 
+        # fused SPMD path (kvstore='tpu'): the whole per-batch pipeline —
+        # forward, backward, gradient AllReduce, optimizer — runs as ONE
+        # jit-compiled sharded XLA program instead of the executor fan-out +
+        # kvstore push/pull protocol (SURVEY §2.3 TPU mapping note)
+        self._fused = None
+        self._fused_batch = None
+        self._fused_outputs = None
+        self._monitor_installed = False
+
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         """Create Module from checkpoint (reference module.py:97)."""
@@ -176,6 +185,8 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        if self._fused is not None:
+            self._fused.set_params(self._arg_params, self._aux_params)
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -188,6 +199,8 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         self._exec_group.set_params(arg_params, aux_params)
+        if self._fused is not None:
+            self._fused.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
 
@@ -240,6 +253,10 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused = None
+        self._fused_batch = None
+        self._fused_outputs = None
+        self._monitor_installed = False
 
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -255,7 +272,11 @@ class Module(BaseModule):
             kvstore, len(self._context), self._arg_params)
 
         batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+        # sync-replicated stores ('dist_sync*' and the collective 'tpu'
+        # store) sum gradients across workers, so rescale by the global
+        # batch (reference module.py:461-462)
+        if kvstore and ("tpu" in kvstore.type or
+                        ("dist" in kvstore.type and "_sync" in kvstore.type)):
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 
@@ -282,22 +303,106 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
-        if kvstore:
-            _initialize_kvstore(kvstore=kvstore,
-                                param_arrays=self._exec_group.param_arrays,
-                                arg_params=self._arg_params,
-                                param_names=self._param_names,
-                                update_on_kvstore=update_on_kvstore)
-        if update_on_kvstore:
-            kvstore.set_optimizer(self._optimizer)
+        self._fused = self._maybe_init_fused(kvstore, optimizer)
+        if self._fused is not None:
+            self.logger.info(
+                "kvstore '%s': using the fused SPMD train step "
+                "(fwd+bwd+allreduce+update in one XLA program)",
+                kvstore.type)
         else:
-            self._updater = opt.get_updater(optimizer)
+            if kvstore:
+                _initialize_kvstore(
+                    kvstore=kvstore,
+                    param_arrays=self._exec_group.param_arrays,
+                    arg_params=self._arg_params,
+                    param_names=self._param_names,
+                    update_on_kvstore=update_on_kvstore)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            else:
+                self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
 
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+
+    def _maybe_init_fused(self, kvstore, optimizer):
+        """Build the fused SPMDTrainer for a 'tpu'/'dist' kvstore, or None
+        when the configuration needs the generic executor path."""
+        if kvstore is None or not ("tpu" in kvstore.type
+                                   or "dist" in kvstore.type):
+            return None
+        if not self.for_training:
+            return None
+        reasons = []
+        if self._state_names:
+            reasons.append("state_names")
+        if self.inputs_need_grad:
+            reasons.append("inputs_need_grad")
+        if self._fixed_param_names:
+            reasons.append("fixed_param_names")
+        if self._monitor_installed:
+            reasons.append("an installed Monitor (needs per-op taps)")
+        if any(self._exec_group.grad_req.get(n) not in (None, "null", "write")
+               for n in self._param_names):
+            reasons.append("grad_req != 'write'")
+        from ..parallel.trainer import SUPPORTED_OPTIMIZERS
+        kind = type(optimizer).__name__.lower()
+        if kind not in SUPPORTED_OPTIMIZERS:
+            reasons.append("optimizer %r (no in-graph rule)" % kind)
+        if reasons:
+            self.logger.info(
+                "kvstore '%s': falling back to the kvstore push/pull path "
+                "(fused step unavailable with %s)", kvstore.type,
+                ", ".join(reasons))
+            return None
+
+        import jax
+        import numpy as _np
+        from ..parallel import SPMDTrainer
+        from jax.sharding import Mesh
+
+        num_workers = kvstore.num_workers
+        if num_workers > 1:
+            devs = sorted(jax.devices(),
+                          key=lambda d: (d.process_index, d.id))
+            local_batch = self._exec_group.batch_size
+            if (local_batch * num_workers) % len(devs) != 0:
+                self.logger.info(
+                    "kvstore '%s': global batch %d not divisible by %d "
+                    "devices; falling back to kvstore push/pull",
+                    kvstore.type, local_batch * num_workers, len(devs))
+                return None
+            mesh = Mesh(_np.asarray(devs), ("dp",))
+        elif len(self._context) > 1:
+            # single-process multi-device stays on the executor-group path
+            # (it already data-parallelizes across the contexts)
+            return None
+        else:
+            mesh = None
+
+        if self._params_dirty:
+            # re-initializing mid-training (force_init): seed the new
+            # trainer from the CURRENT weights, not the stale host copy
+            self._sync_params_from_devices()
+        trainer = SPMDTrainer(self._symbol, optimizer, mesh=mesh)
+        trainer.bind(self._data_shapes, self._label_shapes)
+        trainer.init_params(None, self._arg_params, self._aux_params)
+        return trainer
+
+    def _fused_feed(self, data_batch):
+        """Assemble the trainer's input list (data then labels) from a
+        DataBatch, synthesizing zero labels when absent (predict path —
+        labels only matter for the backward)."""
+        arrays = list(data_batch.data)
+        labels = list(data_batch.label or [])
+        if len(labels) < len(self._fused.label_names):
+            labels = labels + [
+                nd_zeros(self._fused.arg_shapes[name])
+                for name in self._fused.label_names[len(labels):]]
+        return arrays + labels
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
@@ -310,10 +415,28 @@ class Module(BaseModule):
     # -- execution ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            if is_train is None:
+                is_train = self.for_training
+            if is_train:
+                # the train step is deferred to update() so the reference's
+                # forward → backward → update contract (metric sees outputs
+                # of pre-update weights) holds with one fused program
+                self._fused_batch = self._fused_feed(data_batch)
+                self._fused_outputs = None
+            else:
+                outs = self._fused.eval_step(*self._fused_feed(data_batch))
+                self._fused_outputs = [NDArray._from_jax(o) for o in outs]
+            return
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            assert out_grads is None, \
+                "custom head gradients need the executor path (use a " \
+                "non-tpu kvstore)"
+            return
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
@@ -321,6 +444,13 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused is not None:
+            assert self._fused_batch is not None, \
+                "update() without a prior forward(is_train=True)"
+            outs = self._fused.step(*self._fused_batch)
+            self._fused_outputs = [NDArray._from_jax(o) for o in outs]
+            self._fused_batch = None
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -334,6 +464,13 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused is not None:
+            if self._fused_outputs is None and self._fused_batch is not None:
+                # outputs requested between forward_backward() and update()
+                # (e.g. a custom loop): compute a forward-only pass
+                outs = self._fused.eval_step(*self._fused_batch)
+                self._fused_outputs = [NDArray._from_jax(o) for o in outs]
+            return list(self._fused_outputs or [])
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -342,15 +479,24 @@ class Module(BaseModule):
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused is not None:
+            eval_metric.update(list(labels or []), self.get_outputs())
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
+        if self._fused is not None:
+            self._arg_params, self._aux_params = self._fused.get_params()
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            with open(fname, "wb") as fout:
+                fout.write(self._fused.get_states())
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
             with open(fname, "wb") as fout:
@@ -358,7 +504,10 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._fused is not None:
+            with open(fname, "rb") as f:
+                self._fused.set_states(f.read())
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as f:
@@ -366,4 +515,9 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        if self._fused is not None:
+            raise MXNetError(
+                "Monitor taps need per-op execution; install the monitor "
+                "before init_optimizer or use a non-tpu kvstore")
+        self._monitor_installed = True
         self._exec_group.install_monitor(mon)

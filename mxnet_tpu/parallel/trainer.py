@@ -30,7 +30,20 @@ from ..executor import _build_eval
 from ..ndarray import NDArray
 from ..io import DataDesc
 
-__all__ = ["SPMDTrainer"]
+__all__ = ["SPMDTrainer", "SUPPORTED_OPTIMIZERS"]
+
+# optimizers with an in-graph update rule (_apply_update); Module's fused
+# path consults this before engaging
+SUPPORTED_OPTIMIZERS = ("sgd", "ccsgd", "adam", "rmsprop")
+
+
+def _slice_shape(idx, shape):
+    """Shape of shape[idx] for a tuple of slices (no allocation)."""
+    out = []
+    for sl, n in zip(idx, shape):
+        start, stop, step = sl.indices(n)
+        out.append(max(0, -(-(stop - start) // step)))
+    return tuple(out)
 
 
 def _spec_for(name, shape, rules):
@@ -56,13 +69,20 @@ class SPMDTrainer(object):
         import jax
         self.symbol = symbol
         self.mesh = mesh
+        # a mesh spanning several processes (multi-host cluster joined via
+        # distributed.initialize) switches placement to the global-array
+        # path: each process contributes its local batch shard and holds a
+        # replica of every parameter
+        self._multiproc = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
         self.data_axis = data_axis
         self.param_shardings = param_shardings or {}
         self.compute_dtype = compute_dtype and np.dtype(compute_dtype)
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         kind = type(optimizer).__name__.lower()
-        if kind not in ("sgd", "ccsgd", "adam", "rmsprop"):
+        if kind not in SUPPORTED_OPTIMIZERS:
             raise MXNetError(
                 "SPMDTrainer: in-graph rule for optimizer %r not implemented "
                 "(sgd/adam/rmsprop supported); use mx.mod.Module for other "
@@ -72,6 +92,7 @@ class SPMDTrainer(object):
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
 
+        self._rep_fn = None       # cached jitted reshard-to-replicated
         self.params = None        # dict name -> jax array (sharded)
         self.aux = None
         self.opt_state = None
@@ -135,14 +156,33 @@ class SPMDTrainer(object):
             return None
         return NamedSharding(self.mesh, spec)
 
+    def _place(self, host, spec):
+        """Put one host array onto the mesh with the given spec (handles
+        the no-mesh, single-process-mesh, and multi-process-mesh cases)."""
+        if self.mesh is None:
+            return jnp.asarray(host)
+        if self._multiproc:
+            host = np.asarray(host)
+            return jax.make_array_from_callback(
+                host.shape, self._sharding(spec),
+                lambda idx, _v=host: _v[idx])
+        return jax.device_put(host, self._sharding(spec))
+
     def _place_params(self, params, aux=False):
         if self.mesh is None:
             return dict(params)
-        out = {}
-        for name, v in params.items():
-            spec = _spec_for(name, v.shape, self.param_shardings)
-            out[name] = jax.device_put(v, self._sharding(spec))
-        return out
+        if self._multiproc:
+            # rank 0's values win (the reference's init-push semantics:
+            # servers keep the first worker's init, kvstore_dist.h Init);
+            # each process then materializes its addressable pieces
+            from jax.experimental import multihost_utils
+            names = sorted(params)
+            vals = multihost_utils.broadcast_one_to_all(
+                tuple(np.asarray(params[n]) for n in names))
+            params = dict(zip(names, vals))
+        return {name: self._place(v, _spec_for(name, np.shape(v),
+                                               self.param_shardings))
+                for name, v in params.items()}
 
     def _init_opt_state(self):
         """In-graph optimizer state, sharded like its parameter."""
@@ -150,7 +190,17 @@ class SPMDTrainer(object):
         kind = type(self.optimizer).__name__.lower()
         for name in self.param_names:
             p = self.params[name]
-            z = lambda: jnp.zeros_like(p)
+            spec = _spec_for(name, p.shape, self.param_shardings)
+            if self._multiproc:
+                z = lambda: jax.make_array_from_callback(
+                    p.shape, self._sharding(spec),
+                    lambda idx, _s=p.shape, _d=p.dtype:
+                        np.zeros(_slice_shape(idx, _s), _d))
+            elif self.mesh is not None:
+                z = lambda: jax.device_put(jnp.zeros(p.shape, p.dtype),
+                                           self._sharding(spec))
+            else:
+                z = lambda: jnp.zeros_like(p)
             if kind in ("sgd", "ccsgd") and \
                     getattr(self.optimizer, "momentum", 0.0):
                 s = (z(),)
@@ -160,9 +210,6 @@ class SPMDTrainer(object):
                 s = (z(),)
             else:
                 s = ()
-            if self.mesh is not None:
-                spec = _spec_for(name, p.shape, self.param_shardings)
-                s = tuple(jax.device_put(x, self._sharding(spec)) for x in s)
             state[name] = s
         return state
 
@@ -258,11 +305,32 @@ class SPMDTrainer(object):
             if self.compute_dtype is not None and \
                     jnp.issubdtype(raw.dtype, jnp.floating):
                 raw = raw.astype(self.compute_dtype)
-            if self.mesh is not None:
-                raw = jax.device_put(raw, self._sharding(
-                    P(self.data_axis, *([None] * (raw.ndim - 1)))))
+            spec = P(self.data_axis, *([None] * (raw.ndim - 1)))
+            if self._multiproc:
+                # this process's batch is one shard of the global batch
+                # (the reference's per-worker minibatch, batch *= num_workers
+                # scaling at the optimizer, module.py:461)
+                from jax.experimental import multihost_utils
+                raw = multihost_utils.host_local_array_to_global_array(
+                    np.asarray(raw), self.mesh, spec)
+            elif self.mesh is not None:
+                raw = jax.device_put(raw, self._sharding(spec))
             out[name] = raw
         return out
+
+    def _localize(self, outs):
+        """In multi-process mode, return each output's process-local batch
+        shard as a host array (workers see their own slice, exactly like the
+        reference's per-worker executor outputs)."""
+        if not self._multiproc:
+            return outs
+        from jax.experimental import multihost_utils
+        local = []
+        for o in outs:
+            spec = P(self.data_axis, *([None] * (o.ndim - 1)))
+            local.append(multihost_utils.global_array_to_host_local_array(
+                o, self.mesh, spec))
+        return local
 
     def step(self, *batch_arrays):
         """One fused train step: data+labels in input_names order."""
@@ -276,22 +344,75 @@ class SPMDTrainer(object):
             jnp.asarray(lr, jnp.float32), jnp.asarray(self.optimizer.wd,
                                                       jnp.float32),
             self._num_update)
+        outs = self._localize(outs)
         self._outputs = outs
         return outs
 
     def eval_step(self, *batch_arrays):
         from .. import random as _random
         data = self._shard_batch(batch_arrays)
-        return self._eval_fn(self.params, self.aux, data, _random.next_key())
+        return self._localize(
+            self._eval_fn(self.params, self.aux, data, _random.next_key()))
 
     @property
     def outputs(self):
         return [NDArray._from_jax(o) for o in (self._outputs or [])]
 
+    def _gather(self, v):
+        if self._multiproc:
+            # reshard to replicated (GSPMD AllGather) then read the local
+            # copy; the jitted reshard is cached per instance
+            if self._rep_fn is None:
+                self._rep_fn = jax.jit(lambda x: x,
+                                       out_shardings=self._sharding(P()))
+            return np.asarray(self._rep_fn(v).addressable_shards[0].data)
+        return jax.device_get(v)
+
     def get_params(self):
         """Gather params/aux to host NDArrays (for checkpointing)."""
-        arg_params = {k: NDArray._from_jax(jax.device_get(v))
+        arg_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
                       for k, v in self.params.items()}
-        aux_params = {k: NDArray._from_jax(jax.device_get(v))
+        aux_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
                       for k, v in self.aux.items()}
         return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params):
+        """Replace parameter values, keeping optimizer state (the
+        Module.set_params contract).  Names missing from the given dicts
+        keep their current values."""
+        def _host(v):
+            return v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+
+        def _merged(current, names, given):
+            out = {}
+            for n in names:
+                if given and n in given:
+                    out[n] = _host(given[n])
+                else:
+                    out[n] = self._gather(current[n])
+            return out
+
+        self.params = self._place_params(
+            _merged(self.params, self.param_names, arg_params))
+        self.aux = self._place_params(
+            _merged(self.aux, self.aux_names, aux_params), aux=True)
+
+    def get_states(self):
+        """Serialized optimizer state (the Updater.get_states analog —
+        reference kvstore.save_optimizer_states / Updater serialization)."""
+        import pickle
+        host = {k: tuple(np.asarray(self._gather(x)) for x in s)
+                for k, s in self.opt_state.items()}
+        return pickle.dumps({"num_update": self._num_update,
+                             "states": host})
+
+    def set_states(self, blob):
+        import pickle
+        payload = pickle.loads(blob)
+        self._num_update = payload["num_update"]
+        placed = {}
+        for name, s in payload["states"].items():
+            spec = _spec_for(name, self.params[name].shape,
+                             self.param_shardings)
+            placed[name] = tuple(self._place(x, spec) for x in s)
+        self.opt_state = placed
