@@ -1,0 +1,11 @@
+"""Put the repo root on sys.path so ``import mxnet_tpu`` resolves to this
+checkout (the reference's find_mxnet.py does the same for its python/)."""
+import os
+import sys
+
+_REPO = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import mxnet_tpu  # noqa: E402,F401

@@ -1,0 +1,3 @@
+"""Network symbol modules, importable as ``symbols.<network>`` the way the
+reference's train scripts do (``import_module('symbols.'+args.network)``).
+Each module delegates to the mxnet_tpu model zoo (mxnet_tpu/models/)."""

@@ -1,0 +1,7 @@
+"""symbols.inception_bn — delegates to the model zoo (models/inception_bn.py).
+Also importable as 'inception-bn' via train scripts' name normalization."""
+from mxnet_tpu.models import inception_bn as _m
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    return _m.get_symbol(num_classes=num_classes)

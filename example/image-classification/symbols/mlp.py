@@ -1,0 +1,6 @@
+"""symbols.mlp — delegates to the mxnet_tpu model zoo (models/mlp.py)."""
+from mxnet_tpu.models import mlp as _m
+
+
+def get_symbol(num_classes=10, **kwargs):
+    return _m.get_symbol(num_classes=num_classes)

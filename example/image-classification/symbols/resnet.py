@@ -1,0 +1,10 @@
+"""symbols.resnet — delegates to the mxnet_tpu model zoo (models/resnet.py)."""
+from mxnet_tpu.models import resnet as _m
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
+               **kwargs):
+    if isinstance(image_shape, str):
+        image_shape = tuple(int(x) for x in image_shape.split(","))
+    return _m.get_symbol(num_classes=num_classes, num_layers=num_layers,
+                         image_shape=image_shape)

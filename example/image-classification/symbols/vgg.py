@@ -1,0 +1,6 @@
+"""symbols.vgg — delegates to the mxnet_tpu model zoo (models/vgg.py)."""
+from mxnet_tpu.models import vgg as _m
+
+
+def get_symbol(num_classes=1000, num_layers=16, **kwargs):
+    return _m.get_symbol(num_classes=num_classes, num_layers=num_layers)

@@ -1,0 +1,50 @@
+"""Train on ImageNet (capability port of the reference
+example/image-classification/train_imagenet.py).
+
+Feed with packed RecordIO via ``--data-train``/``--data-val`` (produced by
+tools/im2rec.py), or pass ``--benchmark 1`` for synthetic data — the mode
+used for throughput benchmarking on hosts without the dataset.
+
+Usage::
+
+    python train_imagenet.py --benchmark 1 --network resnet --num-layers 50
+    python train_imagenet.py --data-train train.rec --data-val val.rec
+    python tools/launch.py -n 2 --platform cpu \
+        python example/image-classification/train_imagenet.py \
+        --benchmark 1 --network inception-bn --kv-store tpu
+"""
+import argparse
+import logging
+
+from common import find_mxnet, data, fit  # noqa: F401
+
+logging.basicConfig(level=logging.DEBUG)
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    data.set_data_aug_level(parser, 2)
+    parser.set_defaults(
+        # network
+        network="resnet",
+        num_layers=50,
+        # data
+        num_classes=1000,
+        num_examples=1281167,
+        image_shape="3,224,224",
+        min_random_scale=1,
+        # train
+        num_epochs=80,
+        lr_step_epochs="30,60",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+    net = import_module("symbols." + args.network.replace("-", "_"))
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, data.get_rec_iter)

@@ -1,0 +1,120 @@
+"""Train on MNIST (capability port of the reference
+example/image-classification/train_mnist.py).
+
+Reads the standard MNIST ubyte files from ``--data-dir`` when present.
+This build environment has no network egress, so when the files are absent
+the script falls back to a deterministic synthetic digit set (class
+template + noise) with the same shapes — the training pipeline, symbol,
+optimizer, and metrics are identical either way.
+
+Usage::
+
+    python train_mnist.py                         # mlp, 20 epochs
+    python train_mnist.py --network lenet
+    python tools/launch.py -n 2 --platform cpu \
+        python example/image-classification/train_mnist.py --kv-store tpu
+"""
+import argparse
+import gzip
+import logging
+import os
+import struct
+
+import numpy as np
+
+from common import find_mxnet, fit  # noqa: F401
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.DEBUG)
+
+
+def read_data(label_path, image_path):
+    opener = gzip.open if label_path.endswith(".gz") else open
+    with opener(label_path, "rb") as flbl:
+        struct.unpack(">II", flbl.read(8))
+        label = np.frombuffer(flbl.read(), dtype=np.int8)
+    with opener(image_path, "rb") as fimg:
+        _, num, rows, cols = struct.unpack(">IIII", fimg.read(16))
+        image = np.frombuffer(fimg.read(), dtype=np.uint8) \
+            .reshape(len(label), rows, cols)
+    return (label, image)
+
+
+def synthetic_mnist(num, num_classes=10, seed=0):
+    """Deterministic learnable stand-in: one fixed 28x28 template per class
+    (shared by train and val) plus per-sample pixel noise.  Used only when
+    the real ubyte files are absent."""
+    templates = np.random.RandomState(42).rand(num_classes, 28, 28) > 0.6
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, num_classes, size=num).astype(np.int8)
+    images = (templates[labels] * 180).astype(np.float32)
+    images += rs.randn(num, 28, 28).astype(np.float32) * 40
+    return labels, np.clip(images, 0, 255).astype(np.uint8)
+
+
+def _find(data_dir, names):
+    for n in names:
+        for suffix in ("", ".gz"):
+            p = os.path.join(data_dir, n + suffix)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def to4d(img):
+    return img.reshape(img.shape[0], 1, 28, 28).astype(np.float32) / 255
+
+
+def get_mnist_iter(args, kv):
+    d = args.data_dir
+    ti = _find(d, ["train-images-idx3-ubyte"])
+    tl = _find(d, ["train-labels-idx1-ubyte"])
+    vi = _find(d, ["t10k-images-idx3-ubyte"])
+    vl = _find(d, ["t10k-labels-idx1-ubyte"])
+    if ti and tl and vi and vl:
+        (train_lbl, train_img) = read_data(tl, ti)
+        (val_lbl, val_img) = read_data(vl, vi)
+    else:
+        logging.warning("MNIST files not found under %r; using the "
+                        "deterministic synthetic digit set", d)
+        train_lbl, train_img = synthetic_mnist(args.num_examples, seed=0)
+        val_lbl, val_img = synthetic_mnist(10000, seed=1)
+    # rank sharding for dist training (reference shards via the record
+    # iterator's part_index; NDArrayIter data is sliced directly)
+    if kv.num_workers > 1:
+        train_img = train_img[kv.rank::kv.num_workers]
+        train_lbl = train_lbl[kv.rank::kv.num_workers]
+    train = mx.io.NDArrayIter(to4d(train_img), train_lbl.astype("f"),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(to4d(val_img), val_lbl.astype("f"),
+                            args.batch_size)
+    return (train, val)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10,
+                        help="the number of classes")
+    parser.add_argument("--num-examples", type=int, default=60000,
+                        help="the number of training examples")
+    parser.add_argument("--data-dir", type=str, default="data",
+                        help="directory holding the MNIST ubyte files")
+    fit.add_fit_args(parser)
+    parser.set_defaults(
+        network="mlp",
+        gpus=None,
+        batch_size=64,
+        disp_batches=100,
+        num_epochs=20,
+        lr=.05,
+        lr_step_epochs="10",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+    net = import_module("symbols." + args.network.replace("-", "_"))
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, get_mnist_iter)

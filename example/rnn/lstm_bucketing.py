@@ -1,0 +1,163 @@
+"""Train a bucketed LSTM language model on Penn Tree Bank (capability port
+of the reference example/rnn/lstm_bucketing.py).
+
+Reads ``data/ptb.train.txt`` / ``data/ptb.test.txt`` when present; this
+environment has no network egress, so when absent the script falls back to
+a deterministic synthetic corpus with Markov structure (so perplexity is
+learnable).  Pipeline is identical either way: encode_sentences →
+BucketSentenceIter → BucketingModule over per-bucket unrolled LSTM graphs.
+
+Usage::
+
+    python lstm_bucketing.py --num-epochs 4
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="Train RNN on Penn Tree Bank",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--num-layers", type=int, default=2,
+                    help="number of stacked RNN layers")
+parser.add_argument("--num-hidden", type=int, default=200,
+                    help="hidden layer size")
+parser.add_argument("--num-embed", type=int, default=200,
+                    help="embedding layer size")
+parser.add_argument("--gpus", type=str,
+                    help="accelerator indices (kept for script compat)")
+parser.add_argument("--kv-store", type=str, default="local",
+                    help="key-value store type")
+parser.add_argument("--num-epochs", type=int, default=25,
+                    help="max num of epochs")
+parser.add_argument("--lr", type=float, default=0.01,
+                    help="initial learning rate")
+parser.add_argument("--optimizer", type=str, default="sgd",
+                    help="the optimizer type")
+parser.add_argument("--mom", type=float, default=0.0,
+                    help="momentum for sgd")
+parser.add_argument("--wd", type=float, default=0.00001,
+                    help="weight decay for sgd")
+parser.add_argument("--batch-size", type=int, default=32,
+                    help="the batch size")
+parser.add_argument("--disp-batches", type=int, default=50,
+                    help="show progress for every n batches")
+parser.add_argument("--data-dir", type=str, default="./data",
+                    help="directory holding ptb.train.txt / ptb.test.txt")
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    lines = [list(filter(None, i.split(" "))) for i in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label)
+    return sentences, vocab
+
+
+def synthetic_corpus(n_sent, vocab_size=200, seed=0):
+    """Markov-chain sentences: each token strongly conditions the next, so
+    an LSTM LM can push perplexity well below the uniform baseline."""
+    rs = np.random.RandomState(seed)
+    # sparse transition structure: each token has 4 likely successors
+    succ = rs.randint(1, vocab_size, size=(vocab_size, 4))
+    sentences = []
+    for _ in range(n_sent):
+        length = rs.randint(5, 60)
+        tok = rs.randint(1, vocab_size)
+        sent = [tok]
+        for _ in range(length - 1):
+            if rs.rand() < 0.9:
+                tok = succ[tok][rs.randint(4)]
+            else:
+                tok = rs.randint(1, vocab_size)
+            sent.append(tok)
+        sentences.append(sent)
+    return sentences
+
+
+if __name__ == "__main__":
+    head = "%(asctime)-15s %(message)s"
+    logging.basicConfig(level=logging.INFO, format=head)
+
+    args = parser.parse_args()
+
+    buckets = [10, 20, 30, 40, 50, 60]
+    start_label = 1
+    invalid_label = 0
+
+    train_path = os.path.join(args.data_dir, "ptb.train.txt")
+    test_path = os.path.join(args.data_dir, "ptb.test.txt")
+    if os.path.exists(train_path) and os.path.exists(test_path):
+        train_sent, vocab = tokenize_text(train_path,
+                                          start_label=start_label,
+                                          invalid_label=invalid_label)
+        val_sent, _ = tokenize_text(test_path, vocab=vocab,
+                                    start_label=start_label,
+                                    invalid_label=invalid_label)
+        vocab_size = len(vocab) + start_label
+    else:
+        logging.warning("PTB files not found under %r; using the synthetic "
+                        "Markov corpus", args.data_dir)
+        vocab_size = 200
+        train_sent = synthetic_corpus(2000, vocab_size, seed=0)
+        val_sent = synthetic_corpus(200, vocab_size, seed=1)
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=invalid_label)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets,
+                                         invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=[mx.current_context()])
+
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr,
+                          "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))

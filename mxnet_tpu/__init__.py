@@ -46,6 +46,9 @@ from . import engine
 from . import recordio
 from . import image
 from . import io
+# reference parity: the C++ record iterator registers as mx.io.ImageRecordIter
+# (src/io/iter_image_recordio.cc:319); ours lives in image.py
+io.ImageRecordIter = image.ImageRecordIter
 from . import initializer
 from .initializer import init_registry
 from . import optimizer

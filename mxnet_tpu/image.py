@@ -118,7 +118,9 @@ def center_crop(src, size, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    src = src.astype(np.float32) - np.asarray(mean, dtype=np.float32)
+    src = src.astype(np.float32)
+    if mean is not None:
+        src = src - np.asarray(mean, dtype=np.float32)
     if std is not None:
         src /= np.asarray(std, dtype=np.float32)
     return src
@@ -273,7 +275,7 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
-    if mean is not None:
+    if mean is not None or std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
 
@@ -460,6 +462,52 @@ class ImageIter(mxio.DataIter):
         return data
 
 
+def _translate_cxx_aug_params(kwargs):
+    """Map the reference C++ iterator's parameter names
+    (src/io/image_aug_default.cc: mean_r/g/b, max_random_scale, ...) onto
+    CreateAugmenter's kwargs, so reference training scripts run unmodified.
+    Unsupported knobs are dropped with a log line rather than an error,
+    matching the spirit of the reference's "best effort" augmentation
+    defaults; exact-parity consumers should pass aug_list explicitly."""
+    kw = dict(kwargs)
+    out = {}
+    mean = [kw.pop("mean_r", 0.0), kw.pop("mean_g", 0.0),
+            kw.pop("mean_b", 0.0)]
+    if any(mean):
+        out["mean"] = np.asarray(mean, dtype=np.float32)
+    std = [kw.pop("std_r", 0.0), kw.pop("std_g", 0.0), kw.pop("std_b", 0.0)]
+    if any(std):
+        out["std"] = np.asarray(std, dtype=np.float32)
+    if "rand_crop" in kw:
+        out["rand_crop"] = bool(kw.pop("rand_crop"))
+    if "rand_mirror" in kw:
+        out["rand_mirror"] = bool(kw.pop("rand_mirror"))
+    if "resize" in kw:
+        out["resize"] = kw.pop("resize")
+    # random scale: the C++ pipeline rescales the source image before the
+    # crop; the closest Python-side analog is the random-sized crop
+    mx_scale = kw.pop("max_random_scale", 1.0)
+    mn_scale = kw.pop("min_random_scale", 1.0)
+    if (mx_scale != 1.0 or mn_scale != 1.0) and out.get("rand_crop"):
+        out["rand_resize"] = True
+    dropped = {}
+    for name in ("max_rotate_angle", "max_random_rotate_angle",
+                 "max_aspect_ratio", "max_random_aspect_ratio",
+                 "max_shear_ratio", "max_random_shear_ratio",
+                 "max_random_h", "max_random_s", "max_random_l", "pad",
+                 "fill_value", "inter_method", "max_img_size",
+                 "min_img_size", "mirror", "rand_gray", "scale", "max_crop_size",
+                 "min_crop_size", "random_h", "random_s", "random_l",
+                 "rotate", "verbose"):
+        if name in kw:
+            dropped[name] = kw.pop(name)
+    if dropped:
+        logging.info("ImageRecordIter: ignoring augmentation params with no "
+                     "Python-pipeline analog yet: %s", sorted(dropped))
+    out.update(kw)  # anything else goes through (and typos will raise)
+    return out
+
+
 class ImageRecordIter(mxio.DataIter):
     """Threaded RecordIO image iterator — the reference's C++
     ImageRecordIOParser2 pipeline (reference src/io/iter_image_recordio_2.cc:
@@ -476,6 +524,7 @@ class ImageRecordIter(mxio.DataIter):
                  data_name="data", label_name="softmax_label", dtype="float32",
                  **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
+        aug_kwargs = _translate_cxx_aug_params(aug_kwargs)
         from . import engine as eng
         self._engine = eng.Engine(num_workers=max(2, preprocess_threads))
         self._it = ImageIter(

@@ -232,6 +232,19 @@ class DataParallelExecutorGroup(object):
             weight = sum(w.copyto(cpu()) for w in block) / len(block)
             weight.copyto(aux_params[name])
 
+    def release_device_buffers(self):
+        """Free the device memory behind this group's executors (arg, grad,
+        aux cells shrink to 0-size placeholders).  Used by Module when the
+        fused SPMD path engages — the trainer holds the live parameters, so
+        keeping a second full copy (plus gradient buffers) here would double
+        HBM.  A later set_params() re-materializes the cells."""
+        import jax.numpy as jnp
+        for e in self.execs:
+            for d in (e.arg_dict, e.grad_dict, e.aux_dict):
+                for arr in d.values():
+                    if arr is not None:
+                        arr._data = jnp.zeros((0,), arr._data.dtype)
+
     # -- execution ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         from ..ndarray import _to_device

@@ -184,9 +184,11 @@ class Module(BaseModule):
 
         self.params_initialized = True
         self._params_dirty = False
-        self._exec_group.set_params(self._arg_params, self._aux_params)
         if self._fused is not None:
+            # trainer is the live copy; exec_group buffers stay released
             self._fused.set_params(self._arg_params, self._aux_params)
+        else:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
@@ -198,9 +200,10 @@ class Module(BaseModule):
             return
         if self.params_initialized and not force_init:
             return
-        self._exec_group.set_params(arg_params, aux_params)
         if self._fused is not None:
             self._fused.set_params(arg_params, aux_params)
+        else:
+            self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
 
@@ -303,12 +306,22 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
+        if self._fused is not None and self._params_dirty:
+            # re-initializing mid-training: capture the trained weights from
+            # the outgoing trainer before it is replaced (any fallback path
+            # below would otherwise drop them)
+            self._sync_params_from_devices()
+            self._exec_group.set_params(self._arg_params, self._aux_params)
         self._fused = self._maybe_init_fused(kvstore, optimizer)
         if self._fused is not None:
             self.logger.info(
                 "kvstore '%s': using the fused SPMD train step "
                 "(fwd+bwd+allreduce+update in one XLA program)",
                 kvstore.type)
+            # the trainer holds the live params now; drop the executor
+            # group's duplicate device buffers (re-materialized by
+            # set_params if a later init_optimizer falls back)
+            self._exec_group.release_device_buffers()
         else:
             if kvstore:
                 _initialize_kvstore(
@@ -383,10 +396,6 @@ class Module(BaseModule):
         else:
             mesh = None
 
-        if self._params_dirty:
-            # re-initializing mid-training (force_init): seed the new
-            # trainer from the CURRENT weights, not the stale host copy
-            self._sync_params_from_devices()
         trainer = SPMDTrainer(self._symbol, optimizer, mesh=mesh)
         trainer.bind(self._data_shapes, self._label_shapes)
         trainer.init_params(None, self._arg_params, self._aux_params)
@@ -467,8 +476,9 @@ class Module(BaseModule):
         if self._fused is not None:
             if self._fused_outputs is None and self._fused_batch is not None:
                 # outputs requested between forward_backward() and update()
-                # (e.g. a custom loop): compute a forward-only pass
-                outs = self._fused.eval_step(*self._fused_batch)
+                # (e.g. a custom loop): train-mode forward with a peeked
+                # RNG key (doesn't shift the training stream)
+                outs = self._fused.forward_only(*self._fused_batch)
                 self._fused_outputs = [NDArray._from_jax(o) for o in outs]
             return list(self._fused_outputs or [])
         return self._exec_group.get_outputs(merge_multi_context)

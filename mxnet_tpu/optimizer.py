@@ -371,6 +371,24 @@ class Updater(object):
 
     def set_states(self, states):
         loaded = pickle.loads(states)
+        if isinstance(loaded, dict) and "states" in loaded \
+                and "num_update" in loaded:
+            # blob saved by the fused SPMD path ({name: tuple}) — convert to
+            # this updater's {index_or_name: state} convention
+            name2idx = {n: i for i, n in
+                        (getattr(self.optimizer, "idx2name", {}) or {}).items()}
+            self.optimizer.num_update = max(self.optimizer.num_update,
+                                            loaded["num_update"])
+            converted = {}
+            for name, s in loaded["states"].items():
+                key = name2idx.get(name, name)
+                if len(s) == 0:
+                    converted[key] = None
+                elif len(s) == 1:
+                    converted[key] = s[0]
+                else:
+                    converted[key] = tuple(s)
+            loaded = converted
         self.states = {k: _state_from_numpy(v) for k, v in loaded.items()}
 
     def get_states(self):

@@ -123,8 +123,14 @@ class SPMDTrainer(object):
         # set_wd_mult — the Module/kvstore path and this fused path must
         # apply identical decay)
         self.optimizer.idx2name = dict(enumerate(self.param_names))
+        # seed name-based defaults (zero wd for biases/gammas/betas) without
+        # wiping multipliers the user already set via set_lr_mult/set_wd_mult
+        user_lr = dict(getattr(self.optimizer, "lr_mult", {}) or {})
+        user_wd = dict(getattr(self.optimizer, "wd_mult", {}) or {})
         self.optimizer.set_wd_mult({})
         self.optimizer.set_lr_mult({})
+        self.optimizer.lr_mult.update(user_lr)
+        self.optimizer.wd_mult.update(user_wd)
         self._build_step()
         return self
 
@@ -280,13 +286,13 @@ class SPMDTrainer(object):
             new_aux.update(auxu)
             return new_params, new_aux, new_state, list(outs)
 
-        def eval_step(params, aux, data, rng):
+        def eval_step(params, aux, data, rng, is_train=False):
             if compute_dtype is not None:
                 params = {k: v.astype(compute_dtype)
                           for k, v in params.items()}
             merged = dict(data)
             merged.update(params)
-            outs, _ = eval_fn(merged, aux, rng, False)
+            outs, _ = eval_fn(merged, aux, rng, is_train)
             return outs
 
         # input shardings propagate from the placed arguments (params were
@@ -294,7 +300,7 @@ class SPMDTrainer(object):
         # _shard_batch) — GSPMD partitions the step and inserts collectives.
         # Donation lets params/opt-state update in place in HBM.
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
-        self._eval_fn = jax.jit(eval_step)
+        self._eval_fn = jax.jit(eval_step, static_argnums=(4,))
 
     # -- public API --------------------------------------------------------
     def _shard_batch(self, arrays):
@@ -354,14 +360,31 @@ class SPMDTrainer(object):
         return self._localize(
             self._eval_fn(self.params, self.aux, data, _random.next_key()))
 
+    def forward_only(self, *batch_arrays):
+        """Train-mode forward WITHOUT the update, for output inspection
+        between forward_backward() and update().  Uses a peeked RNG key so
+        the training stream is not advanced; stochastic layers (Dropout)
+        therefore draw different masks than the actual step will."""
+        from .. import random as _random
+        data = self._shard_batch(batch_arrays)
+        return self._localize(
+            self._eval_fn(self.params, self.aux, data, _random.peek_key(),
+                          True))
+
     @property
     def outputs(self):
         return [NDArray._from_jax(o) for o in (self._outputs or [])]
 
     def _gather(self, v):
         if self._multiproc:
-            # reshard to replicated (GSPMD AllGather) then read the local
-            # copy; the jitted reshard is cached per instance
+            # replicated values (the default) are readable locally with no
+            # collective — critical for rank-guarded checkpointing, where a
+            # cross-process reshard would deadlock the other ranks
+            if v.sharding.is_fully_replicated:
+                return np.asarray(v.addressable_shards[0].data)
+            # genuinely sharded (tp/...): reshard to replicated (GSPMD
+            # AllGather, cached per instance).  NOTE: collective — all
+            # processes must call get_params/get_states together then.
             if self._rep_fn is None:
                 self._rep_fn = jax.jit(lambda x: x,
                                        out_shardings=self._sharding(P()))
@@ -409,9 +432,29 @@ class SPMDTrainer(object):
     def set_states(self, blob):
         import pickle
         payload = pickle.loads(blob)
-        self._num_update = payload["num_update"]
+        if isinstance(payload, dict) and "states" in payload \
+                and "num_update" in payload:
+            states = payload["states"]
+            self._num_update = payload["num_update"]
+        else:
+            # Updater-format blob ({index_or_name: state}) saved by the
+            # executor/kvstore path — convert so checkpoints resume across
+            # the path boundary (reference Updater serialization)
+            idx2name = getattr(self.optimizer, "idx2name", {}) or {}
+            states = {}
+            for k, v in payload.items():
+                name = idx2name.get(k, k)
+                if v is None:
+                    states[name] = ()
+                elif isinstance(v, (tuple, list)):
+                    states[name] = tuple(np.asarray(x) for x in v)
+                else:
+                    states[name] = (np.asarray(v),)
         placed = {}
-        for name, s in payload["states"].items():
+        for name, s in states.items():
+            if name not in self.params:
+                raise MXNetError(
+                    "optimizer state for unknown parameter %r" % (name,))
             spec = _spec_for(name, self.params[name].shape,
                              self.param_shardings)
             placed[name] = tuple(self._place(x, spec) for x in s)

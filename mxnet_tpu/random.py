@@ -44,6 +44,13 @@ def next_key():
     return sub
 
 
+def peek_key():
+    """A key derived from the current state WITHOUT advancing it — for
+    side-channel inspection (e.g. a metrics-only forward) that must not
+    shift the training trajectory's random stream."""
+    return jax.random.fold_in(_get_key(), 0x9e3779b9)
+
+
 def uniform(low=0, high=1, shape=(), ctx=None, dtype="float32", out=None):
     from . import ndarray as nd
     return nd.uniform(low=low, high=high, shape=shape, ctx=ctx, dtype=dtype, out=out)

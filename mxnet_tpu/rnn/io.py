@@ -9,7 +9,7 @@ import numpy as np
 from ..io import DataBatch, DataDesc, DataIter
 from ..ndarray import array as nd_array
 
-__all__ = ["BucketSentenceIter"]
+__all__ = ["BucketSentenceIter", "encode_sentences"]
 
 
 class BucketSentenceIter(DataIter):
@@ -105,3 +105,28 @@ class BucketSentenceIter(DataIter):
                                                  layout=self.layout)])
 
     __next__ = next
+
+
+def encode_sentences(sentences, vocab=None, invalid_label=-1,
+                     invalid_key="\n", start_label=0):
+    """Map token sequences to integer id sequences, growing ``vocab`` as
+    new tokens appear (reference rnn/io.py:encode_sentences)."""
+    idx = start_label
+    if vocab is None:
+        vocab = {invalid_key: invalid_label}
+        new_vocab = True
+    else:
+        new_vocab = False
+    res = []
+    for sent in sentences:
+        coded = []
+        for word in sent:
+            if word not in vocab:
+                assert new_vocab, "Unknown token %s" % word
+                if idx == invalid_label:
+                    idx += 1
+                vocab[word] = idx
+                idx += 1
+            coded.append(vocab[word])
+        res.append(coded)
+    return res, vocab

@@ -83,6 +83,35 @@ class BaseRNNCell(object):
             states.append(state)
         return states
 
+    def _zeros_begin_state(self, ref_batch_first):
+        """Default zero initial states, shaped from a reference input symbol
+        whose axis 0 is the batch (the reference's
+        ``begin_state(func=symbol.zeros)`` with shape (0, H): the unknown
+        batch dim resolves forward from the data instead of needing the
+        reference's bidirectional shape solver)."""
+        states = []
+        for info in self.state_info:
+            shape = info["shape"]
+            known = [int(d) for d in shape if d != 0]
+            total = 1
+            for d in known:
+                total *= d
+            base = symbol.Reshape(ref_batch_first * 0, shape=(0, -1))
+            z = symbol.sum(base, axis=1, keepdims=True)       # (B, 1)
+            z = symbol.tile(z, reps=(1, total))               # (B, prod)
+            if len(shape) == 2:
+                pass                                          # (B, H)
+            elif len(shape) == 3 and shape[1] == 0:
+                # fused layout (L*D, B, H): batch in the middle
+                z = symbol.Reshape(z, shape=(0, shape[0], shape[2]))
+                z = symbol.SwapAxis(z, dim1=0, dim2=1)
+            else:
+                raise MXNetError(
+                    "cannot derive a zero begin state for state shape %s"
+                    % (shape,))
+            states.append(z)
+        return states
+
     def unpack_weights(self, args):
         """fused vector -> per-gate i2h/h2h dict (rnn_cell.py:unpack_weights)."""
         args = args.copy()
@@ -132,7 +161,9 @@ class BaseRNNCell(object):
                                          squeeze_axis=1)
             inputs = [inputs[i] for i in range(length)]
         if begin_state is None:
-            begin_state = self.begin_state()
+            # reference default: zeros (begin_state(func=symbol.zeros));
+            # shaped from the data so shapes resolve forward
+            begin_state = self._zeros_begin_state(inputs[0])
         states = begin_state
         outputs = []
         for i in range(length):
@@ -429,7 +460,9 @@ class FusedRNNCell(BaseRNNCell):
             # NTC -> TNC for the fused op
             inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
         if begin_state is None:
-            begin_state = self.begin_state()
+            # inputs is TNC here; the zero-state builder wants batch-first
+            begin_state = self._zeros_begin_state(
+                symbol.SwapAxis(inputs, dim1=0, dim2=1))
         states = begin_state
         if self._mode == "lstm":
             states = {"state": states[0], "state_cell": states[1]}
@@ -526,8 +559,6 @@ class SequentialRNNCell(BaseRNNCell):
 
     def unroll(self, length, inputs=None, begin_state=None, **kwargs):
         self.reset()
-        if begin_state is None:
-            begin_state = self.begin_state()
         states = begin_state
         outputs = inputs
         p = 0
@@ -536,7 +567,8 @@ class SequentialRNNCell(BaseRNNCell):
         for i, cell in enumerate(self._cells):
             n = len(cell.state_info)
             outputs, st = cell.unroll(
-                length, inputs=outputs, begin_state=states[p:p + n],
+                length, inputs=outputs,
+                begin_state=None if states is None else states[p:p + n],
                 merge_outputs=None if i < len(self._cells) - 1 else merge,
                 **kwargs)
             next_states.extend(st)
@@ -669,12 +701,11 @@ class BidirectionalCell(BaseRNNCell):
     def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
                layout="NTC", merge_outputs=None):
         self.reset()
-        if begin_state is None:
-            begin_state = self.begin_state()
         l_cell, r_cell = self._cells
         n_l = len(l_cell.state_info)
         l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs, begin_state=begin_state[:n_l],
+            length, inputs=inputs,
+            begin_state=None if begin_state is None else begin_state[:n_l],
             layout=layout, merge_outputs=False, input_prefix=input_prefix)
         rev_inputs = list(reversed(inputs)) if isinstance(inputs, list) \
             else symbol.SequenceReverse(symbol.SwapAxis(inputs, dim1=0,
@@ -682,7 +713,8 @@ class BidirectionalCell(BaseRNNCell):
         if not isinstance(rev_inputs, list):
             rev_inputs = symbol.SwapAxis(rev_inputs, dim1=0, dim2=1)
         r_outputs, r_states = r_cell.unroll(
-            length, inputs=rev_inputs, begin_state=begin_state[n_l:],
+            length, inputs=rev_inputs,
+            begin_state=None if begin_state is None else begin_state[n_l:],
             layout=layout, merge_outputs=False, input_prefix=input_prefix)
         outputs = [symbol.Concat(l_o, r_o, dim=1,
                                  name="%st%d" % (self._output_prefix, i))

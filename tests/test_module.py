@@ -284,3 +284,44 @@ def test_module_fused_fallback_unsupported_optimizer():
     mod.fit(it, num_epoch=1, kvstore="tpu", optimizer="adagrad",
             optimizer_params={"learning_rate": 0.05})
     assert mod._fused is None
+
+
+def test_module_fused_force_init_fallback_keeps_weights():
+    """Re-running init_optimizer with a non-fusable config after fused
+    training must carry the trained weights over, not revert to init."""
+    X, y = make_blobs(256, 8, 3, seed=11)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp_sym(nh=16))
+    mod.fit(it, num_epoch=3, kvstore="tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert mod._fused is not None
+    trained = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    # switch to an optimizer with no in-graph rule -> executor path
+    mod.init_optimizer(kvstore="tpu", optimizer="adagrad",
+                       optimizer_params={"learning_rate": 0.05},
+                       force_init=True)
+    assert mod._fused is None
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in trained:
+        np.testing.assert_array_equal(trained[k], after[k], err_msg=k)
+
+
+def test_optimizer_states_cross_path(tmp_path):
+    """Optimizer-state files resume across the fused/executor boundary."""
+    X, y = make_blobs(128, 6, 3, seed=9)
+
+    def make(kv):
+        it = mx.io.NDArrayIter(X, y, batch_size=32)
+        mod = mx.mod.Module(mlp_sym(nh=8))
+        mod.fit(it, num_epoch=1, kvstore=kv, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+        return mod
+
+    fused, plain = make("tpu"), make(None)
+    f_states = str(tmp_path / "fused.states")
+    p_states = str(tmp_path / "plain.states")
+    fused.save_optimizer_states(f_states)
+    plain.save_optimizer_states(p_states)
+    # each side loads the other's format without error
+    fused.load_optimizer_states(p_states)
+    plain.load_optimizer_states(f_states)

@@ -26,6 +26,7 @@ def test_fused_vs_unfused_lstm():
                                 get_next_state=True)
     data = mx.sym.Variable("data")
     f_out, f_states = fused.unroll(T, inputs=data, layout="NTC",
+                                   begin_state=fused.begin_state(),
                                    merge_outputs=True)
     fg = mx.sym.Group([f_out] + list(f_states))
 
@@ -45,6 +46,7 @@ def test_fused_vs_unfused_lstm():
     # unfused path with unpacked weights
     unfused = fused.unfuse()
     u_out, u_states = unfused.unroll(T, inputs=data, layout="NTC",
+                                     begin_state=unfused.begin_state(),
                                      merge_outputs=True)
     arg_dict = {"lstm_parameters": mx.nd.array(params)}
     # fused vector -> per-gate entries -> unfused cells' stacked i2h/h2h form
