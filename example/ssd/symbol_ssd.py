@@ -1,0 +1,112 @@
+"""Compact SSD detection symbol (capability port of the reference
+example/ssd/symbol/symbol_builder.py wiring: multi-scale conv heads →
+MultiBoxPrior anchors → MultiBoxTarget training targets → softmax cls loss
++ smooth-L1 loc loss; MultiBoxDetection for deployment).
+
+The backbone here is a small conv net sized for toy datasets — the wiring
+(per-scale heads, transpose/flatten/concat layout, loss group) is exactly
+the reference's, so swapping in vgg16 from the model zoo reproduces
+vgg16_ssd_300."""
+import mxnet_tpu as mx
+
+
+def conv_act(data, name, num_filter, kernel=(3, 3), pad=(1, 1),
+             stride=(1, 1)):
+    c = mx.sym.Convolution(data=data, kernel=kernel, pad=pad, stride=stride,
+                           num_filter=num_filter, name=name)
+    return mx.sym.Activation(c, act_type="relu", name=name + "_relu")
+
+
+def multi_layer_feature(data):
+    """Backbone + extra layers -> list of feature maps at shrinking
+    scales (for 64x64 input: 16x16, 8x8, 4x4)."""
+    b1 = conv_act(data, "conv1_1", 32)
+    b1 = conv_act(b1, "conv1_2", 32)
+    p1 = mx.sym.Pooling(b1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    b2 = conv_act(p1, "conv2_1", 64)
+    b2 = conv_act(b2, "conv2_2", 64)
+    p2 = mx.sym.Pooling(b2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f1 = conv_act(p2, "conv3_1", 128)                      # /4
+    p3 = mx.sym.Pooling(f1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f2 = conv_act(p3, "conv4_1", 128)                      # /8
+    p4 = mx.sym.Pooling(f2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f3 = conv_act(p4, "conv5_1", 128)                      # /16
+    return [f1, f2, f3]
+
+
+def multibox_layer(features, num_classes, sizes, ratios):
+    """Per-scale prediction heads (reference symbol_builder.multibox_layer):
+    returns (loc_preds, cls_preds, anchors)."""
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    num_anchors = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
+    for i, feat in enumerate(features):
+        a = num_anchors[i]
+        loc = mx.sym.Convolution(data=feat, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=a * 4,
+                                 name="loc_pred_conv%d" % i)
+        loc = mx.sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc_layers.append(mx.sym.Flatten(loc))
+        cls = mx.sym.Convolution(data=feat, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=a * (num_classes + 1),
+                                 name="cls_pred_conv%d" % i)
+        cls = mx.sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls_layers.append(mx.sym.Flatten(cls))
+        anchor_layers.append(
+            mx.sym.contrib.MultiBoxPrior(feat, sizes=sizes[i],
+                                         ratios=ratios[i], clip=False))
+    loc_preds = mx.sym.Concat(*loc_layers, dim=1, name="multibox_loc_pred")
+    cls_preds = mx.sym.Concat(*cls_layers, dim=1)
+    cls_preds = mx.sym.Reshape(cls_preds, shape=(0, -1, num_classes + 1))
+    cls_preds = mx.sym.transpose(cls_preds, axes=(0, 2, 1),
+                                 name="multibox_cls_pred")
+    anchors = mx.sym.Concat(*anchor_layers, dim=1, name="multibox_anchors")
+    return loc_preds, cls_preds, anchors
+
+
+def get_symbol_train(num_classes=3,
+                     sizes=((0.2, 0.35), (0.5,), (0.75,)),
+                     ratios=((1.0, 2.0, 0.5),) * 3,
+                     nms_thresh=0.5, overlap_thresh=0.5,
+                     negative_mining_ratio=3.0):
+    """Training graph (reference symbol_builder.get_symbol_train): outputs
+    [cls_prob, loc_loss, cls_label] for the MultiBox metrics."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    feats = multi_layer_feature(data)
+    loc_preds, cls_preds, anchors = multibox_layer(feats, num_classes,
+                                                   sizes, ratios)
+    loc_target, loc_target_mask, cls_target = mx.sym.contrib.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=overlap_thresh,
+        ignore_label=-1, negative_mining_ratio=negative_mining_ratio,
+        minimum_negative_samples=0, negative_mining_thresh=0.5,
+        variances=(0.1, 0.1, 0.2, 0.2), name="multibox_target")
+    cls_prob = mx.sym.SoftmaxOutput(data=cls_preds, label=cls_target,
+                                    ignore_label=-1, use_ignore=True,
+                                    grad_scale=1.0, multi_output=True,
+                                    normalization="valid", name="cls_prob")
+    loc_diff = loc_target_mask * (loc_preds - loc_target)
+    loc_loss_ = mx.sym.smooth_l1(data=loc_diff, scalar=1.0,
+                                 name="loc_loss_")
+    loc_loss = mx.sym.MakeLoss(loc_loss_, grad_scale=1.0,
+                               normalization="valid", name="loc_loss")
+    cls_label = mx.sym.MakeLoss(data=cls_target, grad_scale=0,
+                                name="cls_label")
+    return mx.sym.Group([cls_prob, loc_loss, cls_label])
+
+
+def get_symbol_detect(num_classes=3,
+                      sizes=((0.2, 0.35), (0.5,), (0.75,)),
+                      ratios=((1.0, 2.0, 0.5),) * 3,
+                      nms_thresh=0.5, nms_topk=100, threshold=0.2):
+    """Deployment graph (reference get_symbol): decoded + NMS'd detections
+    [batch, num_anchors, 6] rows (cls, score, x1, y1, x2, y2)."""
+    data = mx.sym.Variable("data")
+    feats = multi_layer_feature(data)
+    loc_preds, cls_preds, anchors = multibox_layer(feats, num_classes,
+                                                   sizes, ratios)
+    cls_prob = mx.sym.SoftmaxActivation(cls_preds, mode="channel")
+    return mx.sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, name="detection",
+        nms_threshold=nms_thresh, force_suppress=False,
+        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=nms_topk,
+        threshold=threshold)

@@ -1,0 +1,209 @@
+"""Train a Single-Shot Detector (capability port of the reference
+example/ssd/train.py → train/train_net.py).
+
+Feed a detection RecordIO packed by tools/im2rec.py via ``--train-path``,
+or run with no arguments to train on a generated toy shapes dataset
+(colored rectangles; the environment has no dataset downloads).  The
+pipeline — ImageDetRecordIter → MultiBoxTarget → softmax + smooth-L1
+losses → Module.fit — is the reference's end to end.
+
+Usage::
+
+    python train_ssd.py                       # toy dataset, 10 epochs
+    python train_ssd.py --train-path train.rec --num-classes 20
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+import symbol_ssd
+
+
+class DetRecordIter(DataIter):
+    """Wrap ImageDetRecordIter's padded label protocol (B, pad+4) into the
+    (B, M, 5) object tensor MultiBoxTarget consumes — the role of the
+    reference example's dataset/iterator.py DetRecordIter."""
+
+    def __init__(self, inner):
+        super().__init__(inner.batch_size)
+        self.inner = inner
+        pad = inner.label_pad_width
+        # flat label = [header_width, object_width, objects...]
+        self.max_objects = (pad - 2) // 5
+
+    @property
+    def provide_data(self):
+        return self.inner.provide_data
+
+    @property
+    def provide_label(self):
+        return [DataDesc("label", (self.batch_size, self.max_objects, 5))]
+
+    def reset(self):
+        self.inner.reset()
+
+    def next(self):
+        batch = self.inner.next()
+        raw = batch.label[0].asnumpy()
+        out = np.full((raw.shape[0], self.max_objects, 5), -1.0,
+                      dtype=np.float32)
+        for i, row in enumerate(raw):
+            n = int(row[3])
+            if n < 2:
+                continue
+            flat = row[4:4 + n]
+            hdr = int(flat[0])
+            ow = int(flat[1])
+            objs = flat[hdr:].reshape(-1, ow)[:, :5]
+            out[i, :len(objs)] = objs
+        return DataBatch(data=batch.data, label=[mx.nd.array(out)],
+                         pad=batch.pad, index=batch.index,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    __next__ = next
+
+
+def make_toy_rec(prefix, n=64, size=64, num_classes=3, seed=0):
+    """Colored-rectangle toy detection set packed as RecordIO."""
+    rs = np.random.RandomState(seed)
+    colors = [(255, 60, 60), (60, 255, 60), (60, 60, 255)]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n):
+        img = np.full((size, size, 3), 100, dtype=np.uint8)
+        img += rs.randint(0, 20, img.shape).astype(np.uint8)
+        nobj = rs.randint(1, 3)
+        label = [2.0, 5.0]
+        for _ in range(nobj):
+            x0, y0 = rs.randint(0, size - 24, 2)
+            bw, bh = rs.randint(16, 24, 2)
+            x1, y1 = min(size - 1, x0 + bw), min(size - 1, y0 + bh)
+            cls = rs.randint(0, num_classes)
+            img[y0:y1, x0:x1] = colors[cls % len(colors)]
+            label += [float(cls), x0 / size, y0 / size, x1 / size,
+                      y1 / size]
+        header = recordio.IRHeader(0, np.asarray(label, np.float32), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=95))
+    rec.close()
+    return prefix + ".rec", prefix + ".idx"
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Cross-entropy + smooth-L1 composite (reference
+    example/ssd/train/metric.py MultiBoxMetric)."""
+
+    def __init__(self):
+        super().__init__("MultiBox")
+        self.num = 2
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0, 0]
+        self.sum_metric = [0.0, 0.0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()     # (B, C+1, A)
+        loc_loss = preds[1].asnumpy()     # (B, A*4)
+        cls_label = preds[2].asnumpy()    # (B, A)
+        valid = cls_label >= 0
+        prob = np.moveaxis(cls_prob, 1, -1)   # (B, A, C+1)
+        idx = np.clip(cls_label.astype(int), 0, prob.shape[-1] - 1)
+        p = np.take_along_axis(prob, idx[..., None], axis=-1)[..., 0]
+        p = np.where(valid, p, 1.0)
+        self.sum_metric[0] += float(-np.log(np.maximum(p, 1e-12)).sum())
+        self.num_inst[0] += int(valid.sum())
+        self.sum_metric[1] += float(np.abs(loc_loss).sum())
+        self.num_inst[1] += max(1, int(valid.sum()))
+
+    def get(self):
+        return (["CrossEntropy", "SmoothL1"],
+                [s / max(1, n) for s, n in zip(self.sum_metric,
+                                               self.num_inst)])
+
+    def get_name_value(self):
+        names, values = self.get()
+        return list(zip(names, values))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Train a Single-shot detection network")
+    parser.add_argument("--train-path", type=str, default="",
+                        help="detection .rec to train on (toy set if empty)")
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--data-shape", type=int, default=64)
+    parser.add_argument("--num-epochs", dest="num_epochs", type=int,
+                        default=10)
+    parser.add_argument("--lr", type=float, default=0.005)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=0.0005)
+    parser.add_argument("--frequent", type=int, default=10,
+                        help="logging frequency")
+    parser.add_argument("--prefix", type=str, default="",
+                        help="checkpoint prefix")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    args = parse_args()
+
+    if args.train_path:
+        rec_path = args.train_path
+        idx_path = os.path.splitext(rec_path)[0] + ".idx"
+        if not os.path.exists(idx_path):
+            idx_path = None
+    else:
+        logging.warning("no --train-path; generating the toy shapes set")
+        rec_path, idx_path = make_toy_rec(
+            os.path.join("/tmp", "ssd_toy"), num_classes=args.num_classes)
+
+    shape = (3, args.data_shape, args.data_shape)
+    inner = mx.io.ImageDetRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_mirror_prob=0.5,
+        rand_crop_prob=0.0, mean_r=123.0, mean_g=117.0, mean_b=104.0,
+        verbose=True)
+    train_iter = DetRecordIter(inner)
+
+    net = symbol_ssd.get_symbol_train(num_classes=args.num_classes)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+    mod.fit(train_iter,
+            eval_metric=MultiBoxMetric(),
+            num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum, "wd": args.wd},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.frequent),
+            epoch_end_callback=(mx.callback.do_checkpoint(args.prefix)
+                                if args.prefix else None),
+            kvstore=None)
+
+    # deployment graph shares the trained weights; run one detection pass
+    det_sym = symbol_ssd.get_symbol_detect(num_classes=args.num_classes)
+    arg_params, aux_params = mod.get_params()
+    det_mod = mx.mod.Module(det_sym, data_names=("data",), label_names=None)
+    det_mod.bind(data_shapes=[("data", (args.batch_size,) + shape)],
+                 for_training=False)
+    det_mod.set_params(arg_params, aux_params, allow_missing=False)
+    train_iter.reset()
+    batch = train_iter.next()
+    det_mod.forward(DataBatch(data=batch.data), is_train=False)
+    dets = det_mod.get_outputs()[0].asnumpy()
+    found = (dets[:, :, 0] >= 0).sum(axis=1)
+    logging.info("detections per image (first batch): %s", found.tolist())

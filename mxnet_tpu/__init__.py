@@ -46,9 +46,12 @@ from . import engine
 from . import recordio
 from . import image
 from . import io
-# reference parity: the C++ record iterator registers as mx.io.ImageRecordIter
-# (src/io/iter_image_recordio.cc:319); ours lives in image.py
+# reference parity: the C++ record iterators register as mx.io.* iterators
+# (src/io/iter_image_recordio.cc:319, iter_image_det_recordio.cc:563); ours
+# live in image.py / image_det.py
+from . import image_det
 io.ImageRecordIter = image.ImageRecordIter
+io.ImageDetRecordIter = image_det.ImageDetRecordIter
 from . import initializer
 from .initializer import init_registry
 from . import optimizer
@@ -62,6 +65,10 @@ from . import executor_manager
 from . import parallel
 from . import autograd
 from . import contrib
+# both addressing styles work: mx.contrib.symbol.X (the reference's v0.9.5
+# layout) and mx.sym.contrib.X / mx.nd.contrib.X (later-API convenience)
+symbol.contrib = contrib.symbol
+ndarray.contrib = contrib.ndarray
 from . import monitor
 from .monitor import Monitor
 from . import profiler

@@ -126,8 +126,15 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        outputs = self._exec_group.execs[0].outputs  # lazy compute ok
-        return list(zip(self._output_names, [o.shape for o in outputs]))
+        from ..io import DataDesc
+        shapes = {}
+        for d in (self._data_shapes or []) + (self._label_shapes or []):
+            if isinstance(d, DataDesc):
+                shapes[d.name] = d.shape
+            else:
+                shapes[d[0]] = tuple(d[1])
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
 
     # -- params ------------------------------------------------------------
     def get_params(self):
@@ -306,12 +313,12 @@ class Module(BaseModule):
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
 
-        if self._fused is not None and self._params_dirty:
-            # re-initializing mid-training: capture the trained weights from
-            # the outgoing trainer before it is replaced (any fallback path
-            # below would otherwise drop them)
+        was_fused = self._fused is not None
+        if self._params_dirty:
+            # re-initializing mid-training: capture the trained weights
+            # from whichever side currently owns them (trainer or
+            # exec_group) before the ownership may change below
             self._sync_params_from_devices()
-            self._exec_group.set_params(self._arg_params, self._aux_params)
         self._fused = self._maybe_init_fused(kvstore, optimizer)
         if self._fused is not None:
             self.logger.info(
@@ -319,10 +326,14 @@ class Module(BaseModule):
                 "(fwd+bwd+allreduce+update in one XLA program)",
                 kvstore.type)
             # the trainer holds the live params now; drop the executor
-            # group's duplicate device buffers (re-materialized by
-            # set_params if a later init_optimizer falls back)
+            # group's duplicate device buffers (re-materialized below if a
+            # later init_optimizer falls back)
             self._exec_group.release_device_buffers()
         else:
+            if was_fused:
+                # buffers were released while the trainer owned the params
+                self._exec_group.set_params(self._arg_params,
+                                            self._aux_params)
             if kvstore:
                 _initialize_kvstore(
                     kvstore=kvstore,

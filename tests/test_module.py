@@ -325,3 +325,46 @@ def test_optimizer_states_cross_path(tmp_path):
     # each side loads the other's format without error
     fused.load_optimizer_states(p_states)
     plain.load_optimizer_states(f_states)
+
+
+def test_module_exec_to_fused_force_init_keeps_weights():
+    """Switching from the executor path INTO the fused path mid-training
+    must seed the trainer from the trained device weights."""
+    X, y = make_blobs(256, 8, 3, seed=13)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp_sym(nh=16))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for _ in range(2):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    # read trained weights straight off the exec_group so the module's
+    # dirty-state bookkeeping is untouched (the regression hid behind a
+    # prior get_params() call syncing _arg_params)
+    assert mod._params_dirty
+    names = [n for n in mod._param_names if n in mod._symbol.list_arguments()]
+    trained = {n: block[0].asnumpy()
+               for n, block in zip(names, mod._exec_group.param_arrays)}
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1},
+                       force_init=True)
+    assert mod._fused is not None
+    seeded = {k: np.asarray(mod._fused._gather(v))
+              for k, v in mod._fused.params.items()}
+    for k in trained:
+        np.testing.assert_allclose(seeded[k], trained[k], rtol=1e-6,
+                                   err_msg=k)
+
+
+def test_module_output_shapes_with_fused():
+    X, y = make_blobs(64, 8, 3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(mlp_sym(nh=8))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore="tpu")
+    assert mod.output_shapes == [("softmax_output", (32, 3))]

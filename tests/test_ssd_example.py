@@ -1,0 +1,65 @@
+"""SSD acceptance-config smoke test: the example's training graph binds,
+trains a few steps on the toy detection set, and the deployment graph
+emits decoded detections (BASELINE config #5 analog, on the virtual CPU
+backend)."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SSD_DIR = os.path.join(REPO, "example", "ssd")
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ssd_train_and_detect(tmp_path):
+    sys.path.insert(0, SSD_DIR)
+    try:
+        symbol_ssd = _load("symbol_ssd",
+                           os.path.join(SSD_DIR, "symbol_ssd.py"))
+        train_ssd = _load("train_ssd_mod",
+                          os.path.join(SSD_DIR, "train_ssd.py"))
+    finally:
+        sys.path.pop(0)
+
+    rec, idx = train_ssd.make_toy_rec(str(tmp_path / "toy"), n=32)
+    inner = mx.io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 64, 64),
+        batch_size=8, shuffle=True, rand_mirror_prob=0.5,
+        mean_r=123.0, mean_g=117.0, mean_b=104.0)
+    it = train_ssd.DetRecordIter(inner)
+
+    net = symbol_ssd.get_symbol_train(num_classes=3)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+    metric = train_ssd.MultiBoxMetric()
+    mod.fit(it, eval_metric=metric, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.005, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2),
+            kvstore=None)
+    names, values = metric.get()
+    assert np.isfinite(values).all()
+
+    det_sym = symbol_ssd.get_symbol_detect(num_classes=3)
+    arg_params, aux_params = mod.get_params()
+    det = mx.mod.Module(det_sym, data_names=("data",), label_names=None)
+    det.bind(data_shapes=[("data", (8, 3, 64, 64))], for_training=False)
+    det.set_params(arg_params, aux_params)
+    it.reset()
+    batch = it.next()
+    det.forward(DataBatch(data=batch.data), is_train=False)
+    out = det.get_outputs()[0].asnumpy()
+    assert out.ndim == 3 and out.shape[0] == 8 and out.shape[2] == 6
+    kept = out[out[:, :, 0] >= 0]
+    assert ((kept[:, 1] >= 0) & (kept[:, 1] <= 1)).all()  # scores
