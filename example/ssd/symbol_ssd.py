@@ -66,7 +66,7 @@ def multibox_layer(features, num_classes, sizes, ratios):
 def get_symbol_train(num_classes=3,
                      sizes=((0.2, 0.35), (0.5,), (0.75,)),
                      ratios=((1.0, 2.0, 0.5),) * 3,
-                     nms_thresh=0.5, overlap_thresh=0.5,
+                     overlap_thresh=0.5,
                      negative_mining_ratio=3.0):
     """Training graph (reference symbol_builder.get_symbol_train): outputs
     [cls_prob, loc_loss, cls_label] for the MultiBox metrics."""

@@ -89,7 +89,7 @@ def DetHorizontalFlipAug(p):
 
 def DetRandomCropAug(min_scale=0.3, max_scale=1.0, min_aspect=0.5,
                      max_aspect=2.0, min_overlap=0.1, max_trials=25,
-                     prob=0.5, emit_overlap_thresh=0.3):
+                     prob=0.5):
     """IOU-constrained random crop (the reference's crop sampler,
     image_det_aug_default.cc min_crop_scales/min_crop_overlaps): sample a
     crop window whose IOU with at least one ground-truth box exceeds
@@ -225,11 +225,20 @@ class ImageDetRecordIter(mxio.DataIter):
         else:
             self._rec = recordio.MXRecordIO(path_imgrec, "r")
             self._keys = None
+            if shuffle:
+                logging.warning(
+                    "ImageDetRecordIter: shuffle requires path_imgidx (the "
+                    "stream reader is sequential); iterating in file order")
         self.shuffle = shuffle
-        if self._keys is not None and num_parts > 1:
-            chunk = len(self._keys) // num_parts
-            self._keys = self._keys[part_index * chunk:
-                                    (part_index + 1) * chunk]
+        self._stream_part = None
+        if num_parts > 1:
+            if self._keys is not None:
+                chunk = len(self._keys) // num_parts
+                self._keys = self._keys[part_index * chunk:
+                                        (part_index + 1) * chunk]
+            else:
+                # shard the sequential stream by record position
+                self._stream_part = (part_index, num_parts)
         mean = [mean_r, mean_g, mean_b] if any([mean_r, mean_g, mean_b]) \
             else None
         std = [std_r, std_g, std_b] if any([std_r, std_g, std_b]) else None
@@ -280,10 +289,9 @@ class ImageDetRecordIter(mxio.DataIter):
                          (self.batch_size, self.label_pad_width + 4))]
 
     def reset(self):
-        if self._keys is not None:
-            if self.shuffle:
-                pyrandom.shuffle(self._keys)
-            self._cursor = 0
+        if self._keys is not None and self.shuffle:
+            pyrandom.shuffle(self._keys)
+        self._cursor = 0
         self._rec.reset()
 
     def _next_record(self):
@@ -293,7 +301,15 @@ class ImageDetRecordIter(mxio.DataIter):
             s = self._rec.read_idx(self._keys[self._cursor])
             self._cursor += 1
             return s
-        return self._rec.read()
+        while True:
+            s = self._rec.read()
+            if s is None or self._stream_part is None:
+                return s
+            part, nparts = self._stream_part
+            pos = self._cursor
+            self._cursor += 1
+            if pos % nparts == part:
+                return s
 
     def next(self):
         c, h, w = self.data_shape
@@ -316,7 +332,11 @@ class ImageDetRecordIter(mxio.DataIter):
                 arr, label = aug(arr, label)
             flat = label.flat()
             if flat.size > self.label_pad_width:
-                flat = flat[:self.label_pad_width]
+                raise MXNetError(
+                    "augmented label width %d exceeds label_pad_width %d "
+                    "(an augmenter added boxes?); construct the iterator "
+                    "with an explicit larger label_pad_width"
+                    % (flat.size, self.label_pad_width))
             data[n] = np.asarray(arr, dtype=np.float32).transpose(2, 0, 1)
             labels[n, 0] = arr.shape[2] if arr.ndim == 3 else 1
             labels[n, 1] = arr.shape[0]

@@ -154,18 +154,25 @@ def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4):
     if not ctx_list:
         return
     arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
     shapes = ctx_list[0]["shapes"] if isinstance(ctx_list[0], dict) else None
     outputs = []
     arg_vals = None
+    aux_vals = None
     for spec in ctx_list:
         ctx = spec["ctx"]
         shapes = spec.get("shapes", shapes)
-        arg_shapes, _, _ = sym.infer_shape(**shapes)
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
         if arg_vals is None:
             arg_vals = {n: (np.random.normal(0, scale, size=s).astype(np.float32))
                         for n, s in zip(arg_names, arg_shapes)}
+            # aux convention: running means 0, running variances 1
+            aux_vals = {n: (np.ones(s, np.float32) if "var" in n
+                            else np.zeros(s, np.float32))
+                        for n, s in zip(aux_names, aux_shapes)}
         args = {k: nd_array(v, ctx=ctx) for k, v in arg_vals.items()}
-        ex = sym.bind(ctx, args)
+        aux = {k: nd_array(v, ctx=ctx) for k, v in aux_vals.items()} or None
+        ex = sym.bind(ctx, args, aux_states=aux)
         outputs.append([o.asnumpy() for o in ex.forward()])
     for other in outputs[1:]:
         for a, b in zip(outputs[0], other):

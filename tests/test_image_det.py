@@ -2,6 +2,10 @@
 image_det_aug_default.cc behavior): pack a toy rectangle dataset with
 recordio, read it back through ImageDetRecordIter, and check the padded
 label protocol + label-aware augmenter geometry."""
+import importlib.util
+import os
+import sys
+
 import numpy as np
 import pytest
 
@@ -10,28 +14,27 @@ from mxnet_tpu import recordio
 from mxnet_tpu.image_det import (_DetLabel, DetHorizontalFlipAug,
                                  DetRandomPadAug, ImageDetRecordIter)
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SSD = os.path.join(_REPO, "example", "ssd")
+
+
+def _toy_gen():
+    """The SSD example's toy dataset writer — single source of truth for
+    the packed detection label format."""
+    sys.path.insert(0, _SSD)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "train_ssd_for_det_tests", os.path.join(_SSD, "train_ssd.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.pop(0)
+    return mod.make_toy_rec
+
 
 def make_det_rec(path, n=12, seed=0):
-    """Toy detection set: colored rectangles on gray background."""
-    rs = np.random.RandomState(seed)
-    rec = recordio.MXIndexedRecordIO(str(path) + ".idx", str(path) + ".rec",
-                                     "w")
-    for i in range(n):
-        img = np.full((64, 64, 3), 90, dtype=np.uint8)
-        nobj = rs.randint(1, 4)
-        label = [2.0, 5.0]
-        for _ in range(nobj):
-            x0, y0 = rs.randint(0, 40, 2)
-            bw, bh = rs.randint(10, 24, 2)
-            x1, y1 = min(63, x0 + bw), min(63, y0 + bh)
-            cls = rs.randint(0, 3)
-            img[y0:y1, x0:x1] = [(255, 0, 0), (0, 255, 0),
-                                 (0, 0, 255)][cls]
-            label += [float(cls), x0 / 64.0, y0 / 64.0, x1 / 64.0,
-                      y1 / 64.0]
-        header = recordio.IRHeader(0, np.asarray(label, np.float32), i, 0)
-        rec.write_idx(i, recordio.pack_img(header, img, quality=95))
-    rec.close()
+    _toy_gen()(str(path), n=n, seed=seed)
 
 
 def test_image_det_record_iter(tmp_path):

@@ -1,0 +1,638 @@
+"""Per-op numeric verification sweep (reference
+tests/python/unittest/test_operator.py, 3,073 LoC: check_numeric_gradient
+finite differences vs the symbolic backward, check_symbolic_forward /
+check_symbolic_backward vs numpy references, and
+tests/python/gpu/test_operator_gpu.py's check_consistency axis).
+
+Shapes are kept tiny because the finite-difference oracle runs 2*numel
+forwards per input."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_consistency,
+                                  check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward)
+
+RS = np.random.RandomState
+
+
+def _u(shape, lo=-1.0, hi=1.0, seed=0):
+    return RS(seed).uniform(lo, hi, size=shape).astype("f")
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise family — forward vs numpy + numeric gradient
+# (reference test_operator.py mathematical_core / test_unary_func)
+# ---------------------------------------------------------------------------
+
+UNARY = [
+    # (op name, symbol builder, numpy forward, input domain)
+    ("relu", lambda x: mx.sym.Activation(x, act_type="relu"),
+     lambda a: np.maximum(a, 0), (0.1, 1.0)),
+    ("sigmoid", lambda x: mx.sym.Activation(x, act_type="sigmoid"),
+     lambda a: 1 / (1 + np.exp(-a)), (-1, 1)),
+    ("tanh", lambda x: mx.sym.Activation(x, act_type="tanh"),
+     np.tanh, (-1, 1)),
+    ("softrelu", lambda x: mx.sym.Activation(x, act_type="softrelu"),
+     lambda a: np.log1p(np.exp(a)), (-1, 1)),
+    ("exp", mx.sym.exp, np.exp, (-1, 1)),
+    ("log", mx.sym.log, np.log, (0.2, 2.0)),
+    ("log2", mx.sym.log2, np.log2, (0.2, 2.0)),
+    ("log10", mx.sym.log10, np.log10, (0.2, 2.0)),
+    ("log1p", mx.sym.log1p, np.log1p, (-0.5, 1.0)),
+    ("expm1", mx.sym.expm1, np.expm1, (-1, 1)),
+    ("sqrt", mx.sym.sqrt, np.sqrt, (0.2, 2.0)),
+    ("rsqrt", mx.sym.rsqrt, lambda a: 1 / np.sqrt(a), (0.2, 2.0)),
+    ("cbrt", mx.sym.cbrt, np.cbrt, (0.2, 2.0)),
+    ("square", mx.sym.square, np.square, (-1, 1)),
+    ("abs", mx.sym.abs, np.abs, (0.1, 1.0)),
+    ("sign", mx.sym.sign, np.sign, (0.1, 1.0)),
+    ("negative", mx.sym.negative, np.negative, (-1, 1)),
+    ("reciprocal", mx.sym.reciprocal, lambda a: 1 / a, (0.5, 2.0)),
+    ("sin", mx.sym.sin, np.sin, (-1, 1)),
+    ("cos", mx.sym.cos, np.cos, (-1, 1)),
+    ("tan", mx.sym.tan, np.tan, (-0.5, 0.5)),
+    ("arcsin", mx.sym.arcsin, np.arcsin, (-0.8, 0.8)),
+    ("arccos", mx.sym.arccos, np.arccos, (-0.8, 0.8)),
+    ("arctan", mx.sym.arctan, np.arctan, (-1, 1)),
+    ("sinh", mx.sym.sinh, np.sinh, (-1, 1)),
+    ("cosh", mx.sym.cosh, np.cosh, (-1, 1)),
+    ("arcsinh", mx.sym.arcsinh, np.arcsinh, (-1, 1)),
+    ("arctanh", mx.sym.arctanh, np.arctanh, (-0.8, 0.8)),
+    ("softsign", mx.sym.softsign, lambda a: a / (1 + np.abs(a)),
+     (0.1, 1.0)),
+    ("erf", mx.sym.erf,
+     lambda a: np.vectorize(__import__("math").erf)(a).astype("f"),
+     (-1, 1)),
+]
+
+
+@pytest.mark.parametrize("name,build,ref,dom",
+                         UNARY, ids=[u[0] for u in UNARY])
+def test_unary_forward_and_gradient(name, build, ref, dom):
+    x = mx.sym.Variable("x")
+    sym = build(x)
+    a = _u((3, 4), dom[0], dom[1], seed=hash(name) % 1000)
+    check_symbolic_forward(sym, {"x": a}, [ref(a)], rtol=1e-4, atol=1e-5)
+    if name != "sign":  # zero-gradient op
+        check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-3,
+                               rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# binary / broadcast family (reference test_operator.py
+# test_binary_op_duplicate_input + check_binary_op_forward/backward)
+# ---------------------------------------------------------------------------
+
+BINARY = [
+    ("elemwise_add", lambda a, b: a + b, lambda x, y: x + y),
+    ("elemwise_sub", lambda a, b: a - b, lambda x, y: x - y),
+    ("elemwise_mul", lambda a, b: a * b, lambda x, y: x * y),
+    ("elemwise_div", lambda a, b: a / b, lambda x, y: x / y),
+    ("broadcast_add", mx.sym.broadcast_add, lambda x, y: x + y),
+    ("broadcast_sub", mx.sym.broadcast_sub, lambda x, y: x - y),
+    ("broadcast_mul", mx.sym.broadcast_mul, lambda x, y: x * y),
+    ("broadcast_div", mx.sym.broadcast_div, lambda x, y: x / y),
+    ("broadcast_maximum", mx.sym.broadcast_maximum, np.maximum),
+    ("broadcast_minimum", mx.sym.broadcast_minimum, np.minimum),
+    ("broadcast_power", mx.sym.broadcast_power, np.power),
+    ("broadcast_hypot", mx.sym.broadcast_hypot, np.hypot),
+]
+
+
+@pytest.mark.parametrize("name,build,ref",
+                         BINARY, ids=[b[0] for b in BINARY])
+def test_binary_forward_and_gradient(name, build, ref):
+    broadcast = name.startswith("broadcast")
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    sym = build(x, y)
+    a = _u((3, 4), 0.5, 2.0, seed=1)
+    b = _u((1, 4) if broadcast else (3, 4), 0.6, 1.8, seed=2)
+    check_symbolic_forward(sym, {"x": a, "y": b}, [ref(a, b)])
+    eps = 1e-3
+    check_numeric_gradient(sym, {"x": a, "y": b}, numeric_eps=eps,
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_dot_and_batch_dot_gradient():
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    a, b = _u((3, 4), seed=3), _u((4, 2), seed=4)
+    check_symbolic_forward(mx.sym.dot(x, y), {"x": a, "y": b}, [a.dot(b)])
+    check_numeric_gradient(mx.sym.dot(x, y), {"x": a, "y": b},
+                           rtol=2e-2, atol=2e-3)
+    ab, bb = _u((2, 3, 4), seed=5), _u((2, 4, 2), seed=6)
+    check_symbolic_forward(mx.sym.batch_dot(x, y), {"x": ab, "y": bb},
+                           [np.einsum("bij,bjk->bik", ab, bb)])
+    check_numeric_gradient(mx.sym.batch_dot(x, y), {"x": ab, "y": bb},
+                           rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference broadcast_reduce_op_value.cc families)
+# ---------------------------------------------------------------------------
+
+REDUCE = [
+    ("sum", mx.sym.sum, np.sum, {}),
+    ("sum_axis0", lambda x, **k: mx.sym.sum(x, axis=0),
+     lambda a: a.sum(axis=0), {}),
+    ("sum_keepdims", lambda x, **k: mx.sym.sum(x, axis=1, keepdims=True),
+     lambda a: a.sum(axis=1, keepdims=True), {}),
+    ("mean", mx.sym.mean, np.mean, {}),
+    ("prod", mx.sym.prod, np.prod, {}),
+    ("max", mx.sym.max, np.max, {}),
+    ("min", mx.sym.min, np.min, {}),
+    ("norm", mx.sym.norm,
+     lambda a: np.sqrt((a * a).sum()).reshape(1), {}),
+]
+
+
+@pytest.mark.parametrize("name,build,ref,kw",
+                         REDUCE, ids=[r[0] for r in REDUCE])
+def test_reduce_forward_and_gradient(name, build, ref, kw):
+    x = mx.sym.Variable("x")
+    sym = build(x, **kw)
+    # distinct magnitudes so max/min have a unique argmax (differentiable)
+    a = (np.arange(12, dtype="f").reshape(3, 4) / 7.0 + 0.3) * \
+        _u((3, 4), 0.9, 1.1, seed=7)
+    out = np.asarray(ref(a))
+    if out.ndim == 0:
+        out = out.reshape(1)
+    check_symbolic_forward(sym, {"x": a}, [out])
+    check_numeric_gradient(sym, {"x": a}, rtol=2e-2, atol=2e-3)
+
+
+def test_argmax_argmin_forward():
+    x = mx.sym.Variable("x")
+    a = _u((3, 4), seed=8)
+    check_symbolic_forward(mx.sym.argmax(x, axis=1), {"x": a},
+                           [a.argmax(axis=1).astype("f")])
+    check_symbolic_forward(mx.sym.argmin(x, axis=0), {"x": a},
+                           [a.argmin(axis=0).astype("f")])
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation ops
+# ---------------------------------------------------------------------------
+
+def test_shape_ops_gradient():
+    x = mx.sym.Variable("x")
+    a = _u((2, 3, 4), seed=9)
+    for name, sym, ref in [
+        ("transpose", mx.sym.transpose(x, axes=(2, 0, 1)),
+         a.transpose(2, 0, 1)),
+        ("swapaxes", mx.sym.SwapAxis(x, dim1=0, dim2=2), a.swapaxes(0, 2)),
+        ("reshape", mx.sym.Reshape(x, shape=(4, 6)), a.reshape(4, 6)),
+        ("flatten", mx.sym.Flatten(x), a.reshape(2, 12)),
+        ("expand_dims", mx.sym.expand_dims(x, axis=1), a[:, None]),
+        ("flip", mx.sym.flip(x, axis=1), a[:, ::-1]),
+        ("tile", mx.sym.tile(x, reps=(1, 2, 1)), np.tile(a, (1, 2, 1))),
+        ("repeat", mx.sym.repeat(x, repeats=2, axis=1),
+         np.repeat(a, 2, axis=1)),
+        ("slice", mx.sym.slice(x, begin=(0, 1, 0), end=(2, 3, 2)),
+         a[0:2, 1:3, 0:2]),
+        ("slice_axis", mx.sym.slice_axis(x, axis=2, begin=1, end=3),
+         a[:, :, 1:3]),
+        ("pad", mx.sym.Pad(mx.sym.Reshape(x, shape=(1, 2, 3, 4)),
+                           mode="constant",
+                           pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                           constant_value=0),
+         np.pad(a.reshape(1, 2, 3, 4),
+                ((0, 0), (0, 0), (1, 1), (1, 1)))),
+    ]:
+        check_symbolic_forward(sym, {"x": a}, [ref])
+        check_numeric_gradient(sym, {"x": a}, rtol=2e-2, atol=2e-3)
+
+
+def test_concat_and_split_gradient():
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    a, b = _u((2, 3), seed=10), _u((2, 2), seed=11)
+    sym = mx.sym.Concat(x, y, dim=1)
+    check_symbolic_forward(sym, {"x": a, "y": b},
+                           [np.concatenate([a, b], axis=1)])
+    check_numeric_gradient(sym, {"x": a, "y": b}, rtol=2e-2, atol=2e-3)
+
+    s = mx.sym.SliceChannel(mx.sym.Variable("x"), num_outputs=2, axis=1)
+    c = _u((2, 4), seed=12)
+    check_symbolic_forward(s, {"x": c}, [c[:, :2], c[:, 2:]])
+    check_numeric_gradient(s, {"x": c}, rtol=2e-2, atol=2e-3)
+
+
+def test_where_clip_gradient():
+    c = (np.asarray([[1, 0], [0, 1]], dtype="f"))
+    a, b = _u((2, 2), seed=13), _u((2, 2), seed=14)
+    cond = mx.sym.Variable("c")
+    x, y = mx.sym.Variable("x"), mx.sym.Variable("y")
+    sym = mx.sym.where(cond, x, y)
+    check_symbolic_forward(sym, {"c": c, "x": a, "y": b},
+                           [np.where(c, a, b)])
+    check_numeric_gradient(sym, {"c": c, "x": a, "y": b},
+                           grad_nodes=["x", "y"], rtol=2e-2, atol=2e-3)
+
+    sym = mx.sym.clip(x, a_min=-0.3, a_max=0.4)
+    a2 = _u((3, 4), seed=15)
+    a2 = a2[(np.abs(a2 - (-0.3)) > 2e-3) & (np.abs(a2 - 0.4) > 2e-3)]
+    check_numeric_gradient(mx.sym.clip(x, a_min=-0.3, a_max=0.4),
+                           {"x": a2}, rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# NN layers with custom lowerings — the hand-written-backward hot spots
+# ---------------------------------------------------------------------------
+
+def test_fullyconnected_gradient():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    loc = {"data": _u((2, 4), seed=16), "fc_weight": _u((3, 4), seed=17),
+           "fc_bias": _u((3,), seed=18)}
+    exp = loc["data"].dot(loc["fc_weight"].T) + loc["fc_bias"]
+    check_symbolic_forward(sym, loc, [exp])
+    check_numeric_gradient(sym, loc, rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("stride,pad,num_group", [((1, 1), (0, 0), 1),
+                                                  ((2, 2), (1, 1), 1),
+                                                  ((1, 1), (1, 1), 2)])
+def test_convolution_gradient(stride, pad, num_group):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(x, kernel=(3, 3), num_filter=2, stride=stride,
+                             pad=pad, num_group=num_group, name="conv")
+    loc = {"data": _u((1, 2, 5, 5), seed=19),
+           "conv_weight": _u((2, 2 // num_group, 3, 3), seed=20),
+           "conv_bias": _u((2,), seed=21)}
+    check_numeric_gradient(sym, loc, rtol=3e-2, atol=3e-3)
+
+
+def test_deconvolution_gradient():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Deconvolution(x, kernel=(3, 3), num_filter=2, stride=(2, 2),
+                               name="dc", no_bias=True)
+    loc = {"data": _u((1, 2, 3, 3), seed=22),
+           "dc_weight": _u((2, 2, 3, 3), seed=23)}
+    check_numeric_gradient(sym, loc, rtol=3e-2, atol=3e-3)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_pooling_gradient(pool_type):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Pooling(x, pool_type=pool_type, kernel=(2, 2),
+                         stride=(2, 2))
+    # distinct values so max pooling has unique argmax
+    a = (np.arange(32, dtype="f").reshape(1, 2, 4, 4) * 0.07 + 0.1) * \
+        _u((1, 2, 4, 4), 0.95, 1.05, seed=24)
+    check_numeric_gradient(sym, {"data": a}, rtol=2e-2, atol=2e-3)
+
+
+def test_pooling_global():
+    x = mx.sym.Variable("data")
+    a = _u((2, 3, 4, 4), seed=25)
+    sym = mx.sym.Pooling(x, pool_type="avg", kernel=(1, 1),
+                         global_pool=True)
+    check_symbolic_forward(sym, {"data": a},
+                           [a.mean(axis=(2, 3), keepdims=True)])
+    check_numeric_gradient(sym, {"data": a}, rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.parametrize("act", ["leaky", "elu"])
+def test_leakyrelu_gradient(act):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(x, act_type=act, slope=0.3)
+    a = _u((3, 4), 0.1, 1.0, seed=26)   # away from the kink at 0
+    check_numeric_gradient(sym, {"data": a}, rtol=2e-2, atol=2e-3)
+    a = _u((3, 4), -1.0, -0.1, seed=27)
+    check_numeric_gradient(sym, {"data": a}, rtol=2e-2, atol=2e-3)
+
+
+def test_batchnorm_gradient_and_aux_semantics():
+    """BatchNorm: numeric gradient in train mode + the reference's aux
+    update contract (batch_norm-inl.h: moving = momentum*moving +
+    (1-momentum)*batch stat; eval uses moving stats)."""
+    x = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(x, eps=1e-3, momentum=0.9, fix_gamma=False,
+                           name="bn")
+    a = _u((4, 2), 0.5, 1.5, seed=28)
+    loc = {"data": a, "bn_gamma": np.asarray([1.2, 0.8], "f"),
+           "bn_beta": np.asarray([0.1, -0.2], "f")}
+    aux = {"bn_moving_mean": np.zeros(2, "f"),
+           "bn_moving_var": np.ones(2, "f")}
+    check_numeric_gradient(sym, loc, aux_states=aux, rtol=3e-2, atol=3e-3)
+
+    # aux update semantics
+    ex = sym.bind(mx.current_context(),
+                  {k: mx.nd.array(v) for k, v in loc.items()},
+                  aux_states={k: mx.nd.array(v) for k, v in aux.items()})
+    ex.forward(is_train=True)
+    mean = a.mean(axis=0)
+    var = a.var(axis=0)
+    got_mean = ex.aux_dict["bn_moving_mean"].asnumpy()
+    got_var = ex.aux_dict["bn_moving_var"].asnumpy()
+    assert_almost_equal(got_mean, 0.9 * 0.0 + 0.1 * mean, rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(got_var, 0.9 * 1.0 + 0.1 * var, rtol=1e-4,
+                        atol=1e-5)
+    # eval mode uses moving stats, not batch stats
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    expect = (a - got_mean) / np.sqrt(got_var + 1e-3) * \
+        loc["bn_gamma"] + loc["bn_beta"]
+    assert_almost_equal(out_eval, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_instancenorm_l2norm_gradient():
+    x = mx.sym.Variable("data")
+    a = _u((2, 3, 4), 0.5, 1.5, seed=29)
+    sym = mx.sym.InstanceNorm(x, mx.sym.Variable("gamma"),
+                              mx.sym.Variable("beta"), eps=1e-3)
+    loc = {"data": a, "gamma": _u((3,), 0.5, 1.5, seed=30),
+           "beta": _u((3,), -0.5, 0.5, seed=31)}
+    check_numeric_gradient(sym, loc, rtol=3e-2, atol=3e-3)
+
+    sym = mx.sym.L2Normalization(x, eps=1e-6)
+    check_numeric_gradient(sym, {"data": a}, rtol=3e-2, atol=3e-3)
+
+
+def test_embedding_take_gradient():
+    """Embedding/take backward = scatter-add into the table (reference
+    indexing_op.h EmbeddingOpBackward)."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    sym = mx.sym.Embedding(data=data, weight=w, input_dim=5, output_dim=3,
+                           name="emb")
+    idx = np.asarray([[0, 2], [4, 2]], "f")   # repeated index 2 -> grads add
+    table = _u((5, 3), seed=32)
+    check_numeric_gradient(sym, {"data": idx, "w": table},
+                           grad_nodes=["w"], rtol=2e-2, atol=2e-3)
+    # forward parity
+    check_symbolic_forward(sym, {"data": idx, "w": table},
+                           [table[idx.astype(int)]])
+
+    sym = mx.sym.take(w, data)
+    check_symbolic_forward(sym, {"w": table, "data": idx},
+                           [table[idx.astype(int)]])
+    check_numeric_gradient(sym, {"w": table, "data": idx},
+                           grad_nodes=["w"], rtol=2e-2, atol=2e-3)
+
+
+def test_one_hot_pick_forward():
+    idx = np.asarray([0, 2, 1], "f")
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.one_hot(x, depth=3), {"x": idx},
+                           [np.eye(3, dtype="f")[idx.astype(int)]])
+    a = _u((3, 4), seed=33)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.pick(data, x, axis=1)
+    check_symbolic_forward(sym, {"data": a, "x": np.asarray([1, 0, 3], "f")},
+                           [a[np.arange(3), [1, 0, 3]]])
+
+
+# ---------------------------------------------------------------------------
+# loss layers: custom backward conventions (the reference's semantics that
+# jax.vjp would NOT give automatically)
+# ---------------------------------------------------------------------------
+
+def test_softmax_output_grad_convention():
+    """SoftmaxOutput backward = (p - onehot(label)) * grad_scale, ignoring
+    the incoming head gradient (softmax_output-inl.h)."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data, label, grad_scale=2.0, name="sm")
+    a = _u((3, 4), seed=34)
+    lab = np.asarray([1, 0, 3], "f")
+    p = np.exp(a - a.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expect = p.copy()
+    expect[np.arange(3), lab.astype(int)] -= 1.0
+    expect *= 2.0
+    # head grads of ones must be IGNORED (replaced by the convention)
+    check_symbolic_backward(sym, {"data": a, "label": lab},
+                            [np.full((3, 4), 7.7, "f")],
+                            {"data": expect}, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_ignore_label_multi_output():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data, label, multi_output=True,
+                               use_ignore=True, ignore_label=-1,
+                               name="sm")
+    a = _u((2, 3, 4), seed=35)          # (B, C, A): per-position softmax
+    lab = np.asarray([[0, -1, 2, 1], [-1, 1, 1, -1]], "f")
+    grads = check_symbolic_backward(
+        sym, {"data": a, "label": lab}, [np.ones_like(a)],
+        {}, rtol=1e-4, atol=1e-5)
+    g = grads["data"]
+    assert np.abs(g[0, :, 1]).max() == 0          # ignored positions
+    assert np.abs(g[1, :, 0]).max() == 0
+    assert np.abs(g[0, :, 0]).max() > 0
+
+
+def test_regression_outputs_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    a = _u((3, 4), seed=36)
+    lab = _u((3, 4), seed=37)
+    # Linear: grad = (pred - label) / num_output
+    check_symbolic_backward(
+        mx.sym.LinearRegressionOutput(data, label), {"data": a, "label": lab},
+        [np.ones_like(a)], {"data": (a - lab) / 4.0})
+    # Logistic: grad = (sigmoid(pred) - label) / num_output
+    s = 1 / (1 + np.exp(-a))
+    check_symbolic_backward(
+        mx.sym.LogisticRegressionOutput(data, label),
+        {"data": a, "label": lab},
+        [np.ones_like(a)], {"data": (s - lab) / 4.0})
+    # MAE: grad = sign(pred - label) / num_output
+    check_symbolic_backward(
+        mx.sym.MAERegressionOutput(data, label), {"data": a, "label": lab},
+        [np.ones_like(a)], {"data": np.sign(a - lab) / 4.0})
+
+
+def test_makeloss_blockgrad():
+    x = mx.sym.Variable("x")
+    a = _u((3, 4), 0.5, 1.5, seed=38)
+    # MakeLoss: forward = data, backward = grad_scale (not head grad)
+    check_symbolic_backward(mx.sym.MakeLoss(x, grad_scale=0.5), {"x": a},
+                            [np.full_like(a, 9.9)],
+                            {"x": np.full_like(a, 0.5)})
+    # BlockGrad: zero gradient
+    check_symbolic_backward(mx.sym.BlockGrad(x) * 2.0, {"x": a},
+                            [np.ones_like(a)], {"x": np.zeros_like(a)})
+
+
+def test_softmax_cross_entropy():
+    x = mx.sym.Variable("x")
+    label = mx.sym.Variable("label")
+    a = _u((3, 4), seed=39)
+    lab = np.asarray([1, 3, 0], "f")
+    p = np.exp(a - a.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expect = -np.log(p[np.arange(3), lab.astype(int)]).sum(keepdims=True)
+    check_symbolic_forward(mx.sym.softmax_cross_entropy(x, label),
+                           {"x": a, "label": lab}, [expect], rtol=1e-4)
+
+
+def test_svm_output_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    a = _u((2, 3), seed=40)
+    lab = np.asarray([0, 2], "f")
+    sym = mx.sym.SVMOutput(data, label, margin=1.0,
+                           regularization_coefficient=1.0)
+    out = check_symbolic_forward(sym, {"data": a, "label": lab}, [a])
+    grads = check_symbolic_backward(sym, {"data": a, "label": lab},
+                                    [np.ones_like(a)], {})
+    assert np.isfinite(grads["data"]).all()
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (sequence_{last,mask,reverse}.cc)
+# ---------------------------------------------------------------------------
+
+def test_sequence_ops():
+    # data layout (T, N, C)
+    a = _u((4, 2, 3), seed=41)
+    length = np.asarray([2, 4], "f")
+    data = mx.sym.Variable("data")
+    seq_len = mx.sym.Variable("len")
+
+    sym = mx.sym.SequenceLast(data, seq_len, use_sequence_length=True)
+    expect = np.stack([a[1, 0], a[3, 1]])
+    check_symbolic_forward(sym, {"data": a, "len": length}, [expect])
+    check_numeric_gradient(sym, {"data": a, "len": length},
+                           grad_nodes=["data"], rtol=2e-2, atol=2e-3)
+
+    sym = mx.sym.SequenceMask(data, seq_len, use_sequence_length=True,
+                              value=0.0)
+    expect = a.copy()
+    expect[2:, 0] = 0.0
+    check_symbolic_forward(sym, {"data": a, "len": length}, [expect])
+    check_numeric_gradient(sym, {"data": a, "len": length},
+                           grad_nodes=["data"], rtol=2e-2, atol=2e-3)
+
+    sym = mx.sym.SequenceReverse(data, seq_len, use_sequence_length=True)
+    expect = a.copy()
+    expect[:2, 0] = a[:2, 0][::-1]
+    expect[:, 1] = a[:, 1][::-1]
+    check_symbolic_forward(sym, {"data": a, "len": length}, [expect])
+    check_numeric_gradient(sym, {"data": a, "len": length},
+                           grad_nodes=["data"], rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# vision/legacy layers
+# ---------------------------------------------------------------------------
+
+def test_upsampling_crop_gradient():
+    x = mx.sym.Variable("data")
+    a = _u((1, 2, 3, 3), seed=42)
+    sym = mx.sym.UpSampling(x, scale=2, sample_type="nearest")
+    check_symbolic_forward(sym, {"data": a},
+                           [a.repeat(2, axis=2).repeat(2, axis=3)])
+    check_numeric_gradient(sym, {"data": a}, rtol=2e-2, atol=2e-3)
+
+    big = mx.sym.Variable("data")
+    sym = mx.sym.Crop(big, offset=(1, 1), h_w=(2, 2))
+    b = _u((1, 2, 4, 4), seed=43)
+    check_symbolic_forward(sym, {"data": b}, [b[:, :, 1:3, 1:3]])
+    check_numeric_gradient(sym, {"data": b}, rtol=2e-2, atol=2e-3)
+
+
+def test_dropout_modes():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Dropout(x, p=0.5)
+    a = _u((4, 5), 0.5, 1.5, seed=44)
+    # eval mode: identity
+    ex = sym.bind(mx.current_context(), {"data": mx.nd.array(a)})
+    assert_almost_equal(ex.forward(is_train=False)[0].asnumpy(), a)
+    # train mode: inverted dropout — surviving values scaled by 1/(1-p)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mask = out != 0
+    assert_almost_equal(out[mask], a[mask] * 2.0, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# grad_req='add' accumulation (reference inplace_addto_detect_pass /
+# test_operator.py grad_req cases)
+# ---------------------------------------------------------------------------
+
+def test_grad_req_add_accumulates():
+    x = mx.sym.Variable("x")
+    sym = 2.0 * x
+    a = _u((3, 4), seed=45)
+    ga = mx.nd.array(np.full((3, 4), 0.5, "f"))
+    ex = sym.bind(mx.current_context(), {"x": mx.nd.array(a)},
+                  args_grad={"x": ga}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(np.ones((3, 4), "f"))])
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(np.ones((3, 4), "f"))])
+    # 0.5 initial + 2.0 + 2.0
+    assert_almost_equal(ga.asnumpy(), np.full((3, 4), 4.5, "f"))
+
+
+def test_grad_req_null_skips():
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    sym = mx.sym.broadcast_mul(x, w)
+    a, b = _u((2, 3), seed=46), _u((1, 3), seed=47)
+    gw = mx.nd.array(np.zeros((1, 3), "f"))
+    ex = sym.bind(mx.current_context(),
+                  {"x": mx.nd.array(a), "w": mx.nd.array(b)},
+                  args_grad={"w": gw}, grad_req={"x": "null", "w": "write"})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(np.ones((2, 3), "f"))])
+    assert_almost_equal(gw.asnumpy(), a.sum(axis=0, keepdims=True))
+
+
+# ---------------------------------------------------------------------------
+# ordering / indexing forward oracles
+# ---------------------------------------------------------------------------
+
+def test_ordering_ops_forward():
+    a = _u((3, 5), seed=48)
+    x = mx.sym.Variable("x")
+    check_symbolic_forward(mx.sym.sort(x, axis=1), {"x": a},
+                           [np.sort(a, axis=1)])
+    check_symbolic_forward(mx.sym.argsort(x, axis=1), {"x": a},
+                           [np.argsort(a, axis=1,
+                                       kind="stable").astype("f")])
+    topk = mx.sym.topk(x, axis=1, k=2, ret_typ="value")
+    check_symbolic_forward(topk, {"x": a},
+                           [np.sort(a, axis=1)[:, ::-1][:, :2]])
+    bt = mx.sym.batch_take(x, mx.sym.Variable("i"))
+    check_symbolic_forward(bt, {"x": a, "i": np.asarray([1, 0, 4], "f")},
+                           [a[np.arange(3), [1, 0, 4]]])
+
+
+# ---------------------------------------------------------------------------
+# cpu-vs-default-device consistency (the reference's gpu test axis,
+# tests/python/gpu/test_operator_gpu.py check_consistency)
+# ---------------------------------------------------------------------------
+
+def test_check_consistency_conv_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    check_consistency(net, [
+        {"ctx": mx.cpu(0), "shapes": {"data": (4, 2, 8, 8),
+                                      "softmax_label": (4,)}},
+        {"ctx": mx.current_context(), "shapes": {"data": (4, 2, 8, 8),
+                                                 "softmax_label": (4,)}},
+    ], rtol=1e-3, atol=1e-4)
+
+
+def test_check_consistency_elementwise():
+    x = mx.sym.Variable("x")
+    net = mx.sym.tanh(2.0 * x + 1.0) * mx.sym.sigmoid(x)
+    check_consistency(net, [
+        {"ctx": mx.cpu(0), "shapes": {"x": (3, 7)}},
+        {"ctx": mx.current_context(), "shapes": {"x": (3, 7)}},
+    ])
