@@ -39,21 +39,12 @@ from .ops.registry import OpDef
 __all__ = ["Executor"]
 
 
-@functools.lru_cache(maxsize=2048)
-def _sig_info(fn):
-    params = inspect.signature(fn).parameters
-    has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
-                     for p in params.values())
-    names = frozenset(p.name for p in params.values()
-                      if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
-                                    inspect.Parameter.KEYWORD_ONLY))
-    return names, has_var_kw
-
-
 def _filter_attrs(op, attrs):
     """Keep only attrs the op function accepts (graph nodes also carry
-    framework attrs like ctx_group / lr_mult)."""
-    names, has_var_kw = _sig_info(op.fn)
+    framework attrs like ctx_group / lr_mult).  Unknown USER attrs were
+    already rejected at symbol-creation time (OpDef.validate_attrs)."""
+    from .ops.registry import fn_signature_info
+    names, has_var_kw = fn_signature_info(op.fn)
     if has_var_kw:
         return dict(attrs)
     return {k: v for k, v in attrs.items() if k in names}
@@ -80,16 +71,26 @@ def _node_plan(symbol):
     return plan
 
 
-def _build_eval(symbol):
+def _build_eval(symbol, placement=None):
     """Return eval_fn(args_dict, aux_dict, rng, is_train) ->
-    (outputs_list, aux_updates_dict).  Pure — jit/vjp-able."""
+    (outputs_list, aux_updates_dict).  Pure — jit/vjp-able.
+
+    ``placement`` (id(node) -> jax device) activates group2ctx model
+    parallelism: every node's inputs are committed to its group's device
+    before dispatch — the reference's PlaceDevice pass inserting
+    _CrossDeviceCopy at group boundaries (graph_executor.cc:242-331),
+    expressed as jax.device_put (whose vjp transposes to a device_put of
+    the cotangent back across the same boundary).  Placement-active graphs
+    run eagerly per-op, the reference's own dispatch model."""
     plan = _node_plan(symbol)
     out_refs = [(id(n), i) for n, i in symbol._outputs]
+    placement = placement or {}
 
     def eval_fn(args, aux, rng, is_train, monitor=None):
         env = {}
         aux_updates = {}
         for node, call_attrs, n_out, aux_var_names, _ in plan:
+            dev = placement.get(id(node))
             if node.op is None:
                 if node.name in args:
                     val = args[node.name]
@@ -97,9 +98,13 @@ def _build_eval(symbol):
                     val = aux[node.name]
                 else:
                     raise MXNetError("unbound variable %r" % node.name)
+                if dev is not None:
+                    val = jax.device_put(val, dev)
                 env[id(node)] = (val,)
                 continue
             ins = [env[id(src)][idx] for src, idx in node.inputs]
+            if dev is not None:
+                ins = [jax.device_put(x, dev) for x in ins]
             kw = {}
             if node.op.needs_is_train:
                 kw["is_train"] = is_train
@@ -146,15 +151,33 @@ class Executor(object):
             n for n in arg_names
             if self.grad_req.get(n, "null") != "null" and n in self.grad_dict)
 
-        self._eval = _build_eval(symbol)
+        # group2ctx model parallelism: resolve each node's ctx_group to a
+        # device; active only when ≥2 distinct devices result (a single
+        # device degenerates to the normal fused path)
+        placement = {}
+        if self._group2ctx:
+            for node in symbol._nodes():
+                grp = node.attrs.get("ctx_group")
+                c = self._group2ctx.get(grp) if grp else None
+                placement[id(node)] = (c if c is not None
+                                       else self._ctx).jax_device
+            if len(set(placement.values())) <= 1:
+                placement = {}
+        self._placement = placement
+
+        self._eval = _build_eval(symbol, placement=placement or None)
         # graphs holding host-callback ops (Custom) can only be whole-graph
         # jitted if the backend supports callbacks under jit; otherwise run
         # eagerly — the reference likewise executes CustomOp host-side
-        # between kernel launches (src/operator/custom/custom-inl.h)
+        # between kernel launches (src/operator/custom/custom-inl.h).
+        # Multi-device group2ctx placement also runs eagerly: one XLA
+        # program compiles for one device, while eager ops dispatch on
+        # their (committed) input devices.
         has_no_jit = any(n.op is not None and getattr(n.op, "no_jit", False)
                          for n in symbol._nodes())
         from .ops.registry import callbacks_under_jit_supported
-        use_jit = not has_no_jit or callbacks_under_jit_supported()
+        use_jit = (not has_no_jit or callbacks_under_jit_supported()) \
+            and not placement
         _maybe_jit = jax.jit if use_jit else (lambda f: f)
         self._jit_fwd = _maybe_jit(
             lambda a, x, r: self._eval(a, x, r, False)[0])

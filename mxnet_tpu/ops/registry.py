@@ -31,18 +31,41 @@ __all__ = ["OpDef", "register", "get_op", "list_ops", "OP_REGISTRY", "apply_op"]
 OP_REGISTRY = {}
 
 
+# attrs the framework itself attaches to nodes (AttrScope / optimizer
+# multipliers / graph plumbing) — always allowed alongside op params
+FRAMEWORK_ATTRS = frozenset({
+    "ctx_group", "lr_mult", "wd_mult", "force_mirroring", "mirror_stage",
+    "num_args",
+})
+
+
+@functools.lru_cache(maxsize=2048)
+def fn_signature_info(fn):
+    """(keyword-accepting param names, has **kwargs) of a lowering fn —
+    shared by attr validation here and executor._filter_attrs."""
+    import inspect
+    params = inspect.signature(fn).parameters
+    has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in params.values())
+    names = frozenset(p.name for p in params.values()
+                      if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                                    inspect.Parameter.KEYWORD_ONLY))
+    return names, has_var_kw
+
+
 class OpDef(object):
     __slots__ = (
         "name", "fn", "input_names", "aux_names", "num_outputs",
         "infer_shape", "needs_is_train", "needs_rng", "variable_inputs",
         "aliases", "output_names", "hidden", "param_indices", "doc",
-        "no_jit",
+        "no_jit", "extra_attrs", "_accepted",
     )
 
     def __init__(self, name, fn, input_names=("data",), aux_names=(),
                  num_outputs=1, infer_shape=None, needs_is_train=False,
                  needs_rng=False, variable_inputs=False, aliases=(),
-                 output_names=None, hidden=False, no_jit=False):
+                 output_names=None, hidden=False, no_jit=False,
+                 extra_attrs=()):
         self.name = name
         self.fn = fn
         self.input_names = input_names          # tuple | callable(attrs)->tuple
@@ -56,6 +79,8 @@ class OpDef(object):
         self.output_names = output_names        # tuple | callable(attrs)->tuple
         self.hidden = hidden
         self.no_jit = no_jit    # host-callback ops: run eagerly, never jit
+        self.extra_attrs = tuple(extra_attrs)  # attrs consumed outside fn
+        self._accepted = None   # lazy cache for accepted_attrs()
         self.doc = fn.__doc__
 
     # -- resolved-per-attrs accessors ------------------------------------
@@ -83,6 +108,49 @@ class OpDef(object):
     def normalize_attrs(self, attrs):
         """Parse string attr values into typed python values."""
         return {k: parse_attr_value(v) for k, v in attrs.items()}
+
+    def accepted_attrs(self):
+        """The op's declared parameter surface (the dmlc::Parameter schema
+        analog: kwargs of the lowering function plus declared extra_attrs,
+        minus tensor inputs/aux and the is_train/rng specials), or None
+        when the function takes **kwargs."""
+        if self._accepted is None:
+            names, has_var_kw = fn_signature_info(self.fn)
+            if has_var_kw:
+                self._accepted = "any"
+            else:
+                drop = {"is_train", "rng"}
+                try:
+                    drop |= set(self.get_input_names({}))
+                    drop |= set(self.get_aux_names({}))
+                except Exception:  # noqa: BLE001 — attr-dependent callables
+                    pass
+                self._accepted = frozenset(
+                    (names | set(self.extra_attrs)) - drop)
+        return None if self._accepted == "any" else self._accepted
+
+    def validate_attrs(self, attrs, where="op call"):
+        """Reject unknown parameters instead of silently dropping them —
+        dmlc::Parameter semantics (the reference errors on a typo'd
+        ``kernal=(3,3)``; src/operator/optimizer_op-inl.h:25-45).
+        Framework attrs and ``__dunder__`` user attrs always pass."""
+        accepted = self.accepted_attrs()
+        if accepted is None:
+            return
+        bad = [k for k in attrs
+               if k not in accepted and k not in FRAMEWORK_ATTRS
+               and not (k.startswith("__") and k.endswith("__"))]
+        if bad:
+            import difflib
+            hints = []
+            for k in bad:
+                close = difflib.get_close_matches(k, sorted(accepted), n=1)
+                hints.append("%r%s" % (k, (" (did you mean %r?)" % close[0])
+                                       if close else ""))
+            raise MXNetError(
+                "%s %s: unknown parameter(s) %s; accepted parameters: %s"
+                % (self.name, where, ", ".join(hints),
+                   ", ".join(sorted(accepted))))
 
     def __repr__(self):
         return "OpDef(%s)" % self.name
@@ -176,7 +244,13 @@ def apply_op(op, arrays, attrs, is_train=False, rng=None):
 
     Returns a tuple of jax.Arrays (outputs, then updated aux if any).
     """
+    op.validate_attrs(attrs, where="imperative call")
     attrs = op.normalize_attrs(attrs)
+    accepted = op.accepted_attrs()
+    if accepted is not None:
+        # framework attrs (ctx_group/lr_mult/...) validated above but not
+        # consumed by the lowering fn
+        attrs = {k: v for k, v in attrs.items() if k in accepted}
     with_rng = op.needs_rng
     # is_train only keys the cache for ops whose behavior depends on it —
     # otherwise autograd's train-mode default would double-compile every op

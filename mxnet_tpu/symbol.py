@@ -487,6 +487,7 @@ def _create(op_name, input_syms, attrs, name=None, extra_attr=None,
     named ``{node}_{input}`` — the reference's auto-created weight/bias/aux
     variables (python/mxnet/symbol.py compose)."""
     op = get_op(op_name)
+    op.validate_attrs(attrs, where="symbol")
     norm = op.normalize_attrs(attrs)
     hint = op.name.lstrip("_").lower()
     node_name = _name_mod.current().get(name, hint)

@@ -172,3 +172,59 @@ def test_rnn_cell_gradients():
     ex.forward(is_train=True)
     ex.backward()
     assert np.abs(grads["params"].asnumpy()).sum() > 0
+
+
+def test_group2ctx_model_parallel():
+    """group2ctx places op groups on distinct devices with transfers at
+    boundaries, numerically identical to the single-device run (reference
+    tests/python/unittest/test_model_parallel.py:16-31 on two fake
+    devices)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def build():
+        data = mx.sym.Variable("data")
+        with mx.AttrScope(ctx_group="dev1"):
+            fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+            act1 = mx.sym.Activation(fc1, act_type="tanh")
+        with mx.AttrScope(ctx_group="dev2"):
+            fc2 = mx.sym.FullyConnected(act1, num_hidden=4, name="fc2")
+            out = mx.sym.sum(mx.sym.square(fc2))
+        return out
+
+    shapes = {"data": (3, 5)}
+    rs = np.random.RandomState(0)
+    net = build()
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    vals = {n: rs.uniform(-1, 1, s).astype("f")
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+
+    def run(group2ctx, ctx):
+        args = {k: mx.nd.array(v, ctx=ctx) for k, v in vals.items()}
+        grads = {k: mx.nd.zeros(v.shape, ctx=ctx) for k, v in vals.items()}
+        ex = net.bind(ctx, args, args_grad=grads, group2ctx=group2ctx)
+        out = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward()
+        return out, {k: g.asnumpy() for k, g in grads.items()}, ex
+
+    out_ref, grads_ref, _ = run(None, mx.cpu(0))
+    out_mp, grads_mp, ex = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+                               mx.cpu(0))
+    assert ex._placement, "placement should be active on two devices"
+    np.testing.assert_allclose(out_mp, out_ref, rtol=1e-5, atol=1e-6)
+    for k in grads_ref:
+        np.testing.assert_allclose(grads_mp[k], grads_ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+    # outputs of the dev2 group are committed to cpu(1)
+    dev = next(iter(ex.outputs[0]._data.devices()))
+    assert dev == mx.cpu(1).jax_device, dev
+
+
+def test_group2ctx_single_device_degenerates():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = net.simple_bind(mx.cpu(0), data=(2, 3),
+                         group2ctx={"dev1": mx.cpu(0)})
+    assert not ex._placement

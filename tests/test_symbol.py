@@ -134,3 +134,20 @@ def test_variable_inputs_concat():
     assert out_shapes == [(2, 8)]
     ex = c.bind(mx.cpu(), {"a": mx.nd.ones((2, 3)), "b": mx.nd.zeros((2, 5))})
     assert ex.forward()[0].shape == (2, 8)
+
+
+def test_unknown_op_param_rejected():
+    """Typo'd op kwargs raise instead of vanishing (dmlc::Parameter
+    semantics; the reference rejects kernal=(3,3))."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    data = mx.sym.Variable("data")
+    with pytest.raises(MXNetError, match="kernal.*did you mean.*kernel"):
+        mx.sym.Convolution(data, kernal=(3, 3), num_filter=4)
+    with pytest.raises(MXNetError, match="unknown parameter"):
+        mx.sym.FullyConnected(data, num_hidden=4, bogus_flag=1)
+    # framework attrs and dunder user attrs still pass
+    with mx.AttrScope(ctx_group="g"):
+        s = mx.sym.FullyConnected(data, num_hidden=4, name="fc",
+                                  attr={"__myattr__": "x"})
+    assert s is not None
