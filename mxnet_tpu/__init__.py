@@ -65,6 +65,7 @@ from . import executor_manager
 from . import parallel
 from . import autograd
 from . import contrib
+from . import rtc
 # both addressing styles work: mx.contrib.symbol.X (the reference's v0.9.5
 # layout) and mx.sym.contrib.X / mx.nd.contrib.X (later-API convenience)
 symbol.contrib = contrib.symbol

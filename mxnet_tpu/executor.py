@@ -22,9 +22,6 @@ compiled function with those heads.
 """
 from __future__ import annotations
 
-import functools
-import inspect
-
 import numpy as np
 
 import jax

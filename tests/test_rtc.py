@@ -1,0 +1,97 @@
+"""Pallas kernel escape hatch tests (the reference's tests/python/gpu/
+test_rtc.py role: user kernels runnable through the framework).  On the
+CPU test backend pallas runs in interpreter mode — same code path users
+ship to TPU."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_register_pallas_kernel_nd_and_sym():
+    def body(in_ref, out_ref):
+        out_ref[...] = in_ref[...] * 2.0 + 1.0
+
+    fn = mx.rtc.elementwise_pallas_kernel(body)
+
+    # pallas_call does not support reverse-mode AD; the escape hatch pairs
+    # the kernel with its hand-written vjp (pallas_guide.md "Custom VJP")
+    @mx.rtc.register_kernel("rtc_scale_shift",
+                            vjp=lambda x, g: (2.0 * g,))
+    def rtc_scale_shift(data):
+        return fn(data)
+
+    x = np.random.RandomState(0).rand(8, 16).astype("f")
+    # imperative
+    y = mx.nd.rtc_scale_shift(mx.nd.array(x))
+    np.testing.assert_allclose(y.asnumpy(), x * 2 + 1, rtol=1e-6)
+    # symbolic — participates in the executor graph like any op
+    data = mx.sym.Variable("data")
+    net = mx.sym.sum(mx.sym.rtc_scale_shift(data))
+    ex = net.simple_bind(mx.current_context(), data=(8, 16))
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, (x * 2 + 1).sum(), rtol=1e-5)
+    # autograd through the pallas kernel (d/dx of sum(2x+1) = 2)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full_like(x, 2.0), rtol=1e-6)
+
+
+def test_register_kernel_custom_vjp():
+    @mx.rtc.register_kernel(
+        "rtc_cube", vjp=lambda x, g: (3.0 * x * x * g,))
+    def rtc_cube(data):
+        return data ** 3
+
+    x = np.asarray([[1.0, 2.0], [3.0, 0.5]], "f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.sum(mx.sym.rtc_cube(data))
+    ex = net.simple_bind(mx.current_context(), data=(2, 2))
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), 3 * x * x,
+                               rtol=1e-5)
+
+
+def test_register_kernel_duplicate_rejected():
+    with pytest.raises(mx.MXNetError, match="already registered"):
+        mx.rtc.register_kernel("relu")(lambda data: data)
+
+
+def test_mxrtc_parity_class():
+    def kernel(x, y):
+        return x * y + 1.0
+
+    a = mx.nd.array(np.full((4, 4), 3.0, "f"))
+    b = mx.nd.array(np.full((4, 4), 2.0, "f"))
+    out = mx.nd.zeros((4, 4))
+    rtc = mx.rtc.MXRtc("mul1", [("a", a), ("b", b)], [("c", out)], kernel)
+    rtc.push([a, b], [out], (1, 1, 1), (4, 4, 1))
+    np.testing.assert_allclose(out.asnumpy(), np.full((4, 4), 7.0, "f"))
+
+
+def test_mxrtc_rejects_cuda_source():
+    with pytest.raises(mx.MXNetError, match="Pallas"):
+        mx.rtc.MXRtc("k", [], [], "__global__ void k() {}")
+
+
+def test_register_kernel_vjp_with_params():
+    """vjp kernels with op parameters (the docstring's advertised shape) —
+    regression for the custom_vjp kwargs binding."""
+    @mx.rtc.register_kernel("rtc_scale_p",
+                            vjp=lambda x, g, scalar=2.0: (scalar * g,))
+    def rtc_scale_p(data, scalar=2.0):
+        return data * scalar
+
+    x = np.random.RandomState(1).rand(3, 4).astype("f")
+    y = mx.nd.rtc_scale_p(mx.nd.array(x), scalar=3.0)
+    np.testing.assert_allclose(y.asnumpy(), x * 3.0, rtol=1e-6)
+    net = mx.sym.sum(mx.sym.rtc_scale_p(mx.sym.Variable("data"), scalar=3.0))
+    ex = net.simple_bind(mx.current_context(), data=(3, 4))
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full_like(x, 3.0), rtol=1e-6)
