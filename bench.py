@@ -67,12 +67,78 @@ def main():
 
     img_per_sec = batch * steps / (toc - tic)
     baseline = 109.0  # reference: ResNet-50 batch 32 on 1x K80
-    print(json.dumps({
+
+    # End-to-end mode: the RecordIO pipeline (decode+augment on engine
+    # threads) feeding the same trainer — the reference's published numbers
+    # run with its C++ RecordIO prefetcher ahead of the device
+    # (BASELINE config #2; pipeline baseline ~3,000 img/s/host,
+    # docs imagenet_full.md:37).  Reported alongside compute-only.
+    pipe_img_per_sec = None
+    if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        try:
+            pipe_img_per_sec = _pipeline_bench(trainer, batch, steps,
+                                               warmup)
+        except Exception as e:  # noqa: BLE001 — bench must still report
+            sys.stderr.write("pipeline bench skipped: %s\n" % e)
+
+    result = {
         "metric": "resnet50_train_throughput_batch%d" % batch,
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / baseline, 3),
-    }))
+    }
+    if pipe_img_per_sec is not None:
+        result["pipeline_img_s"] = round(pipe_img_per_sec, 2)
+        result["pipeline_frac_of_compute"] = round(
+            pipe_img_per_sec / img_per_sec, 3)
+    print(json.dumps(result))
+
+
+def _pipeline_bench(trainer, batch, steps, warmup):
+    """Train-step throughput with the threaded ImageRecordIter feeding
+    (decode + augment + batch assembly on host engine workers)."""
+    import tempfile
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    n_img = max(batch * 4, 256)
+    tmp = tempfile.mkdtemp(prefix="bench_rec_")
+    prefix = os.path.join(tmp, "bench")
+    rs = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(n_img):
+        img = rs.randint(0, 255, (256, 256, 3)).astype(np.uint8)
+        header = recordio.IRHeader(0, float(rs.randint(0, 1000)), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=90))
+    rec.close()
+
+    # dtype=bfloat16: cast on host so H2D moves half the bytes
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+        rand_crop=True, rand_mirror=True, preprocess_threads=8,
+        prefetch_buffer=8, dtype="bfloat16")
+
+    def batches():
+        while True:
+            it.reset()
+            for b in it:
+                yield b
+
+    gen = batches()
+    for _ in range(warmup):
+        b = next(gen)
+        trainer.step(b.data[0], b.label[0])
+    jax.block_until_ready(trainer.params)
+
+    tic = time.time()
+    for _ in range(steps):
+        b = next(gen)
+        trainer.step(b.data[0], b.label[0])
+    jax.block_until_ready(trainer.params)
+    return batch * steps / (time.time() - tic)
 
 
 if __name__ == "__main__":

@@ -59,6 +59,14 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     global _INITIALIZED
     if _INITIALIZED:
         return
+    if coordinator_address is None:
+        # implicit env-driven auto-init: spawned helper processes
+        # (data-pipeline decode workers) inherit the launcher's MXTPU_*
+        # envs but must never join the process group.  Explicit-argument
+        # calls (user-managed multiprocessing ranks) are honored anywhere.
+        import multiprocessing
+        if multiprocessing.current_process().name != "MainProcess":
+            return
     coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
     if num_processes is None:
         num_processes = int(os.environ.get(ENV_NUM_WORKERS, "0") or 0)

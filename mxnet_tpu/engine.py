@@ -357,23 +357,44 @@ class Engine(object):
             engine_type = get_env("MXNET_ENGINE_TYPE", "ThreadedEngine")
         naive = "naive" in engine_type.lower()
         if not force_python and native.get_lib() is not None:
-            return _NativeEngine(naive=naive, num_workers=num_workers)
-        return _PythonEngine(naive=naive, num_workers=num_workers)
+            inst = _NativeEngine(naive=naive, num_workers=num_workers)
+        else:
+            inst = _PythonEngine(naive=naive, num_workers=num_workers)
+        _track(inst)
+        return inst
 
 
 _engine = None
-_engine_lock = threading.Lock()
+_engine_lock = threading.RLock()
+_all_engines = None
+_atexit_registered = False
+
+
+def _track(inst):
+    """Every engine (incl. private ones owned by data iterators) must be
+    drained and stopped before interpreter teardown — native workers left
+    running abort the process ('terminate called ...')."""
+    global _all_engines, _atexit_registered
+    import weakref
+    with _engine_lock:
+        if _all_engines is None:
+            _all_engines = weakref.WeakSet()
+        _all_engines.add(inst)
+        if not _atexit_registered:
+            import atexit
+            atexit.register(_shutdown_global)
+            _atexit_registered = True
 
 
 def _shutdown_global():
     global _engine
     with _engine_lock:
-        if _engine is not None:
+        for eng in list(_all_engines or ()):
             try:
-                _engine.shutdown()
+                eng.shutdown()
             except Exception:
                 pass
-            _engine = None
+        _engine = None
 
 
 def get():
@@ -383,11 +404,6 @@ def get():
         with _engine_lock:
             if _engine is None:
                 _engine = Engine()
-                # Drain + stop worker threads before interpreter teardown:
-                # a native worker invoking a ctypes callback into a
-                # finalizing interpreter is undefined behavior.
-                import atexit
-                atexit.register(_shutdown_global)
     return _engine
 
 

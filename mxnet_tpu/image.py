@@ -24,7 +24,7 @@ import numpy as np
 from . import io as mxio
 from . import ndarray as nd
 from . import recordio
-from .base import MXNetError
+from .base import MXNetError, get_env
 
 __all__ = [
     "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
@@ -462,6 +462,280 @@ class ImageIter(mxio.DataIter):
         return data
 
 
+# ---------------------------------------------------------------------------
+# process-pool decode workers (the fast path).  cv2 in this environment does
+# not release the GIL, so Python threads cannot scale decode+augment; worker
+# PROCESSES are the faithful analog of the reference's C++ decode thread pool
+# (iter_image_recordio_2.cc's omp parallel chunk decode).  Workers are
+# spawned (not forked — forking after XLA init is unsafe) and only touch
+# numpy/cv2.
+# ---------------------------------------------------------------------------
+
+_PP_AUG = None
+
+
+def _pp_init(data_shape, aug_kwargs, seed):
+    global _PP_AUG
+    import os as _os
+    pyrandom.seed(seed + _os.getpid())
+    np.random.seed((seed + _os.getpid()) % (2 ** 31))
+    _PP_AUG = CreateAugmenter(tuple(data_shape), **aug_kwargs)
+
+
+def _pp_work(raw, augs=None):
+    """bytes -> augmented CHW float32 (or None for an unusable image —
+    decode OR augmentation failures skip the sample, like the reference
+    parser's per-image error tolerance)."""
+    augs = _PP_AUG if augs is None else augs
+    try:
+        d = imdecode(raw)
+        for a in augs:
+            d = a(d)[0]
+        return np.ascontiguousarray(np.asarray(d, dtype=np.float32)
+                                    .transpose(2, 0, 1))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _pp_work_chunk(raws):
+    """Decode+augment a chunk of records in one IPC round trip (amortizes
+    submit/pickle overhead, like the reference's per-chunk omp decode)."""
+    return [_pp_work(r) for r in raws]
+
+
+class _ProcessPipeline(object):
+    """Reader thread + spawned decode workers + bounded batch queue."""
+
+    def __init__(self, it, data_shape, batch_size, label_width, aug_kwargs,
+                 num_workers, prefetch, dtype, allow_procs=True):
+        import concurrent.futures as cf
+        import multiprocessing as mp
+        import queue
+        import threading
+
+        self._it = it
+        self._shape = data_shape
+        self._bs = batch_size
+        self._lw = label_width
+        self._dtype = np.dtype(dtype) if dtype != "bfloat16" else dtype
+        # On hosts with spare cores, decode in worker PROCESSES; on
+        # single-core hosts (or num_workers<=1) decode inline in the reader
+        # thread — still overlapped with the consumer's device dispatch,
+        # and without IPC/context-switch overhead that a starved pool adds.
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-linux
+            cores = os.cpu_count() or 1
+        self._workers = max(1, min(num_workers, cores))
+        if not allow_procs:
+            self._workers = 1
+        if self._workers > 1:
+            # forkserver: workers fork from a clean server process — no XLA
+            # state inherited (unlike fork) and no __main__ re-execution
+            # (unlike spawn)
+            try:
+                ctx = mp.get_context("forkserver")
+            except ValueError:
+                ctx = mp.get_context("spawn")
+            self._pool = cf.ProcessPoolExecutor(
+                max_workers=self._workers, mp_context=ctx,
+                initializer=_pp_init,
+                initargs=(tuple(data_shape), dict(aug_kwargs), 0))
+            self._augs = None
+        else:
+            self._pool = None
+            self._augs = CreateAugmenter(tuple(data_shape), **aug_kwargs)
+        self._queue = queue.Queue(maxsize=max(1, prefetch))
+        self._cmd = queue.Queue()
+        self._at_end = False
+        self._stopping = False
+        self._abandon = False
+        self._failed = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._cmd.put("epoch")
+        _register_pipeline(self)
+
+    def _run(self):
+        while True:
+            cmd = self._cmd.get()
+            if cmd == "stop":
+                break
+            try:
+                self._one_epoch()
+                self._put(None)  # epoch end marker
+            except BaseException as e:  # noqa: BLE001 — surface in next()
+                if not self._stopping:
+                    self._failed = e
+                    self._put(("error", e))
+                break
+
+    def _put(self, item):
+        """Bounded put that stays interruptible for shutdown."""
+        import queue
+        while not self._stopping:
+            try:
+                self._queue.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _one_epoch(self):
+        from collections import deque
+        chunk = max(1, min(16, self._bs))
+        max_inflight = self._workers * 4
+        inflight = deque()
+        ready = []          # decoded (img, label) awaiting batch assembly
+        exhausted = False
+        while (not exhausted or inflight or ready) \
+                and not self._stopping and not self._abandon:
+            while not exhausted and len(inflight) < max_inflight:
+                raws, labs = [], []
+                for _ in range(chunk):
+                    try:
+                        lab, raw = self._it.next_raw()
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    raws.append(raw)
+                    labs.append(np.asarray(lab, dtype=np.float32))
+                if raws:
+                    if self._pool is None:
+                        inflight.append((_Done([_pp_work(r, self._augs)
+                                                for r in raws]), labs))
+                    else:
+                        inflight.append(
+                            (self._pool.submit(_pp_work_chunk, raws), labs))
+            if inflight:
+                fut, labs = inflight.popleft()
+                for img, lab in zip(fut.result(), labs):
+                    if img is not None:
+                        ready.append((img, lab))
+                while len(ready) >= self._bs:
+                    self._emit(ready[:self._bs])
+                    del ready[:self._bs]
+            elif ready:
+                self._emit(ready)
+                ready = []
+
+    def _emit(self, items):
+        c, h, w = self._shape
+        data = np.zeros((self._bs, c, h, w), np.float32)
+        lab = np.zeros((self._bs, self._lw), np.float32)
+        n = 0
+        for d, l in items:
+            data[n] = d
+            lab[n] = l
+            n += 1
+        if n == 0:
+            return
+        if self._dtype == "bfloat16":
+            import ml_dtypes
+            data = data.astype(ml_dtypes.bfloat16)  # halve the H2D bytes
+        elif self._dtype != np.float32:
+            data = data.astype(self._dtype)
+        batch = mxio.DataBatch(
+            [nd.array(data, dtype=data.dtype)],
+            [nd.array(lab[:, 0] if self._lw == 1 else lab)],
+            pad=self._bs - n)
+        self._put(batch)
+
+    @staticmethod
+    def _is_error(b):
+        return isinstance(b, tuple) and len(b) == 2 and b[0] == "error"
+
+    def next(self):
+        if self._failed is not None:
+            raise MXNetError("decode pipeline failed: %r" % (self._failed,))
+        if self._at_end:
+            raise StopIteration   # repeated next() after exhaustion
+        b = self._queue.get()
+        if b is None:
+            self._at_end = True
+            raise StopIteration
+        if self._is_error(b):
+            self._failed = b[1]
+            self._at_end = True
+            raise MXNetError("decode pipeline failed: %r" % (b[1],))
+        return b
+
+    def reset(self):
+        if self._failed is not None:
+            raise MXNetError(
+                "decode pipeline failed earlier: %r" % (self._failed,))
+        if not self._at_end:
+            # abandon the in-flight epoch (reader checks the flag per
+            # chunk) and drain to the end marker
+            self._abandon = True
+            while True:
+                b = self._queue.get()
+                if b is None:
+                    break
+                if self._is_error(b):
+                    self._failed = b[1]
+                    self._abandon = False
+                    raise MXNetError(
+                        "decode pipeline failed: %r" % (b[1],))
+            self._abandon = False
+        self._at_end = False
+        self._it.reset()
+        self._cmd.put("epoch")
+
+    def shutdown(self):
+        """Stop the reader thread BEFORE interpreter/XLA teardown — a
+        daemon thread killed mid-XLA-call aborts the process."""
+        import queue
+        self._stopping = True
+        try:
+            self._cmd.put_nowait("stop")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            while True:
+                self._queue.get_nowait()   # unblock a full-queue put
+        except queue.Empty:
+            pass
+        try:
+            self._thread.join(timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):
+        self.shutdown()
+
+
+_live_pipelines = None
+
+
+def _register_pipeline(p):
+    global _live_pipelines
+    if _live_pipelines is None:
+        import atexit
+        import weakref
+        _live_pipelines = weakref.WeakSet()
+
+        def _stop_all():
+            for pl in list(_live_pipelines):
+                pl.shutdown()
+        atexit.register(_stop_all)
+    _live_pipelines.add(p)
+
+
+class _Done(object):
+    """Immediately-resolved future (inline decode path)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
 def _translate_cxx_aug_params(kwargs):
     """Map the reference C++ iterator's parameter names
     (src/io/image_aug_default.cc: mean_r/g/b, max_random_scale, ...) onto
@@ -525,13 +799,33 @@ class ImageRecordIter(mxio.DataIter):
                  **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
         aug_kwargs = _translate_cxx_aug_params(aug_kwargs)
-        from . import engine as eng
-        self._engine = eng.Engine(num_workers=max(2, preprocess_threads))
+        has_custom_augs = "aug_list" in aug_kwargs
         self._it = ImageIter(
             batch_size, data_shape, label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx,
             shuffle=shuffle, part_index=part_index, num_parts=num_parts,
             data_name=data_name, label_name=label_name, **aug_kwargs)
+        # Fast path: spawned decode-worker processes (cv2 holds the GIL, so
+        # in-process threading cannot scale; see _ProcessPipeline).  Custom
+        # aug_list closures aren't picklable -> engine-threaded fallback,
+        # also selectable via MXNET_CPU_WORKER_NTHREADS-style env.
+        import sys as _sys
+        main_file = getattr(_sys.modules.get("__main__"), "__file__", None)
+        # worker processes re-import __main__ (standard multiprocessing
+        # contract: scripts guard with if __name__ == '__main__'); from a
+        # REPL/stdin only the inline reader-thread mode is available
+        spawnable_main = main_file is not None and os.path.exists(main_file)
+        use_pipeline = (not has_custom_augs
+                        and get_env("MXNET_RECORDITER_PROCS", "1") != "0")
+        self._pipeline = None
+        if use_pipeline:
+            self._pipeline = _ProcessPipeline(
+                self._it, tuple(data_shape), batch_size, label_width,
+                aug_kwargs, preprocess_threads, prefetch_buffer, dtype,
+                allow_procs=spawnable_main)
+        else:
+            from . import engine as eng
+            self._engine = eng.Engine(num_workers=max(2, preprocess_threads))
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
@@ -539,9 +833,10 @@ class ImageRecordIter(mxio.DataIter):
         self._prefetch = max(1, prefetch_buffer)
         self._queue = []
         self._drained = False
-        # Serializes raw record reads (the source is a sequential stream).
-        self._read_var = self._engine.new_variable()
-        self._start_prefetch()
+        if self._pipeline is None:
+            # Serializes raw record reads (the source is sequential).
+            self._read_var = self._engine.new_variable()
+            self._start_prefetch()
 
     @property
     def provide_data(self):
@@ -631,6 +926,9 @@ class ImageRecordIter(mxio.DataIter):
             self._produce_one()
 
     def reset(self):
+        if self._pipeline is not None:
+            self._pipeline.reset()
+            return
         self._engine.wait_for_all()
         self._queue = []
         self._drained = False
@@ -638,6 +936,11 @@ class ImageRecordIter(mxio.DataIter):
         self._start_prefetch()
 
     def next(self):
+        if self._pipeline is not None:
+            batch = self._pipeline.next()
+            batch.provide_data = self.provide_data
+            batch.provide_label = self.provide_label
+            return batch
         if not self._queue:
             raise StopIteration
         slot, done = self._queue.pop(0)
@@ -652,5 +955,8 @@ class ImageRecordIter(mxio.DataIter):
     __next__ = next
 
     def close(self):
+        if self._pipeline is not None:
+            self._pipeline.shutdown()
+            return
         self._engine.wait_for_all()
         self._engine.shutdown()

@@ -168,3 +168,25 @@ def test_image_record_iter_trains(rec_dataset):
             optimizer_params={"learning_rate": 0.01},
             initializer=mx.initializer.Xavier())
     it.close()
+
+
+def test_record_iter_exhaustion_and_midepoch_reset(rec_dataset):
+    """Pipeline-mode iterator: repeated next() after exhaustion raises
+    StopIteration (no hang), and reset() mid-epoch abandons the epoch."""
+    path, idx = rec_dataset
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx,
+        data_shape=(3, 32, 32), batch_size=8, preprocess_threads=2)
+    n = sum(1 for _ in it)
+    assert n == 3
+    import pytest
+    with pytest.raises(StopIteration):
+        it.next()
+    with pytest.raises(StopIteration):
+        it.next()
+    # mid-epoch reset
+    it.reset()
+    it.next()
+    it.reset()
+    assert sum(1 for _ in it) == 3
+    it.close()

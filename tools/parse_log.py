@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Parse a training log into a per-epoch table (reference
+tools/parse_log.py): epoch, train/validation metric values, speed, time
+cost.  Reads the log format emitted by Module.fit + Speedometer.
+
+Usage::
+
+    python tools/parse_log.py train.log
+    python tools/parse_log.py train.log --format csv
+"""
+import argparse
+import re
+import sys
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([\d.eE+-]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.eE+-]+)")
+SPEED = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\s*\[\d+\]\s+Speed:\s*([\d.eE+-]+)")
+
+
+def parse(lines):
+    rows = {}
+
+    def row(e):
+        return rows.setdefault(int(e), {"epoch": int(e), "speeds": []})
+
+    for line in lines:
+        m = EPOCH_METRIC.search(line)
+        if m:
+            e, kind, name, val = m.groups()
+            row(e)["%s-%s" % (kind.lower(), name)] = float(val)
+            continue
+        m = EPOCH_TIME.search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+            continue
+        m = SPEED.search(line)
+        if m:
+            row(m.group(1))["speeds"].append(float(m.group(2)))
+    out = []
+    for e in sorted(rows):
+        r = rows[e]
+        speeds = r.pop("speeds")
+        if speeds:
+            r["speed"] = sum(speeds) / len(speeds)
+        out.append(r)
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse a training log")
+    parser.add_argument("logfile")
+    parser.add_argument("--format", choices=["table", "csv"],
+                        default="table")
+    args = parser.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        sys.stderr.write("no epochs found\n")
+        return 1
+    cols = ["epoch"]
+    for r in rows:
+        for k in r:
+            if k not in cols:
+                cols.append(k)
+    if args.format == "csv":
+        print(",".join(cols))
+        for r in rows:
+            print(",".join(str(r.get(c, "")) for c in cols))
+    else:
+        widths = [max(len(c), 12) for c in cols]
+        print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+        for r in rows:
+            print("  ".join(
+                (("%.6g" % r[c]) if isinstance(r.get(c), float)
+                 else str(r.get(c, "-"))).ljust(w)
+                for c, w in zip(cols, widths)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
