@@ -1,0 +1,144 @@
+"""KVStore semantics (reference tests/python/unittest/test_kvstore.py:125):
+init/push/pull, aggregation over multiple 'device' values, list keys,
+string keys, updater installation — multi-device semantics tested without
+real multiple devices, exactly as the reference does with CPU NDArrays."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kind="local"):
+    kv = mx.kv.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(arr, x):
+    np.testing.assert_array_equal(arr.asnumpy(), np.full(SHAPE, x, "f"))
+
+
+@pytest.mark.parametrize("kind", ["local", "device"])
+def test_single_kv_pair(kind):
+    kv = init_kv(kind)
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 4)
+
+
+def test_init_requires_unique_keys():
+    kv = init_kv()
+    with pytest.raises(mx.MXNetError):
+        kv.init(3, mx.nd.ones(SHAPE))
+
+
+def test_push_unaggregated_then_pull():
+    kv = init_kv()
+    # multiple pushes accumulate into the store (no updater -> overwrite
+    # with the merged value per push, reference kvstore_local Push)
+    kv.push(3, mx.nd.ones(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * 3)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 3)
+
+
+@pytest.mark.parametrize("kind", ["local", "device"])
+def test_aggregate_over_device_values(kind):
+    """Push a LIST of values for one key = per-device grads summed
+    (reference test_kvstore.py check_aggregator)."""
+    kv = init_kv(kind)
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE)] * num_devs
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, num_devs)
+
+    # list of keys, list of per-device value lists
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2] * num_devs] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, 2 * num_devs)
+
+
+def test_updater_runs_on_merged():
+    """set_updater: optimizer runs on the merged gradient (reference
+    test_kvstore.py test_updater)."""
+    kv = init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv._set_updater(updater)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)   # merged = 4 -> stored += 8
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 8)
+    kv.push(3, mx.nd.ones(SHAPE))          # stored += 2
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 10)
+
+
+def test_str_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", mx.nd.ones(SHAPE))
+    kv.push("w0", mx.nd.ones(SHAPE) * 3)
+    out = mx.nd.empty(SHAPE)
+    kv.pull("w0", out=out)
+    check_diff_to_scalar(out, 3)
+    kv.init(["w1", "w2"], [mx.nd.zeros(SHAPE)] * 2)
+    kv.push(["w1", "w2"], [mx.nd.ones(SHAPE), mx.nd.ones(SHAPE) * 2])
+    outs = [mx.nd.empty(SHAPE), mx.nd.empty(SHAPE)]
+    kv.pull(["w1", "w2"], out=outs)
+    check_diff_to_scalar(outs[0], 1)
+    check_diff_to_scalar(outs[1], 2)
+
+
+def test_pull_to_multiple_outs():
+    """Pull broadcasts the stored value to every device copy."""
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(SHAPE) * 6)
+    outs = [mx.nd.empty(SHAPE) for _ in range(3)]
+    kv.pull(3, out=outs)
+    for o in outs:
+        check_diff_to_scalar(o, 6)
+
+
+def test_push_uninitialized_key_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push(42, mx.nd.ones(SHAPE))
+    with pytest.raises(mx.MXNetError):
+        kv.pull(42, out=mx.nd.empty(SHAPE))
+
+
+def test_optimizer_on_kvstore_states_roundtrip(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    kv.push(0, mx.nd.ones(SHAPE))
+    fname = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+
+
+def test_dist_async_rejected():
+    with pytest.raises(mx.MXNetError, match="dist_async"):
+        mx.kv.create("dist_async")
+
+
+def test_failure_detection_stance():
+    """The TPU collective runtime's failure model (SURVEY §5.3 analog of
+    ps-lite get_num_dead_node): synchronous SPMD — liveness is all-or-
+    nothing, so a healthy store reports zero dead nodes."""
+    kv = mx.kv.create("tpu")
+    assert kv.get_num_dead_node() == 0
+    assert kv.num_workers == 1
