@@ -1,0 +1,50 @@
+"""Inference throughput across the model zoo (capability port of the
+reference example/image-classification/benchmark_score.py): forward-only
+images/sec per network per batch size on the current device."""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+from common import find_mxnet  # noqa: F401
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(network, batch_size, image_shape, num_batches=20, warmup=5):
+    sym = models.get_symbol(network, num_classes=1000)
+    data_shape = (batch_size,) + image_shape
+    ex = sym.simple_bind(mx.current_context(), data=data_shape,
+                         grad_req="null")
+    rs = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = rs.uniform(-0.05, 0.05, v.shape)
+    ex.arg_dict["data"][:] = rs.rand(*data_shape)
+    for _ in range(warmup):
+        ex.forward(is_train=False)
+    ex.outputs[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        ex.forward(is_train=False)
+    ex.outputs[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="benchmark inference")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg16,inception-bn,resnet-50,"
+                                "resnet-152,googlenet,mobilenet")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    args = parser.parse_args()
+    for net in args.networks.split(","):
+        image_shape = (3, 299, 299) if net == "inception-v3" \
+            else (3, 224, 224)
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(net, b, image_shape)
+            logging.info("network: %s, batch %d: %.1f images/sec",
+                         net, b, speed)

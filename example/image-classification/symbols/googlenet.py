@@ -1,0 +1,6 @@
+"""symbols.googlenet — delegates to the mxnet_tpu model zoo (models/googlenet.py)."""
+from mxnet_tpu.models import googlenet as _m
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    return _m.get_symbol(num_classes=num_classes)

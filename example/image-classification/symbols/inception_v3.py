@@ -1,0 +1,6 @@
+"""symbols.inception_v3 — delegates to the mxnet_tpu model zoo (models/inception_v3.py)."""
+from mxnet_tpu.models import inception_v3 as _m
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    return _m.get_symbol(num_classes=num_classes)

@@ -1,0 +1,7 @@
+"""symbols.resnext — delegates to the mxnet_tpu model zoo (models/resnext.py)."""
+from mxnet_tpu.models import resnext as _m
+
+
+def get_symbol(num_classes=1000, num_layers=50, num_group=32, **kwargs):
+    return _m.get_symbol(num_classes=num_classes, num_layers=num_layers,
+                         num_group=num_group)

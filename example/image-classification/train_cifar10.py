@@ -1,0 +1,66 @@
+"""Train on CIFAR-10 (capability port of the reference
+example/image-classification/train_cifar10.py).
+
+Feed packed RecordIO via --data-train/--data-val, or run without arguments
+to use a deterministic synthetic 32x32 dataset (no network egress here).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+from common import find_mxnet, data, fit  # noqa: F401
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.DEBUG)
+
+
+def synthetic_cifar(num, num_classes=10, seed=0):
+    templates = np.random.RandomState(42).rand(num_classes, 3, 32, 32)
+    rs = np.random.RandomState(seed)
+    labels = rs.randint(0, num_classes, size=num).astype("f")
+    images = templates[labels.astype(int)] * 150
+    images += rs.randn(num, 3, 32, 32) * 30
+    return np.clip(images, 0, 255).astype(np.float32) / 255, labels
+
+
+def get_cifar_iter(args, kv):
+    if args.data_train:
+        return data.get_rec_iter(args, kv)
+    logging.warning("no --data-train; using the synthetic CIFAR set")
+    X, y = synthetic_cifar(args.num_examples, args.num_classes, seed=0)
+    Xv, yv = synthetic_cifar(2000, args.num_classes, seed=1)
+    if kv.num_workers > 1:
+        X, y = X[kv.rank::kv.num_workers], y[kv.rank::kv.num_workers]
+    train = mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, args.batch_size)
+    return (train, val)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    data.set_data_aug_level(parser, 2)
+    parser.set_defaults(
+        network="resnet",
+        num_layers=20,
+        num_classes=10,
+        num_examples=50000,
+        image_shape="3,32,32",
+        pad_size=4,
+        batch_size=128,
+        num_epochs=300,
+        lr=.05,
+        lr_step_epochs="200,250",
+    )
+    args = parser.parse_args()
+
+    from importlib import import_module
+    net = import_module("symbols." + args.network.replace("-", "_"))
+    sym = net.get_symbol(**vars(args))
+
+    fit.fit(args, sym, get_cifar_iter)

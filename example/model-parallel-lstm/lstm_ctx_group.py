@@ -1,0 +1,107 @@
+"""Model-parallel LSTM via ctx groups (capability port of the reference
+example/model-parallel-lstm/lstm.py:48-99: each LSTM layer is annotated
+with ``AttrScope(ctx_group=...)`` and bind's ``group2ctx`` places layers
+on different devices, with cross-device transfers at the boundaries).
+
+On a single-chip host run with the virtual CPU mesh::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+        python lstm_ctx_group.py --num-layers 4
+
+On a multi-chip TPU host, groups map to tpu(0)..tpu(N-1) directly.
+(For production-scale model parallelism prefer SPMDTrainer's
+param_shardings — GSPMD tensor parallelism over the mesh; ctx groups are
+the reference-parity manual-placement API.)
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+
+def build(seq_len, num_layers, num_hidden, vocab):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    with mx.AttrScope(ctx_group="embed"):
+        net = mx.sym.Embedding(data=data, input_dim=vocab,
+                               output_dim=num_hidden, name="embed")
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group="layer%d" % i):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix="lstm_l%d_" % i)
+            outputs, _ = cell.unroll(seq_len, inputs=net,
+                                     merge_outputs=True)
+            net = outputs
+    with mx.AttrScope(ctx_group="out"):
+        pred = mx.sym.Reshape(net, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(data=pred, label=label_r,
+                                    name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--vocab", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-steps", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.5)
+    args = parser.parse_args()
+
+    import jax
+    devs = jax.devices()
+    ctx_of = lambda i: mx.Context(mx.current_context().device_type,
+                                  i % len(devs))
+    group2ctx = {"embed": ctx_of(0), "out": ctx_of(len(devs) - 1)}
+    for i in range(args.num_layers):
+        group2ctx["layer%d" % i] = ctx_of(i)
+    logging.info("placement: %s", {k: str(v) for k, v in group2ctx.items()})
+
+    net = build(args.seq_len, args.num_layers, args.num_hidden, args.vocab)
+    ex = net.simple_bind(ctx_of(0), group2ctx=group2ctx,
+                         data=(args.batch_size, args.seq_len),
+                         softmax_label=(args.batch_size, args.seq_len))
+    rs = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = rs.uniform(-0.08, 0.08, v.shape)
+
+    # synthetic copy task: predict the same token shifted by one
+    toks = rs.randint(1, args.vocab, size=(args.batch_size, args.seq_len + 1))
+    x, y = toks[:, :-1].astype("f"), toks[:, 1:].astype("f")
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["softmax_label"][:] = y
+
+    param_names = [n for n in net.list_arguments()
+                   if n not in ("data", "softmax_label")]
+    for step in range(args.num_steps):
+        out = ex.forward(is_train=True)[0]
+        ex.backward()
+        for name in param_names:
+            w, g = ex.arg_dict[name], ex.grad_dict[name]
+            w._data = w._data - args.lr / x.size * g._data
+        if step % 10 == 0 or step == args.num_steps - 1:
+            p = out.asnumpy().reshape(args.batch_size, args.seq_len, -1)
+            nll = -np.log(np.maximum(
+                p[np.arange(args.batch_size)[:, None],
+                  np.arange(args.seq_len)[None, :], y.astype(int)],
+                1e-12)).mean()
+            logging.info("step %d: nll %.4f (uniform=%.4f)", step, nll,
+                         np.log(args.vocab))
+
+
+if __name__ == "__main__":
+    main()

@@ -123,6 +123,32 @@ class KVStore(object):
     def _send_command_to_servers(self, head, body):
         pass
 
+    def get_num_dead_node(self, node_id=-1, timeout=60):
+        """Failure-detection stance (the reference's ps-lite heartbeat
+        query, kvstore_dist.h:158-167, exposed uniformly on every store):
+
+        XLA collectives over ICI/DCN are synchronous SPMD — liveness is
+        all-or-nothing.  A dead worker does not degrade the cluster into a
+        smaller one (as a dead ps-lite server shard might); it fails the
+        next collective, the JAX distributed runtime surfaces the error on
+        every rank, and the job restarts from the last checkpoint (the
+        reference's practical recovery is the same: --load-epoch relaunch,
+        example fit.py:25-35).  A process able to ask this question is
+        therefore in a cluster with zero dead nodes; partial-failure
+        probing has no ICI analog.  Elastic resize = relaunch with a new
+        process count and resharded checkpoint, outside the kvstore's
+        scope.  Single-process stores trivially report 0 as well.
+        """
+        return 0
+
+    @property
+    def is_recovery(self):
+        """Restart-detection analog of ps::Postoffice::is_recovery
+        (kvstore_dist.h:39-42): always False — restarted TPU jobs rejoin
+        as a fresh cluster and resume from checkpoints, they do not
+        re-enter a live one."""
+        return False
+
 
 def _updater_key(k):
     return k if isinstance(k, int) else str(k)

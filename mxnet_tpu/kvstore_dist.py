@@ -102,29 +102,3 @@ class KVStoreTPU(KVStore):
         distributed.barrier("kvstore_barrier")
 
     _barrier = barrier
-
-    def get_num_dead_node(self, node_id=-1, timeout=60):
-        """Failure-detection stance (the reference's ps-lite heartbeat
-        query, kvstore_dist.h:158-167):
-
-        XLA collectives over ICI/DCN are synchronous SPMD — liveness is
-        all-or-nothing.  A dead worker does not degrade the cluster into a
-        smaller one (as a dead ps-lite server shard might); it fails the
-        next collective, the JAX distributed runtime surfaces the error on
-        every rank, and the job restarts from the last checkpoint (the
-        reference's practical recovery is the same: --load-epoch relaunch,
-        example fit.py:25-35).  A process able to ask this question is
-        therefore in a cluster with zero dead nodes; partial-failure
-        probing has no ICI analog.  Elastic resize = relaunch with a new
-        process count and resharded checkpoint (orbax-style), outside the
-        kvstore's scope.
-        """
-        return 0
-
-    @property
-    def is_recovery(self):
-        """Restart-detection analog of ps::Postoffice::is_recovery
-        (kvstore_dist.h:39-42): always False — restarted TPU jobs rejoin
-        as a fresh cluster and resume from checkpoints, they do not
-        re-enter a live one."""
-        return False

@@ -5,6 +5,7 @@
 reference's fit.py does (example/image-classification/common/fit.py).
 """
 from . import lenet, mlp, alexnet, vgg, resnet, inception_bn, mobilenet
+from . import googlenet, inception_v3, resnext
 from . import lstm_lm
 
 _BUILDERS = {
@@ -22,6 +23,13 @@ _BUILDERS = {
     "resnet-152": lambda num_classes=1000, **kw: resnet.get_symbol(num_classes, 152, **kw),
     "inception-bn": inception_bn.get_symbol,
     "mobilenet": mobilenet.get_symbol,
+    "googlenet": googlenet.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+    "resnext": resnext.get_symbol,
+    "resnext-50": lambda num_classes=1000, **kw: resnext.get_symbol(
+        num_classes, 50, **kw),
+    "resnext-101": lambda num_classes=1000, **kw: resnext.get_symbol(
+        num_classes, 101, **kw),
 }
 
 

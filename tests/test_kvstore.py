@@ -120,14 +120,33 @@ def test_push_uninitialized_key_raises():
 
 
 def test_optimizer_on_kvstore_states_roundtrip(tmp_path):
-    kv = mx.kv.create("local")
-    kv.init(0, mx.nd.ones(SHAPE))
-    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
-                                         momentum=0.9))
-    kv.push(0, mx.nd.ones(SHAPE))
+    """Saved momentum state restores: a reloaded store continues the same
+    SGD-with-momentum trajectory as an uninterrupted one."""
+    def make():
+        kv = mx.kv.create("local")
+        kv.init(0, mx.nd.ones(SHAPE))
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                             momentum=0.9))
+        kv.push(0, mx.nd.ones(SHAPE))
+        return kv
+
+    kv = make()
     fname = str(tmp_path / "kv.states")
     kv.save_optimizer_states(fname)
-    kv.load_optimizer_states(fname)
+    # continue uninterrupted
+    kv.push(0, mx.nd.ones(SHAPE))
+    expect = mx.nd.empty(SHAPE)
+    kv.pull(0, out=expect)
+
+    # fresh store at the same point, restored states, same next step
+    kv2 = make()
+    kv2.load_optimizer_states(fname)
+    kv2.pull(0, out=mx.nd.empty(SHAPE))
+    kv2._store[0][:] = kv2._store[0].asnumpy()  # keep weights as-is
+    kv2.push(0, mx.nd.ones(SHAPE))
+    got = mx.nd.empty(SHAPE)
+    kv2.pull(0, out=got)
+    np.testing.assert_allclose(got.asnumpy(), expect.asnumpy(), rtol=1e-6)
 
 
 def test_dist_async_rejected():

@@ -1,0 +1,51 @@
+"""Model zoo coverage (reference example/image-classification/symbols/ +
+test_score.py's role): every family builds, infers shapes end-to-end, and
+the small ones run a forward pass."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+# (name, input shape, num_classes)
+ZOO = [
+    ("mlp", (2, 1, 28, 28), 10),
+    ("lenet", (2, 1, 28, 28), 10),
+    ("alexnet", (2, 3, 224, 224), 1000),
+    ("vgg16", (2, 3, 224, 224), 1000),
+    ("resnet-18", (2, 3, 224, 224), 1000),
+    ("resnet-50", (2, 3, 224, 224), 1000),
+    ("resnext-50", (2, 3, 224, 224), 1000),
+    ("inception-bn", (2, 3, 224, 224), 1000),
+    ("googlenet", (2, 3, 224, 224), 1000),
+    ("inception-v3", (2, 3, 299, 299), 1000),
+    ("mobilenet", (2, 3, 224, 224), 1000),
+]
+
+
+@pytest.mark.parametrize("name,shape,ncls", ZOO, ids=[z[0] for z in ZOO])
+def test_zoo_builds_and_infers(name, shape, ncls):
+    sym = models.get_symbol(name, num_classes=ncls)
+    args = sym.list_arguments()
+    assert "data" in args and "softmax_label" in args
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(data=shape)
+    assert out_shapes[0] == (shape[0], ncls)
+    assert all(s is not None for s in arg_shapes)
+
+
+@pytest.mark.parametrize("name,shape,ncls",
+                         [z for z in ZOO if z[0] in
+                          ("mlp", "lenet", "googlenet", "resnext-50")],
+                         ids=["mlp", "lenet", "googlenet", "resnext-50"])
+def test_zoo_forward(name, shape, ncls):
+    sym = models.get_symbol(name, num_classes=ncls)
+    shape = (1,) + shape[1:]
+    ex = sym.simple_bind(mx.cpu(0), data=shape, grad_req="null")
+    rs = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v[:] = rs.uniform(-0.05, 0.05, v.shape)
+    ex.arg_dict["data"][:] = rs.rand(*shape)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, ncls)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
