@@ -16,9 +16,17 @@ Components:
 - spmd_module.py: SPMDModule — Module-API adapter over SPMDTrainer
 - ring_attention.py: ring attention over the 'sp' axis (sequence/context
   parallelism — capability beyond the reference, SURVEY §5.7)
+- pipeline.py: GPipe-style microbatch pipeline over the 'pp' axis
+  (shard_map + ppermute neighbor exchange)
+- moe.py: GShard-style top-2 mixture-of-experts over the 'ep' axis
+  (dispatch/combine einsums -> all_to_all under GSPMD)
 """
 from .mesh import build_mesh, default_mesh, local_mesh
 from .trainer import SPMDTrainer
 from .spmd_module import SPMDModule
 from . import ring_attention
 from .ring_attention import ring_attention as ring_attention_fn
+from . import pipeline
+from .pipeline import pipeline_apply, stack_stage_params
+from . import moe
+from .moe import moe_ffn, moe_init, moe_shardings

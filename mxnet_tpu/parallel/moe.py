@@ -1,0 +1,103 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+New capability beyond the reference (SURVEY §2.3: "Expert parallelism:
+NO").  GShard-style top-2 routed FFN: a gating matmul scores tokens, each
+token is dispatched to its top experts within a per-expert capacity, the
+expert FFNs run as one batched (E, C, d) einsum whose E axis is sharded
+over 'ep' — GSPMD turns the dispatch/combine einsums into all_to_all over
+ICI — and combine weights re-mix the expert outputs.
+
+Pattern references: GShard (Lepikhin et al. 2020), Switch Transformer —
+see PAPERS.md.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["moe_init", "moe_ffn", "moe_shardings"]
+
+
+def moe_init(rng, d_model, d_hidden, num_experts, dtype=jnp.float32):
+    """Parameters: gate (d, E), w1 (E, d, h), b1 (E, h), w2 (E, h, d),
+    b2 (E, d)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1 = 1.0 / np.sqrt(d_model)
+    s2 = 1.0 / np.sqrt(d_hidden)
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts), dtype) * s1,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden),
+                                dtype) * s1,
+        "b1": jnp.zeros((num_experts, d_hidden), dtype),
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model),
+                                dtype) * s2,
+        "b2": jnp.zeros((num_experts, d_model), dtype),
+    }
+
+
+def moe_shardings(axis="ep"):
+    """PartitionSpecs for moe_init params: experts sharded over ``axis``."""
+    from jax.sharding import PartitionSpec as P
+    return {"gate": P(), "w1": P(axis, None, None), "b1": P(axis, None),
+            "w2": P(axis, None, None), "b2": P(axis, None)}
+
+
+def _top2_dispatch(logits, capacity):
+    """Token -> (expert, capacity slot) routing tensors.
+
+    logits: (T, E).  Returns dispatch (T, E, C) in {0,1} and combine
+    (T, E, C) with the renormalized top-2 gate weights; tokens overflowing
+    an expert's capacity are dropped (their combine weight is 0), the
+    GShard contract.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    g1 = jnp.max(gates, axis=-1)
+    e1 = jnp.argmax(gates, axis=-1)
+    gates2 = gates * (1.0 - jax.nn.one_hot(e1, E, dtype=gates.dtype))
+    g2 = jnp.max(gates2, axis=-1)
+    e2 = jnp.argmax(gates2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    def route(e, prior_counts):
+        onehot = jax.nn.one_hot(e, E, dtype=jnp.float32)      # (T, E)
+        pos = jnp.cumsum(onehot, axis=0) - onehot + prior_counts
+        keep = (pos < capacity) & (onehot > 0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)              # (T, E, C)
+        disp = slot * keep[..., None]
+        return disp, prior_counts + jnp.sum(onehot * keep, axis=0)
+
+    disp1, counts = route(e1, jnp.zeros((E,), jnp.float32))
+    disp2, _ = route(e2, counts)
+    dispatch = disp1 + disp2
+    combine = disp1 * g1[:, None, None] + disp2 * g2[:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(params, x, capacity_factor=2.0, activation=jax.nn.relu):
+    """Top-2 MoE FFN.  x: (B, S, d) -> (B, S, d).
+
+    Shard params with :func:`moe_shardings` (and the batch over 'dp') and
+    jit over the mesh: GSPMD turns the tec,td->ecd dispatch einsum into
+    the all_to_all that carries tokens to their experts' devices.
+    """
+    B, S, d = x.shape
+    T = B * S
+    E = params["w1"].shape[0]
+    capacity = int(np.ceil(capacity_factor * T * 2 / E))
+    tokens = x.reshape(T, d)
+    logits = tokens @ params["gate"]
+    dispatch, combine = _top2_dispatch(logits, capacity)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    h = activation(jnp.einsum("ecd,edh->ech", expert_in, params["w1"])
+                   + params["b1"][:, None, :])
+    # bias on empty slots is harmless: combine is zero there
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) \
+        + params["b2"][:, None, :]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.reshape(B, S, d)
