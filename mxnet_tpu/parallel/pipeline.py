@@ -51,8 +51,9 @@ def pipeline_apply(fn, stage_params, x, mesh, axis_name="pp",
             % (n_given, axis_name, n_stages))
     M = n_microbatch or n_stages
     B = x.shape[0]
-    assert B % M == 0, \
-        "n_microbatch %d must divide the batch %d" % (M, B)
+    if B % M != 0:
+        raise ValueError(
+            "n_microbatch %d must divide the batch %d" % (M, B))
     mb = B // M
     micro = x.reshape((M, mb) + x.shape[1:])
 

@@ -25,7 +25,8 @@ from . import ndarray as nd
 from . import symbol as sym
 from .context import current_context
 
-__all__ = ["Predictor", "load_ndarray_file", "create"]
+__all__ = ["Predictor", "load_ndarray_file", "create", "export_compiled",
+           "load_compiled"]
 
 
 def load_ndarray_file(blob, ctx=None):
@@ -160,3 +161,93 @@ def create(symbol_json, param_blob, input_shapes, ctx=None,
     """MXPredCreate analog."""
     return Predictor(symbol_json, param_blob, input_shapes, ctx,
                      output_name)
+
+
+# ---------------------------------------------------------------------------
+# Portable compiled export — the amalgamation analog
+# ---------------------------------------------------------------------------
+
+def export_compiled(symbol, arg_params, aux_params, input_shapes,
+                    fname=None, platforms=None):
+    """Serialize the inference function (graph + baked-in weights) as a
+    portable StableHLO artifact via ``jax.export``.
+
+    The reference ships models to phones/JS by amalgamating the predict
+    path into one self-contained file (amalgamation/README.md:1-13 +
+    mxnet_predict.py).  The TPU-native analog: one serialized artifact
+    holding the lowered computation AND the weights, loadable by any
+    process with jax installed — no mxnet_tpu needed (see
+    :func:`load_compiled`).
+
+    input_shapes: {input_name: shape}.  Returns the bytes (also written to
+    ``fname`` when given).  ``platforms`` defaults to ("cpu", "tpu") so
+    one artifact serves both (multi-platform StableHLO lowering).
+
+    CALLING CONVENTION: the exported callable takes the inputs as
+    positional arrays in ``sorted(input_shapes)`` name order (load_compiled
+    documents the same contract).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from .executor import _build_eval
+    from .ndarray import NDArray
+
+    if not isinstance(symbol, sym.Symbol):
+        symbol = sym.load_json(symbol)
+    eval_fn = _build_eval(symbol)
+
+    def _raw(d):
+        return {k: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
+                for k, v in (d or {}).items()}
+
+    params = _raw(arg_params)
+    auxs = _raw(aux_params)
+    input_names = sorted(input_shapes)
+    rng = jax.random.PRNGKey(0)
+
+    # loss labels / aux states absent from both inputs and the param dicts:
+    # zeros, the Predictor.reshape allocation rule
+    shapes = {k: tuple(v) for k, v in input_shapes.items()}
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+    for name, shp in zip(symbol.list_arguments(), arg_shapes):
+        if name not in params and name not in shapes:
+            params[name] = jnp.zeros(shp, jnp.float32)
+    for name, shp in zip(symbol.list_auxiliary_states(), aux_shapes):
+        if name not in auxs:
+            auxs[name] = jnp.zeros(shp, jnp.float32)
+
+    def infer(*inputs):
+        merged = dict(params)
+        merged.update(dict(zip(input_names, inputs)))
+        outs, _ = eval_fn(merged, auxs, rng, False)
+        return tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(shapes[n], jnp.float32)
+             for n in input_names]
+    exported = jexport.export(
+        jax.jit(infer),
+        platforms=tuple(platforms) if platforms else ("cpu", "tpu"))(*specs)
+    blob = exported.serialize()
+    if fname:
+        with open(fname, "wb") as f:
+            f.write(bytes(blob))
+    return bytes(blob)
+
+
+def load_compiled(blob_or_fname):
+    """Load an :func:`export_compiled` artifact -> callable(*inputs),
+    inputs positional in sorted-input-name order (the export contract).
+
+    Needs only jax (the artifact embeds graph + weights) — the mobile/
+    embedded deployment contract of the reference's amalgamated build.
+    """
+    import os as _os
+    from jax import export as jexport
+    if isinstance(blob_or_fname, (str, _os.PathLike)):
+        with open(blob_or_fname, "rb") as f:
+            blob = f.read()
+    else:
+        blob = blob_or_fname
+    exported = jexport.deserialize(bytearray(blob))
+    return exported.call

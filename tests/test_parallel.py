@@ -168,3 +168,38 @@ def test_kvstore_tpu_in_module():
             initializer=mx.initializer.Xavier())
     score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), "acc")
     assert score[0][1] > 0.9
+
+
+def test_spmd_trainer_bfloat16_converges():
+    """bf16 compute / f32 master weights training converges (the reference
+    tests/python/train/test_dtype.py fp16-cifar axis, TPU-native: MXU-rate
+    bfloat16 matmuls with full-precision accumulation + updates)."""
+    rs = np.random.RandomState(0)
+    N, D, C = 512, 16, 3
+    X = rs.randn(N, D).astype("f")
+    w = rs.randn(D, C).astype("f")
+    y = X.dot(w).argmax(axis=1).astype("f")
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    trainer = SPMDTrainer(net, "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9,
+                           "rescale_grad": 1.0 / 64},
+                          mesh=None, compute_dtype="bfloat16")
+    trainer.bind([("data", (64, D))], [("softmax_label", (64,))])
+    mx.random.seed(0)
+    trainer.init_params(mx.initializer.Xavier())
+    # master weights stay f32
+    assert all(np.dtype(v.dtype) == np.float32
+               for v in trainer.params.values())
+    for epoch in range(6):
+        for i in range(0, N, 64):
+            trainer.step(X[i:i + 64], y[i:i + 64])
+    outs = trainer.eval_step(X[:64], y[:64])
+    pred = np.asarray(outs[0]).argmax(axis=1)
+    acc = (pred == y[:64]).mean()
+    assert acc > 0.9, acc

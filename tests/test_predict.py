@@ -87,3 +87,69 @@ def test_predictor_errors(tmp_path):
         pred.set_input("data", np.zeros((9, 9), np.float32))
     with pytest.raises(mx.MXNetError):
         mx.predict.Predictor(sym_json, blob, {"bogus": (2, 6)}, ctx=mx.cpu())
+
+
+def test_export_compiled_roundtrip(tmp_path):
+    """Amalgamation analog: export graph+weights as a portable StableHLO
+    artifact; reload and match the Predictor's outputs — including from a
+    process that imports only jax."""
+    import subprocess
+    import sys
+    net = _small_net()
+    rs = np.random.RandomState(0)
+    shapes = {"data": (4, 6)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    args = {n: mx.nd.array(rs.uniform(-1, 1, s).astype("f"))
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n != "data"}
+    x = rs.rand(4, 6).astype("f")
+
+    from mxnet_tpu import predict
+    fname = str(tmp_path / "model.stablehlo")
+    predict.export_compiled(net, args, {}, shapes, fname=fname)
+
+    fn = predict.load_compiled(fname)
+    out = np.asarray(fn(x)[0])
+
+    pred = predict.Predictor(net, {("arg:%s" % k): v
+                                   for k, v in args.items()}, shapes)
+    pred.set_input("data", x)
+    pred.forward()
+    np.testing.assert_allclose(out, np.asarray(pred.get_output(0)),
+                               rtol=1e-5, atol=1e-6)
+
+    # jax-only consumer (no mxnet_tpu import)
+    code = (
+        "import numpy as np\n"
+        "from jax import export\n"
+        "blob = open(%r,'rb').read()\n"
+        "fn = export.deserialize(bytearray(blob)).call\n"
+        "out = np.asarray(fn(np.full((4,6),0.5,'float32'))[0])\n"
+        "assert out.shape == (4,4) and np.isfinite(out).all()\n"
+        "print('jax-only load OK')\n" % fname)
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "jax-only load OK" in res.stdout
+
+
+def test_export_compiled_batchnorm_aux(tmp_path):
+    """Aux states (BatchNorm moving stats) zero-fill like Predictor."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=2, name="fc"), name="softmax")
+    shapes = {"data": (2, 1, 6, 6)}
+    rs = np.random.RandomState(1)
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    args = {n: mx.nd.array(rs.uniform(-0.3, 0.3, s).astype("f"))
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    from mxnet_tpu import predict
+    predict.export_compiled(net, args, {}, shapes,
+                            fname=tmp_path / "bn.stablehlo")
+    fn = predict.load_compiled(tmp_path / "bn.stablehlo")  # PathLike OK
+    out = np.asarray(fn(rs.rand(2, 1, 6, 6).astype("f"))[0])
+    assert out.shape == (2, 2) and np.isfinite(out).all()
