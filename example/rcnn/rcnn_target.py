@@ -1,0 +1,231 @@
+"""Faster R-CNN target-assignment CustomOps (capability port of the
+reference example/rcnn target machinery: the AnchorLoader's RPN targets
+and rcnn/rcnn/symbol proposal_target.py's Python op).
+
+Both run host-side through the CustomOp bridge (operator.py pure_callback)
+with fixed output shapes, exactly how the reference executes its Python
+ops between kernel launches."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _iou_matrix(a, b):
+    """a: (N,4), b: (M,4) corner boxes -> (N,M) IoU."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    ix = np.maximum(
+        0, np.minimum(a[:, None, 2], b[None, :, 2])
+        - np.maximum(a[:, None, 0], b[None, :, 0]))
+    iy = np.maximum(
+        0, np.minimum(a[:, None, 3], b[None, :, 3])
+        - np.maximum(a[:, None, 1], b[None, :, 1]))
+    inter = ix * iy
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return (inter / np.maximum(union, 1e-9)).astype(np.float32)
+
+
+def _encode(anchors, gt):
+    """Box regression targets (dx, dy, dw, dh)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = gt[:, 2] - gt[:, 0] + 1
+    gh = gt[:, 3] - gt[:, 1] + 1
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    return np.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     np.log(np.maximum(gw / aw, 1e-6)),
+                     np.log(np.maximum(gh / ah, 1e-6))],
+                    axis=1).astype(np.float32)
+
+
+def gen_anchors(h, w, stride, scales, ratios):
+    """All anchors for an (h, w) feature map, corner format, image coords,
+    ordered (y, x, a).  Base anchors come from the SAME generator the
+    Proposal op decodes against (ops/contrib.py _gen_base_anchors) so RPN
+    targets and proposal decoding agree exactly."""
+    from mxnet_tpu.ops.contrib import _gen_base_anchors
+    base = np.asarray(_gen_base_anchors(
+        int(stride), tuple(float(s) for s in scales),
+        tuple(float(r) for r in ratios)), np.float32)       # (A, 4)
+    sy = np.arange(h, dtype=np.float32) * stride
+    sx = np.arange(w, dtype=np.float32) * stride
+    syg, sxg = np.meshgrid(sy, sx, indexing="ij")
+    shift = np.stack([sxg, syg, sxg, syg], axis=-1)         # (h, w, 4)
+    return (shift[:, :, None] + base[None, None]).reshape(-1, 4)
+
+
+class AnchorTargetOp(mx.operator.CustomOp):
+    """RPN targets: label anchors fg/bg/ignore by IoU with gt, emit bbox
+    regression targets + weights (the reference AnchorLoader's job,
+    example/rcnn/rcnn/io/rpn.py assign_anchor)."""
+
+    def __init__(self, stride, scales, ratios, fg_thresh=0.5,
+                 bg_thresh=0.3):
+        self.stride = stride
+        self.scales = scales
+        self.ratios = ratios
+        self.fg_thresh = fg_thresh
+        self.bg_thresh = bg_thresh
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        score = in_data[0].asnumpy()     # (N, 2A, h, w) for shape only
+        gts = in_data[1].asnumpy()       # (N, M, 5) [cls,x1,y1,x2,y2], -1 pad
+        n, two_a, h, w = score.shape
+        a = two_a // 2
+        anchors = gen_anchors(h, w, self.stride, self.scales, self.ratios)
+        k = anchors.shape[0]
+        labels = np.full((n, k), -1.0, np.float32)
+        btargets = np.zeros((n, k, 4), np.float32)
+        bweights = np.zeros((n, k, 4), np.float32)
+        for i in range(n):
+            gt = gts[i]
+            gt = gt[gt[:, 0] >= 0][:, 1:5]
+            if len(gt) == 0:
+                labels[i] = 0.0
+                continue
+            iou = _iou_matrix(anchors, gt)                  # (K, M)
+            best_gt = iou.argmax(axis=1)
+            best_iou = iou.max(axis=1)
+            labels[i][best_iou < self.bg_thresh] = 0.0
+            labels[i][best_iou >= self.fg_thresh] = 1.0
+            labels[i][iou.argmax(axis=0)] = 1.0             # best per gt
+            fg = labels[i] == 1.0
+            btargets[i][fg] = _encode(anchors[fg], gt[best_gt[fg]])
+            bweights[i][fg] = 1.0
+        # layouts the RPN heads expect: predictions reshape to anchor-major
+        # (a, h, w) positions, so labels must transpose from the (y, x, a)
+        # anchor order too (the reference's rpn.py does the same transpose)
+        labels = labels.reshape(n, h, w, a).transpose(0, 3, 1, 2) \
+            .reshape(n, a * h * w)
+        self.assign(out_data[0], req[0], mx.nd.array(labels))
+        self.assign(out_data[1], req[1], mx.nd.array(
+            btargets.reshape(n, h, w, a * 4).transpose(0, 3, 1, 2)))
+        self.assign(out_data[2], req[2], mx.nd.array(
+            bweights.reshape(n, h, w, a * 4).transpose(0, 3, 1, 2)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g in in_grad:
+            self.assign(g, "write", mx.nd.zeros(g.shape))
+
+
+@mx.operator.register("anchor_target")
+class AnchorTargetProp(mx.operator.CustomOpProp):
+    def __init__(self, stride=4, scales="(2,4)", ratios="(0.5,1,2)"):
+        super().__init__(need_top_grad=False)
+        self.stride = int(stride)
+        self.scales = eval(scales)
+        self.ratios = eval(ratios)
+
+    def list_arguments(self):
+        return ["rpn_cls_score", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n, two_a, h, w = in_shape[0]
+        a = two_a // 2
+        k = h * w * a
+        return in_shape, [[n, k], [n, a * 4, h, w], [n, a * 4, h, w]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return AnchorTargetOp(self.stride, self.scales, self.ratios)
+
+
+class ProposalTargetOp(mx.operator.CustomOp):
+    """Sample ROIs and assign classification + regression targets
+    (reference example/rcnn proposal_target Python op)."""
+
+    def __init__(self, num_classes, batch_rois, fg_fraction=0.5,
+                 fg_thresh=0.5):
+        self.num_classes = num_classes
+        self.batch_rois = batch_rois
+        self.fg_fraction = fg_fraction
+        self.fg_thresh = fg_thresh
+        self.rng = np.random.RandomState(0)  # advances across iterations
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        rois = in_data[0].asnumpy()     # (N*post, 5) [batch, x1..y2]
+        gts = in_data[1].asnumpy()      # (N, M, 5)
+        n = gts.shape[0]
+        per = self.batch_rois
+        out_rois = np.zeros((n * per, 5), np.float32)
+        labels = np.zeros((n * per,), np.float32)
+        btargets = np.zeros((n * per, self.num_classes * 4), np.float32)
+        bweights = np.zeros((n * per, self.num_classes * 4), np.float32)
+        rng = self.rng
+        for i in range(n):
+            r = rois[rois[:, 0] == i][:, 1:5]
+            gt = gts[i]
+            gt = gt[gt[:, 0] >= 0]
+            cand = np.concatenate([r, gt[:, 1:5]]) if len(gt) else r
+            valid = (cand[:, 2] > cand[:, 0]) & (cand[:, 3] > cand[:, 1])
+            cand = cand[valid]
+            if len(cand) == 0 or len(gt) == 0:
+                continue
+            iou = _iou_matrix(cand, gt[:, 1:5])
+            best = iou.argmax(axis=1)
+            best_iou = iou.max(axis=1)
+            fg_idx = np.where(best_iou >= self.fg_thresh)[0]
+            bg_idx = np.where(best_iou < self.fg_thresh)[0]
+            n_fg = min(len(fg_idx), int(per * self.fg_fraction))
+            fg_idx = rng.permutation(fg_idx)[:n_fg]
+            bg_idx = rng.permutation(bg_idx)[:per - n_fg]
+            sel = np.concatenate([fg_idx, bg_idx]).astype(int)
+            if 0 < len(sel) < per:
+                # pad by resampling (the reference's round-robin refill) so
+                # no degenerate all-zero ROI rows pollute the head loss
+                extra = rng.choice(sel, size=per - len(sel), replace=True)
+                sel = np.concatenate([sel, extra])
+            base = i * per
+            m = len(sel)
+            out_rois[base:base + m, 0] = i
+            out_rois[base:base + m, 1:] = cand[sel]
+            # per-ROI label from its own IoU (robust to resampled padding)
+            is_fg = best_iou[sel] >= self.fg_thresh
+            cls = np.where(is_fg, gt[best[sel], 0] + 1, 0.0)
+            labels[base:base + m] = cls
+            for j, (c, s) in enumerate(zip(cls, sel)):
+                if c > 0:
+                    t = _encode(cand[s:s + 1], gt[best[s]:best[s] + 1, 1:5])
+                    c4 = int(c) * 4
+                    btargets[base + j, c4:c4 + 4] = t[0]
+                    bweights[base + j, c4:c4 + 4] = 1.0
+        self.assign(out_data[0], req[0], mx.nd.array(out_rois))
+        self.assign(out_data[1], req[1], mx.nd.array(labels))
+        self.assign(out_data[2], req[2], mx.nd.array(btargets))
+        self.assign(out_data[3], req[3], mx.nd.array(bweights))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g in in_grad:
+            self.assign(g, "write", mx.nd.zeros(g.shape))
+
+
+@mx.operator.register("proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    def __init__(self, num_classes=3, batch_rois=32, fg_fraction=0.5):
+        super().__init__(need_top_grad=False)
+        self.num_classes = int(num_classes)
+        self.batch_rois = int(batch_rois)
+        self.fg_fraction = float(fg_fraction)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_out", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        n = in_shape[1][0]
+        total = n * self.batch_rois
+        c4 = self.num_classes * 4
+        return in_shape, [[total, 5], [total], [total, c4], [total, c4]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ProposalTargetOp(self.num_classes, self.batch_rois,
+                                self.fg_fraction)

@@ -1,0 +1,222 @@
+"""Train a compact Faster R-CNN (capability port of the reference
+example/rcnn two-stage pipeline: RPN -> Proposal -> proposal_target
+CustomOp -> ROIPooling -> classification + box-regression heads).
+
+Runs on the toy colored-rectangle detection set (no dataset downloads in
+this environment); the graph machinery — anchor targets via CustomOp, the
+Proposal op's decode+NMS, ROI pooling, per-class smooth-L1 box loss — is
+the reference's end to end.
+
+Usage::
+
+    python train_rcnn.py --num-epochs 3
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc, DataIter
+
+import rcnn_target  # noqa: F401  (registers anchor_target/proposal_target)
+
+IMG = 64
+STRIDE = 4
+SCALES = (2, 4)
+RATIOS = (0.5, 1, 2)
+NUM_ANCHORS = len(SCALES) * len(RATIOS)
+
+
+def get_symbol_train(num_fg_classes=3, batch_rois=32):
+    num_classes = num_fg_classes + 1            # incl. background
+    data = mx.sym.Variable("data")
+    im_info = mx.sym.Variable("im_info")
+    gt_boxes = mx.sym.Variable("gt_boxes")
+
+    # backbone: stride-4 feature map
+    net = data
+    for i, f in enumerate((32, 32, 64, 64)):
+        net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=f, name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        if i in (0, 1):
+            net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                                 stride=(2, 2))
+    feat = net
+
+    # RPN heads
+    rpn = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1), num_filter=64,
+                             name="rpn_conv")
+    rpn = mx.sym.Activation(rpn, act_type="relu")
+    rpn_cls_score = mx.sym.Convolution(rpn, kernel=(1, 1),
+                                       num_filter=2 * NUM_ANCHORS,
+                                       name="rpn_cls_score")
+    rpn_bbox_pred = mx.sym.Convolution(rpn, kernel=(1, 1),
+                                       num_filter=4 * NUM_ANCHORS,
+                                       name="rpn_bbox_pred")
+
+    # RPN targets (CustomOp) + losses
+    rpn_label, rpn_bbox_target, rpn_bbox_weight = mx.sym.Custom(
+        rpn_cls_score, gt_boxes, op_type="anchor_target", stride=STRIDE,
+        scales=str(SCALES), ratios=str(RATIOS), name="anchor_target")
+    rpn_cls_act = mx.sym.Reshape(rpn_cls_score,
+                                 shape=(0, 2, -1), name="rpn_cls_reshape")
+    rpn_cls_prob = mx.sym.SoftmaxOutput(rpn_cls_act, rpn_label,
+                                        multi_output=True, use_ignore=True,
+                                        ignore_label=-1,
+                                        normalization="valid",
+                                        name="rpn_cls_prob")
+    rpn_bbox_loss_ = rpn_bbox_weight * mx.sym.smooth_l1(
+        rpn_bbox_pred - rpn_bbox_target, scalar=3.0)
+    rpn_bbox_loss = mx.sym.MakeLoss(rpn_bbox_loss_, grad_scale=1.0 / 256,
+                                    name="rpn_bbox_loss")
+
+    # proposals (decode + NMS) and sampled training ROIs
+    rpn_prob_full = mx.sym.Reshape(
+        mx.sym.SoftmaxActivation(rpn_cls_act, mode="channel"),
+        shape=(0, 2 * NUM_ANCHORS, IMG // STRIDE, IMG // STRIDE),
+        name="rpn_prob_full")
+    rois = mx.sym.contrib.Proposal(
+        rpn_prob_full, rpn_bbox_pred, im_info, feature_stride=STRIDE,
+        scales=SCALES, ratios=RATIOS, rpn_pre_nms_top_n=256,
+        rpn_post_nms_top_n=64, threshold=0.7, rpn_min_size=4,
+        name="rois")
+    rois, label, bbox_target, bbox_weight = mx.sym.Custom(
+        rois, gt_boxes, op_type="proposal_target",
+        num_classes=num_classes, batch_rois=batch_rois,
+        name="proposal_target")
+
+    # RCNN head over pooled ROI features
+    pooled = mx.sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+                               spatial_scale=1.0 / STRIDE, name="roi_pool")
+    flat = mx.sym.Flatten(pooled)
+    fc = mx.sym.Activation(
+        mx.sym.FullyConnected(flat, num_hidden=128, name="fc6"),
+        act_type="relu")
+    cls_score = mx.sym.FullyConnected(fc, num_hidden=num_classes,
+                                      name="cls_score")
+    bbox_pred = mx.sym.FullyConnected(fc, num_hidden=num_classes * 4,
+                                      name="bbox_pred")
+    cls_prob = mx.sym.SoftmaxOutput(cls_score, label,
+                                    normalization="batch", name="cls_prob")
+    bbox_loss_ = bbox_weight * mx.sym.smooth_l1(bbox_pred - bbox_target,
+                                                scalar=1.0)
+    bbox_loss = mx.sym.MakeLoss(bbox_loss_, grad_scale=1.0 / batch_rois,
+                                name="bbox_loss")
+    label_out = mx.sym.MakeLoss(label, grad_scale=0, name="label_out")
+    return mx.sym.Group([rpn_cls_prob, rpn_bbox_loss, cls_prob, bbox_loss,
+                         label_out])
+
+
+class ToyDetIter(DataIter):
+    """In-memory toy shapes detection iterator feeding data/im_info/
+    gt_boxes (the reference AnchorLoader's provide_data layout)."""
+
+    def __init__(self, n=64, batch_size=8, num_fg=3, seed=0):
+        super().__init__(batch_size)
+        rs = np.random.RandomState(seed)
+        colors = [(255, 60, 60), (60, 255, 60), (60, 60, 255)]
+        self.data = np.zeros((n, 3, IMG, IMG), np.float32)
+        self.gt = np.full((n, 4, 5), -1.0, np.float32)
+        for i in range(n):
+            img = np.full((IMG, IMG, 3), 100, np.uint8)
+            img += rs.randint(0, 20, img.shape).astype(np.uint8)
+            for j in range(rs.randint(1, 3)):
+                x0, y0 = rs.randint(0, IMG - 28, 2)
+                bw, bh = rs.randint(14, 26, 2)
+                x1, y1 = min(IMG - 1, x0 + bw), min(IMG - 1, y0 + bh)
+                cls = rs.randint(0, num_fg)
+                img[y0:y1, x0:x1] = colors[cls % 3]
+                self.gt[i, j] = (cls, x0, y0, x1, y1)
+            self.data[i] = (img.transpose(2, 0, 1).astype(np.float32)
+                            - 115.0)
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, 3, IMG, IMG)),
+                DataDesc("im_info", (self.batch_size, 3)),
+                DataDesc("gt_boxes", (self.batch_size, 4, 5))]
+
+    @property
+    def provide_label(self):
+        return []
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor + self.batch_size <= len(self.data)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        s = slice(self.cursor, self.cursor + self.batch_size)
+        im_info = np.tile(np.asarray([IMG, IMG, 1.0], np.float32),
+                          (self.batch_size, 1))
+        return DataBatch(
+            data=[mx.nd.array(self.data[s]), mx.nd.array(im_info),
+                  mx.nd.array(self.gt[s])],
+            label=[], pad=0, provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    __next__ = next
+
+
+class RcnnMetric(mx.metric.EvalMetric):
+    """RPN log-loss + RCNN accuracy from the loss group's outputs."""
+
+    def __init__(self):
+        super().__init__("RCNN")
+        self.reset()
+
+    def reset(self):
+        self.sum_metric = [0.0, 0.0]
+        self.num_inst = [0, 0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[2].asnumpy()       # (rois, C)
+        label = preds[4].asnumpy().astype(int)
+        acc = (cls_prob.argmax(axis=1) == label).mean()
+        self.sum_metric[0] += float(np.abs(preds[1].asnumpy()).sum()
+                                    + np.abs(preds[3].asnumpy()).sum())
+        self.num_inst[0] += 1
+        self.sum_metric[1] += float(acc)
+        self.num_inst[1] += 1
+
+    def get_name_value(self):
+        return [("BoxLoss", self.sum_metric[0] / max(1, self.num_inst[0])),
+                ("RCNNAcc", self.sum_metric[1] / max(1, self.num_inst[1]))]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    parser = argparse.ArgumentParser(description="Train toy Faster R-CNN")
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=0.002)
+    args = parser.parse_args()
+
+    it = ToyDetIter(batch_size=args.batch_size)
+    net = get_symbol_train()
+    mod = mx.mod.Module(net, data_names=("data", "im_info", "gt_boxes"),
+                        label_names=None)
+    mod.fit(it, num_epoch=args.num_epochs, eval_metric=RcnnMetric(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in",
+                                              magnitude=2),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 4),
+            kvstore=None)
+    logging.info("done")

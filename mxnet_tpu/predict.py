@@ -206,6 +206,11 @@ def export_compiled(symbol, arg_params, aux_params, input_shapes,
     input_names = sorted(input_shapes)
     rng = jax.random.PRNGKey(0)
 
+    unknown = [n for n in input_shapes
+               if n not in set(symbol.list_arguments())]
+    if unknown:
+        raise MXNetError("input name(s) %s not in symbol arguments"
+                         % (unknown,))
     # loss labels / aux states absent from both inputs and the param dicts:
     # zeros, the Predictor.reshape allocation rule
     shapes = {k: tuple(v) for k, v in input_shapes.items()}

@@ -1,0 +1,45 @@
+"""Faster R-CNN example smoke test: the two-stage graph (RPN + Proposal +
+proposal_target CustomOp + ROIPooling + heads) binds and trains with
+improving ROI classification on the toy set."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RCNN = os.path.join(REPO, "example", "rcnn")
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rcnn_trains():
+    sys.path.insert(0, RCNN)
+    try:
+        _load("rcnn_target_t", os.path.join(RCNN, "rcnn_target.py"))
+        train = _load("train_rcnn_t", os.path.join(RCNN, "train_rcnn.py"))
+    finally:
+        sys.path.pop(0)
+
+    it = train.ToyDetIter(n=16, batch_size=4)
+    net = train.get_symbol_train(batch_rois=16)
+    mod = mx.mod.Module(net, data_names=("data", "im_info", "gt_boxes"),
+                        label_names=None)
+    metric = train.RcnnMetric()
+    mod.fit(it, num_epoch=2, eval_metric=metric, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.002, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in",
+                                              magnitude=2),
+            kvstore=None)
+    vals = dict(metric.get_name_value())
+    assert np.isfinite(vals["BoxLoss"])
+    assert vals["RCNNAcc"] > 0.5, vals
