@@ -133,6 +133,7 @@ def test_module_kvstore_update_on_kvstore():
     mod = mx.mod.Module(mlp_sym(num_classes=2, nh=8), context=mx.cpu())
     mod.bind(data_shapes=train.provide_data,
              label_shapes=train.provide_label)
+    mx.random.seed(5)  # deterministic init regardless of suite order
     mod.init_params(initializer=mx.initializer.Xavier())
     mod.init_optimizer(kvstore=kv, optimizer="sgd",
                        optimizer_params={"learning_rate": 0.5})
