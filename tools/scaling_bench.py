@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Weak-scaling harness for the fused data-parallel train step — the
+measurement BASELINE.json's north star asks for ("KVStore allreduce
+scaling 8 -> 64 chips, >=85% efficiency").
+
+Holds per-device batch fixed, grows the dp mesh, reports images/sec and
+weak-scaling efficiency vs the smallest run.  On a real pod the mesh axes
+ride ICI; pass --virtual-devices N to validate the harness on a 1-chip
+host (numbers then reflect host-CPU contention, not ICI).
+
+Usage::
+
+    python tools/scaling_bench.py                      # real devices
+    python tools/scaling_bench.py --virtual-devices 8  # harness check
+    python tools/scaling_bench.py --network resnet-50 --per-device-batch 32
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+
+def run_one(n_dev, network, per_batch, steps, warmup, image_shape,
+            num_classes):
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer, build_mesh
+
+    devices = jax.devices()[:n_dev]
+    mesh = build_mesh({"dp": n_dev}, devices) if n_dev > 1 else None
+    batch = per_batch * n_dev
+    sym = models.get_symbol(network, num_classes=num_classes)
+    trainer = SPMDTrainer(
+        sym, "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+        mesh=mesh, compute_dtype="bfloat16")
+    trainer.bind([("data", (batch,) + image_shape)],
+                 [("softmax_label", (batch,))])
+    trainer.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2))
+    rs = np.random.RandomState(0)
+    staged = []
+    for _ in range(4):
+        d = mx.nd.array(rs.rand(batch, *image_shape).astype("f")) \
+            .astype("bfloat16")
+        l = mx.nd.array(rs.randint(0, num_classes, batch).astype("f"))
+        d.wait_to_read()
+        staged.append((d, l))
+    for i in range(warmup):
+        trainer.step(*staged[i % len(staged)])
+    jax.block_until_ready(trainer.params)
+    tic = time.time()
+    for i in range(steps):
+        trainer.step(*staged[i % len(staged)])
+    jax.block_until_ready(trainer.params)
+    dt = time.time() - tic
+    return batch * steps / dt
+
+
+def main():
+    parser = argparse.ArgumentParser(description="weak-scaling sweep")
+    parser.add_argument("--network", default="resnet-50")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--per-device-batch", type=int, default=32)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--device-counts", default="",
+                        help="comma list; default: 1,2,4,... up to all")
+    parser.add_argument("--virtual-devices", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.virtual_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=%d"
+            % args.virtual_devices)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    total = len(jax.devices())
+    if args.device_counts:
+        counts = [int(c) for c in args.device_counts.split(",")]
+    else:
+        counts, c = [], 1
+        while c <= total:
+            counts.append(c)
+            c *= 2
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+
+    base_per_dev = None
+    for n in counts:
+        ips = run_one(n, args.network, args.per_device_batch, args.steps,
+                      args.warmup, image_shape, args.num_classes)
+        per_dev = ips / n
+        if base_per_dev is None:
+            base_per_dev = per_dev
+        print(json.dumps({
+            "devices": n,
+            "images_per_sec": round(ips, 2),
+            "images_per_sec_per_device": round(per_dev, 2),
+            "weak_scaling_efficiency": round(per_dev / base_per_dev, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()
