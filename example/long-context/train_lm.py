@@ -29,13 +29,14 @@ import numpy as np
 logging.basicConfig(level=logging.INFO, format="%(asctime)-15s %(message)s")
 
 
-def build_params(rng, vocab, d_model, n_heads, n_layers, d_ff):
+def build_params(rng, vocab, d_model, n_heads, n_layers, d_ff,
+                 max_len=4096):
     import jax
-    import jax.numpy as jnp
     keys = jax.random.split(rng, 2 + 4 * n_layers)
     s = 1.0 / np.sqrt(d_model)
     params = {"embed": jax.random.normal(keys[0], (vocab, d_model)) * 0.02,
-              "pos": jnp.zeros((1, 1, d_model))}
+              "pos": jax.random.normal(keys[1],
+                                       (1, max_len, d_model)) * 0.02}
     for i in range(n_layers):
         k = keys[2 + 4 * i: 6 + 4 * i]
         params["l%d" % i] = {
@@ -56,7 +57,7 @@ def apply_model(params, tokens, mesh, n_heads, n_layers):
     B, T = tokens.shape
     D = params["embed"].shape[1]
     hd = D // n_heads
-    x = params["embed"][tokens]
+    x = params["embed"][tokens] + params["pos"][:, :T]
 
     def norm(z):
         mu = z.mean(-1, keepdims=True)

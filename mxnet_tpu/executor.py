@@ -68,7 +68,7 @@ def _node_plan(symbol):
     return plan
 
 
-def _build_eval(symbol, placement=None):
+def _build_eval(symbol, placement=None, mirror_segments=0):
     """Return eval_fn(args_dict, aux_dict, rng, is_train) ->
     (outputs_list, aux_updates_dict).  Pure — jit/vjp-able.
 
@@ -78,10 +78,32 @@ def _build_eval(symbol, placement=None):
     _CrossDeviceCopy at group boundaries (graph_executor.cc:242-331),
     expressed as jax.device_put (whose vjp transposes to a device_put of
     the cotangent back across the same boundary).  Placement-active graphs
-    run eagerly per-op, the reference's own dispatch model."""
+    run eagerly per-op, the reference's own dispatch model.
+
+    ``mirror_segments`` > 1 wraps the trace in that many jax.checkpoint
+    segments: the backward rematerializes each segment's activations
+    instead of storing them (the reference's MXNET_BACKWARD_DO_MIRROR
+    memory mode, graph_executor.cc InitFullGraph mirror option)."""
     plan = _node_plan(symbol)
     out_refs = [(id(n), i) for n, i in symbol._outputs]
     placement = placement or {}
+    if mirror_segments and mirror_segments > 1:
+        if placement:
+            import logging
+            logging.warning(
+                "MXNET_BACKWARD_DO_MIRROR ignored: group2ctx placement "
+                "runs per-op eagerly, which jax.checkpoint cannot wrap")
+        else:
+            return _build_eval_segmented(plan, out_refs,
+                                         int(mirror_segments))
+
+    if not placement:
+        def eval_fn(args, aux, rng, is_train, monitor=None):
+            env, aux_updates = {}, {}
+            _run_plan_nodes(plan, env, args, aux, rng, is_train,
+                            aux_updates, monitor)
+            return [env[nid][i] for nid, i in out_refs], aux_updates
+        return eval_fn
 
     def eval_fn(args, aux, rng, is_train, monitor=None):
         env = {}
@@ -116,6 +138,114 @@ def _build_eval(symbol, placement=None):
                     aux_updates[name] = arr
             if monitor is not None:
                 monitor(node, env[id(node)])
+        outputs = [env[nid][i] for nid, i in out_refs]
+        return outputs, aux_updates
+
+    return eval_fn
+
+
+def mirror_segments_for(symbol, force=False):
+    """Segment count for the memory-mirror mode (0 = off).  Engages when
+    MXNET_BACKWARD_DO_MIRROR=1 (or ``force``, the SPMDTrainer remat
+    param); MXNET_MIRROR_SEGMENTS overrides the sqrt-of-op-count
+    default."""
+    from .base import get_env
+    if not force and str(get_env("MXNET_BACKWARD_DO_MIRROR", "0")) != "1":
+        return 0
+    n_ops = sum(1 for nd_ in symbol._nodes() if nd_.op is not None)
+    return max(2, int(get_env("MXNET_MIRROR_SEGMENTS",
+                              int(np.sqrt(max(1, n_ops))))))
+
+
+def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
+                    monitor=None):
+    """Interpret a slice of the node plan against ``env`` (id -> outputs
+    tuple).  Shared by the plain and segmented eval builders."""
+    for node, call_attrs, n_out, aux_var_names, _ in chunk:
+        if node.op is None:
+            if node.name in args:
+                val = args[node.name]
+            elif node.name in aux:
+                val = aux[node.name]
+            else:
+                raise MXNetError("unbound variable %r" % node.name)
+            env[id(node)] = (val,)
+            continue
+        ins = [env[id(src)][idx] for src, idx in node.inputs]
+        kw = {}
+        if node.op.needs_is_train:
+            kw["is_train"] = is_train
+        if node.op.needs_rng:
+            kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
+        out = node.op.fn(*ins, **call_attrs, **kw)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        env[id(node)] = tuple(out[:n_out])
+        for name, arr in zip(aux_var_names, out[n_out:]):
+            if name is not None:
+                aux_updates[name] = arr
+        if monitor is not None:
+            monitor(node, env[id(node)])
+
+
+def _build_eval_segmented(plan, out_refs, n_segments):
+    """Segmented-remat eval: the plan is split into ~n_segments chunks,
+    each wrapped in jax.checkpoint.  Residuals between segments are only
+    the live boundary values, so activation memory scales with the segment
+    size while the backward recomputes within each segment."""
+    n = len(plan)
+    seg_size = max(1, -(-n // n_segments))
+    chunks = [plan[i:i + seg_size] for i in range(0, n, seg_size)]
+
+    # liveness: which node outputs cross each boundary
+    produced_in = {}
+    for ci, chunk in enumerate(chunks):
+        for node, *_ in chunk:
+            produced_in[id(node)] = ci
+    consumers = {}   # id -> last chunk index that reads it
+    for ci, chunk in enumerate(chunks):
+        for node, *_ in chunk:
+            if node.op is not None:
+                for src, _idx in node.inputs:
+                    consumers[id(src)] = max(consumers.get(id(src), -1), ci)
+    for nid, _ in out_refs:
+        consumers[nid] = len(chunks)
+    live_out = []   # per chunk: ids leaving that boundary, ordered
+    for ci in range(len(chunks)):
+        ids = [nid for nid, pc in produced_in.items()
+               if pc <= ci and consumers.get(nid, -1) > ci]
+        live_out.append(ids)
+
+    def eval_fn(args, aux, rng, is_train, monitor=None):
+        if monitor is not None:
+            # monitored (per-op tap) runs use the plain interpretation
+            env, aux_updates = {}, {}
+            _run_plan_nodes(plan, env, args, aux, rng, is_train,
+                            aux_updates, monitor)
+            return [env[nid][i] for nid, i in out_refs], aux_updates
+
+        aux_updates = {}
+        carry_ids = []
+        carry_vals = ()
+
+        for ci, chunk in enumerate(chunks):
+            ids_in = list(carry_ids)
+            ids_out = live_out[ci]
+
+            def seg(vals_in, args, aux, rng, _chunk=chunk, _in=ids_in,
+                    _out=ids_out):
+                env = dict(zip(_in, vals_in))
+                seg_aux = {}
+                _run_plan_nodes(_chunk, env, args, aux, rng, is_train,
+                                seg_aux)
+                return tuple(env[i] for i in _out), seg_aux
+
+            out_vals, seg_aux = jax.checkpoint(seg)(carry_vals, args, aux,
+                                                    rng)
+            aux_updates.update(seg_aux)
+            carry_ids, carry_vals = ids_out, out_vals
+
+        env = dict(zip(carry_ids, carry_vals))
         outputs = [env[nid][i] for nid, i in out_refs]
         return outputs, aux_updates
 
@@ -162,7 +292,8 @@ class Executor(object):
                 placement = {}
         self._placement = placement
 
-        self._eval = _build_eval(symbol, placement=placement or None)
+        self._eval = _build_eval(symbol, placement=placement or None,
+                                 mirror_segments=mirror_segments_for(symbol))
         # graphs holding host-callback ops (Custom) can only be whole-graph
         # jitted if the backend supports callbacks under jit; otherwise run
         # eagerly — the reference likewise executes CustomOp host-side
@@ -181,6 +312,9 @@ class Executor(object):
         self._jit_fwd_train = _maybe_jit(
             lambda a, x, r: self._eval(a, x, r, True))
         diff_names = self._diff_names
+
+        # memory mirror mode lives inside self._eval (segmented
+        # jax.checkpoint, see _build_eval_segmented)
 
         def train_fn(args, aux, rng, heads):
             diff = {k: args[k] for k in diff_names}

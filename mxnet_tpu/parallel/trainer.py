@@ -65,10 +65,16 @@ class SPMDTrainer(object):
 
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
-                 compute_dtype=None):
+                 compute_dtype=None, remat=None):
         import jax
+        from ..base import get_env
         self.symbol = symbol
         self.mesh = mesh
+        # remat/mirror: rematerialize the forward inside the backward
+        # (reference MXNET_BACKWARD_DO_MIRROR memory mode)
+        if remat is None:
+            remat = str(get_env("MXNET_BACKWARD_DO_MIRROR", "0")) == "1"
+        self.remat = bool(remat)
         # a mesh spanning several processes (multi-host cluster joined via
         # distributed.initialize) switches placement to the global-array
         # path: each process contributes its local batch shard and holds a
@@ -88,7 +94,10 @@ class SPMDTrainer(object):
                 "(sgd/adam/rmsprop supported); use mx.mod.Module for other "
                 "optimizers" % kind)
         self.optimizer = optimizer
-        self._eval = _build_eval(symbol)
+        from ..executor import mirror_segments_for
+        self._eval = _build_eval(
+            symbol,
+            mirror_segments=mirror_segments_for(symbol, force=self.remat))
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
 

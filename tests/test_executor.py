@@ -228,3 +228,35 @@ def test_group2ctx_single_device_degenerates():
     ex = net.simple_bind(mx.cpu(0), data=(2, 3),
                          group2ctx={"dev1": mx.cpu(0)})
     assert not ex._placement
+
+
+def test_backward_do_mirror_numerics(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR=1 (remat) must not change gradients —
+    only the activation-memory/compute tradeoff (reference
+    graph_executor.cc mirror option; BASELINE's VGG memory row)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    def run(mirror):
+        if mirror:
+            monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+        else:
+            monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="tanh")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        ex = net.simple_bind(mx.cpu(0), data=(4, 6))
+        rs = np.random.RandomState(0)
+        for k, v in ex.arg_dict.items():
+            v[:] = rs.uniform(-1, 1, v.shape)
+        ex.forward(is_train=True)
+        ex.backward()
+        return {k: g.asnumpy() for k, g in ex.grad_dict.items()}
+
+    plain = run(False)
+    mirrored = run(True)
+    for k in plain:
+        np.testing.assert_allclose(mirrored[k], plain[k], rtol=1e-6,
+                                   err_msg=k)

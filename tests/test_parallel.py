@@ -203,3 +203,32 @@ def test_spmd_trainer_bfloat16_converges():
     pred = np.asarray(outs[0]).argmax(axis=1)
     acc = (pred == y[:64]).mean()
     assert acc > 0.9, acc
+
+
+def test_spmd_trainer_remat_matches():
+    """SPMDTrainer(remat=True) steps produce the same weights as without
+    remat (jax.checkpoint only changes the memory/compute schedule)."""
+    rs = np.random.RandomState(3)
+    X = rs.randn(64, 8).astype("f")
+    y = rs.randint(0, 3, 64).astype("f")
+
+    def run(remat):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        t = SPMDTrainer(net, "sgd", {"learning_rate": 0.1,
+                                     "rescale_grad": 1.0 / 32},
+                        remat=remat)
+        t.bind([("data", (32, 8))], [("softmax_label", (32,))])
+        mx.random.seed(11)
+        t.init_params(mx.initializer.Xavier())
+        for i in range(4):
+            t.step(X[i % 2 * 32:(i % 2 + 1) * 32],
+                   y[i % 2 * 32:(i % 2 + 1) * 32])
+        return {k: np.asarray(v) for k, v in t.params.items()}
+
+    a, b = run(False), run(True)
+    for k in a:
+        np.testing.assert_allclose(b[k], a[k], rtol=1e-6, err_msg=k)

@@ -94,6 +94,7 @@ def main():
             c *= 2
     image_shape = tuple(int(x) for x in args.image_shape.split(","))
 
+    counts = sorted(set(counts))  # efficiency baselines on the smallest
     base_per_dev = None
     for n in counts:
         ips = run_one(n, args.network, args.per_device_batch, args.steps,
