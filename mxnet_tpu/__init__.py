@@ -79,6 +79,7 @@ from . import visualization as viz
 from . import models
 from . import rnn
 from . import model
+from . import libinfo
 from .model import FeedForward
 from . import module
 from . import module as mod

@@ -1,0 +1,18 @@
+"""Library locator (reference python/mxnet/libinfo.py find_lib_path):
+returns the native engine/recordio shared library this build loads."""
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of the native libraries backing this install (the analog of
+    locating libmxnet.so)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [os.path.join(here, "native", "libmxtpu.so")]
+    found = [p for p in candidates if os.path.exists(p)]
+    if not found:
+        raise RuntimeError(
+            "native library not found (expected %s); the Python engine "
+            "fallback is used automatically" % candidates)
+    return found

@@ -70,6 +70,7 @@ def test_module_lifecycle():
 
 
 def test_module_fit_mlp():
+    mx.random.seed(101)
     X, y = make_blobs(480, 10, 3)
     train = mx.io.NDArrayIter(X[:384], y[:384], batch_size=32, shuffle=True)
     val = mx.io.NDArrayIter(X[384:], y[384:], batch_size=32)
@@ -83,6 +84,7 @@ def test_module_fit_mlp():
 
 def test_module_fit_lenet_e2e():
     """LeNet end-to-end — BASELINE.json config #1 analog (train_mnist.py)."""
+    mx.random.seed(102)
     X, y = make_images(320)
     train = mx.io.NDArrayIter(X[:256], y[:256], batch_size=32, shuffle=True)
     val = mx.io.NDArrayIter(X[256:], y[256:], batch_size=32)
@@ -98,6 +100,7 @@ def test_module_fit_lenet_e2e():
 def test_module_multi_device():
     """Data-parallel across two fake devices (reference
     test_module.py-style; cpu(0)/cpu(1) as in test_model_parallel.py)."""
+    mx.random.seed(103)
     X, y = make_blobs(480, 10, 3, seed=1)
     train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
     mod = mx.mod.Module(mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
@@ -190,6 +193,7 @@ def test_bucketing_module():
 
 
 def test_optimizers_converge():
+    mx.random.seed(104)
     X, y = make_blobs(192, 8, 2, seed=3)
     for optimizer, params in [("sgd", {"learning_rate": 0.5}),
                               ("adam", {"learning_rate": 0.05}),
@@ -206,6 +210,7 @@ def test_optimizers_converge():
 
 
 def test_feedforward_legacy_api():
+    mx.random.seed(105)
     X, y = make_blobs(128, 6, 2, seed=5)
     model = mx.model.FeedForward(mlp_sym(num_classes=2, nh=8),
                                  ctx=mx.cpu(), num_epoch=4,
@@ -219,6 +224,7 @@ def test_feedforward_legacy_api():
 def test_module_fused_tpu_kvstore():
     """kvstore='tpu' engages the fused SPMD step; training converges and
     the post-fit param sync / checkpoint / score paths all work."""
+    mx.random.seed(106)
     X, y = make_blobs(512, 10, 3)
     it = mx.io.NDArrayIter(X, y, batch_size=64)
     mod = mx.mod.Module(mlp_sym())
