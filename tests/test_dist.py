@@ -40,19 +40,21 @@ def test_dist_sync_kvstore(nworkers):
                 in res.stdout)
 
 
-def test_dist_module_fit_fused():
-    """2-worker Module.fit(kvstore='tpu') on the fused SPMD path: workers
-    end with identical weights and a convergent model (the reference's
-    nightly dist_lenet/multi_lenet assertions)."""
+@pytest.mark.parametrize("nworkers", [2, 3])
+def test_dist_module_fit_fused(nworkers):
+    """Multi-worker Module.fit(kvstore='tpu') on the fused SPMD path:
+    workers end with identical weights and a convergent model (the
+    reference's nightly dist_lenet/multi_lenet assertions)."""
     worker = os.path.join(REPO, "tests", "dist", "dist_module_fit.py")
     res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2", "--platform", "cpu",
+        [sys.executable, LAUNCH, "-n", str(nworkers), "--platform", "cpu",
          sys.executable, worker],
         env=_clean_env(), capture_output=True, text=True, timeout=600)
     sys.stdout.write(res.stdout[-4000:])
     assert res.returncode == 0, res.stdout[-4000:]
-    for r in range(2):
-        assert "dist_module_fit rank %d/2: OK" % r in res.stdout
+    for r in range(nworkers):
+        assert "dist_module_fit rank %d/%d: OK" % (r, nworkers) \
+            in res.stdout
 
 
 def test_launcher_propagates_failure():

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Kill stray training processes on this host (reference
+tools/kill-mxnet.py's role for the local launcher).  Matches processes
+whose command line contains the given pattern (default: the MXTPU worker
+env marker or a python command running a mxnet_tpu script).
+
+Usage::
+
+    python tools/kill-mxnet.py              # kill launcher workers
+    python tools/kill-mxnet.py train_lm.py  # kill by script name
+"""
+import os
+import signal
+import sys
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else None
+    me = os.getpid()
+    killed = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace")
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read().decode("utf-8", "replace")
+        except OSError:
+            continue
+        if pattern is not None:
+            match = pattern in cmd
+        else:
+            match = "MXTPU_WORKER_RANK=" in env and "python" in cmd
+        if match:
+            try:
+                os.kill(int(pid), signal.SIGTERM)
+                killed.append((int(pid), cmd.strip()[:80]))
+            except OSError:
+                pass
+    for pid, cmd in killed:
+        print("killed %d: %s" % (pid, cmd))
+    if not killed:
+        print("no matching processes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
