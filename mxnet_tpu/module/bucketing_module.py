@@ -124,7 +124,8 @@ class BucketingModule(BaseModule):
         module = Module(symbol, data_names, label_names, logger=self.logger,
                         context=self._context,
                         work_load_list=self._work_load_list,
-                        fixed_param_names=self._fixed_param_names)
+                        fixed_param_names=self._fixed_param_names,
+                        shared_params=True)
         module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
                     force_rebind=False, shared_module=None, grad_req=grad_req)
         self._curr_module = module
@@ -140,7 +141,8 @@ class BucketingModule(BaseModule):
             module = Module(symbol, data_names, label_names,
                             logger=self.logger, context=self._context,
                             work_load_list=self._work_load_list,
-                            fixed_param_names=self._fixed_param_names)
+                            fixed_param_names=self._fixed_param_names,
+                            shared_params=True)
             module.bind(data_shapes, label_shapes, self._curr_module.for_training,
                         self._curr_module.inputs_need_grad,
                         force_rebind=False,

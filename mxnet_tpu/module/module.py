@@ -26,8 +26,13 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, shared_params=False):
+        """``shared_params=True`` declares that this module's parameter
+        cells will be shared with other executors (BucketingModule's
+        contract); the fused SPMD path then never engages, since the
+        trainer owns its parameters exclusively."""
         super().__init__(logger=logger)
+        self._shared_across_buckets = bool(shared_params)
         if context is None:
             context = current_context()
         if isinstance(context, Context):
@@ -237,6 +242,13 @@ class Module(BaseModule):
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \
                 shared_module.binded and shared_module.params_initialized
+            if shared_module._fused is not None:
+                raise MXNetError(
+                    "shared_module runs the fused SPMD path (its executor "
+                    "buffers are released and its optimizer state lives in "
+                    "the trainer); construct both modules with "
+                    "shared_params=True before init_optimizer, or use a "
+                    "non-tpu kvstore")
             shared_group = shared_module._exec_group
         else:
             shared_group = None
@@ -361,6 +373,10 @@ class Module(BaseModule):
         if not self.for_training:
             return None
         reasons = []
+        if self._shared_across_buckets:
+            # BucketingModule shares parameter cells between bucket
+            # executors; the fused trainer owns its params exclusively
+            reasons.append("bucketed shape sharing")
         if self._state_names:
             reasons.append("state_names")
         if self.inputs_need_grad:

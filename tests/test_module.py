@@ -375,3 +375,49 @@ def test_module_output_shapes_with_fused():
     mod.init_params()
     mod.init_optimizer(kvstore="tpu")
     assert mod.output_shapes == [("softmax_output", (32, 3))]
+
+
+def test_bucketing_module_tpu_kvstore():
+    """BucketingModule with kvstore='tpu' declines the fused path (bucket
+    executors share parameter cells) and trains across bucket switches on
+    the kvstore push/pull path — regression for released-buffer sharing."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore="tpu")
+    assert mod._curr_module._fused is None
+    for key, bs in ((10, 8), (4, 4), (10, 8)):
+        b = mx.io.DataBatch(
+            data=[mx.nd.ones((bs, 10))], label=[mx.nd.zeros((bs,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (bs, 10))],
+            provide_label=[mx.io.DataDesc("softmax_label", (bs,))])
+        mod.forward_backward(b)
+        mod.update()
+    w = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert np.isfinite(w).all()
+
+
+def test_shared_module_against_fused_raises():
+    """bind(shared_module=) against a module on the fused path must fail
+    loudly (its exec buffers are released) instead of sharing 0-size
+    cells."""
+    X, y = make_blobs(64, 6, 3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    a = mx.mod.Module(mlp_sym(nh=8))
+    a.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a.init_params()
+    a.init_optimizer(kvstore="tpu")
+    assert a._fused is not None
+    b = mx.mod.Module(mlp_sym(nh=8))
+    with pytest.raises(mx.MXNetError, match="fused SPMD"):
+        b.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+               shared_module=a)

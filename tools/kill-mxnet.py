@@ -1,25 +1,42 @@
 #!/usr/bin/env python
 """Kill stray training processes on this host (reference
-tools/kill-mxnet.py's role for the local launcher).  Matches processes
-whose command line contains the given pattern (default: the MXTPU worker
-env marker or a python command running a mxnet_tpu script).
+tools/kill-mxnet.py's role for the local launcher).
+
+Default (no argument): kills processes carrying the launcher's
+MXTPU_WORKER_RANK env marker — i.e. workers spawned by tools/launch.py.
+With a pattern: kills PYTHON processes whose command line contains it
+(the invoking process and its ancestors are always excluded).
 
 Usage::
 
     python tools/kill-mxnet.py              # kill launcher workers
-    python tools/kill-mxnet.py train_lm.py  # kill by script name
+    python tools/kill-mxnet.py train_lm.py  # kill python ... train_lm.py
 """
 import os
 import signal
 import sys
 
 
+def _ancestors():
+    """pids of this process and its parent chain."""
+    out = set()
+    pid = os.getpid()
+    while pid > 1:
+        out.add(pid)
+        try:
+            with open("/proc/%d/stat" % pid) as f:
+                pid = int(f.read().rsplit(") ", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+    return out
+
+
 def main():
     pattern = sys.argv[1] if len(sys.argv) > 1 else None
-    me = os.getpid()
+    skip = _ancestors()
     killed = []
     for pid in os.listdir("/proc"):
-        if not pid.isdigit() or int(pid) == me:
+        if not pid.isdigit() or int(pid) in skip:
             continue
         try:
             with open("/proc/%s/cmdline" % pid, "rb") as f:
@@ -30,7 +47,7 @@ def main():
         except OSError:
             continue
         if pattern is not None:
-            match = pattern in cmd
+            match = pattern in cmd and "python" in cmd
         else:
             match = "MXTPU_WORKER_RANK=" in env and "python" in cmd
         if match:
