@@ -52,6 +52,9 @@ parser.add_argument("--disp-batches", type=int, default=50,
                     help="show progress for every n batches")
 parser.add_argument("--data-dir", type=str, default="./data",
                     help="directory holding ptb.train.txt / ptb.test.txt")
+parser.add_argument("--fused", type=int, default=0,
+                    help="1 = FusedRNNCell (one lax.scan per bucket — the "
+                         "cuDNN-RNN analog) instead of per-step unroll")
 
 
 def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
@@ -119,10 +122,15 @@ if __name__ == "__main__":
                                          buckets=buckets,
                                          invalid_label=invalid_label)
 
-    stack = mx.rnn.SequentialRNNCell()
-    for i in range(args.num_layers):
-        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
-                                  prefix="lstm_l%d_" % i))
+    if args.fused:
+        stack = mx.rnn.FusedRNNCell(args.num_hidden,
+                                    num_layers=args.num_layers,
+                                    mode="lstm", prefix="lstm_")
+    else:
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                      prefix="lstm_l%d_" % i))
 
     def sym_gen(seq_len):
         data = mx.sym.Variable("data")

@@ -249,6 +249,11 @@ class Module(BaseModule):
                     "the trainer); construct both modules with "
                     "shared_params=True before init_optimizer, or use a "
                     "non-tpu kvstore")
+            # the parent's parameter cells are now shared: it must never
+            # fuse later either (fusing would release the cells this
+            # module's executors alias)
+            shared_module._shared_across_buckets = True
+            self._shared_across_buckets = True
             shared_group = shared_module._exec_group
         else:
             shared_group = None

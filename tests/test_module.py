@@ -421,3 +421,24 @@ def test_shared_module_against_fused_raises():
     with pytest.raises(mx.MXNetError, match="fused SPMD"):
         b.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
                shared_module=a)
+
+
+def test_fused_declined_after_sharing_out():
+    """Reverse order of the shared-module guard: once another module has
+    bound against A, A must decline the fused path (fusing would release
+    the shared cells)."""
+    X, y = make_blobs(64, 6, 3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    a = mx.mod.Module(mlp_sym(nh=8))
+    a.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a.init_params()
+    b = mx.mod.Module(mlp_sym(nh=8))
+    b.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+           shared_module=a)
+    a.init_optimizer(kvstore="tpu")
+    assert a._fused is None  # declined: cells are shared with b
+    for batch in it:
+        a.forward_backward(batch)
+        a.update()
+        b.forward(batch, is_train=False)  # shared cells remain valid
+        break

@@ -636,3 +636,98 @@ def test_check_consistency_elementwise():
         {"ctx": mx.cpu(0), "shapes": {"x": (3, 7)}},
         {"ctx": mx.current_context(), "shapes": {"x": (3, 7)}},
     ])
+
+
+# ---------------------------------------------------------------------------
+# vision layers with custom lowerings (reference test_operator.py
+# test_roipooling / test_bilinear_sampler / test_grid_generator /
+# test_spatial_transformer / test_correlation)
+# ---------------------------------------------------------------------------
+
+def test_roipooling_gradient():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    sym = mx.sym.ROIPooling(data, rois, pooled_size=(2, 2),
+                            spatial_scale=1.0)
+    # distinct values -> unique max positions
+    a = (np.arange(32, dtype="f").reshape(1, 2, 4, 4) * 0.11 + 0.1) * \
+        _u((1, 2, 4, 4), 0.95, 1.05, seed=50)
+    r = np.asarray([[0, 0, 0, 3, 3], [0, 1, 1, 3, 3]], "f")
+    check_numeric_gradient(sym, {"data": a, "rois": r},
+                           grad_nodes=["data"], rtol=2e-2, atol=2e-3)
+
+
+def test_bilinear_sampler_gradient():
+    data = mx.sym.Variable("data")
+    grid = mx.sym.Variable("grid")
+    sym = mx.sym.BilinearSampler(data, grid)
+    a = _u((1, 2, 4, 4), 0.2, 1.0, seed=51)
+    g = _u((1, 2, 3, 3), -0.7, 0.7, seed=52)
+    check_numeric_gradient(sym, {"data": a, "grid": g}, rtol=3e-2,
+                           atol=3e-3)
+
+
+def test_grid_generator_affine_identity():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.GridGenerator(data, transform_type="affine",
+                               target_shape=(3, 3))
+    ident = np.asarray([[1, 0, 0, 0, 1, 0]], "f")
+    check_symbolic_forward(
+        sym, {"data": ident},
+        [np.stack(np.meshgrid(np.linspace(-1, 1, 3),
+                              np.linspace(-1, 1, 3),
+                              indexing="ij")[::-1])[None]],
+        rtol=1e-5)
+    check_numeric_gradient(sym, {"data": ident}, rtol=2e-2, atol=2e-3)
+
+
+def test_spatial_transformer_gradient():
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    sym = mx.sym.SpatialTransformer(data, loc, target_shape=(3, 3),
+                                    transform_type="affine",
+                                    sampler_type="bilinear")
+    a = _u((1, 1, 4, 4), 0.2, 1.0, seed=53)
+    # near-identity transform, away from sampling-kink boundaries
+    t = np.asarray([[0.9, 0.05, 0.02, -0.03, 0.85, 0.01]], "f")
+    check_numeric_gradient(sym, {"data": a, "loc": t}, rtol=3e-2,
+                           atol=3e-3)
+
+
+def test_correlation_forward_and_gradient():
+    d1 = mx.sym.Variable("data1")
+    d2 = mx.sym.Variable("data2")
+    sym = mx.sym.Correlation(d1, d2, kernel_size=1, max_displacement=1,
+                             stride1=1, stride2=1, pad_size=1,
+                             is_multiply=True)
+    a = _u((1, 2, 4, 4), 0.2, 1.0, seed=54)
+    b = _u((1, 2, 4, 4), 0.2, 1.0, seed=55)
+    check_numeric_gradient(sym, {"data1": a, "data2": b}, rtol=3e-2,
+                           atol=3e-3)
+
+
+def test_broadcast_logic_forward():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    a = np.asarray([[1.0, 2.0], [3.0, 4.0]], "f")
+    b = np.asarray([[2.0], [3.0]], "f")
+    for name, build, ref in [
+        ("broadcast_equal", mx.sym.broadcast_equal,
+         lambda p, q: (p == q).astype("f")),
+        ("broadcast_greater", mx.sym.broadcast_greater,
+         lambda p, q: (p > q).astype("f")),
+        ("broadcast_lesser_equal", mx.sym.broadcast_lesser_equal,
+         lambda p, q: (p <= q).astype("f")),
+        ("broadcast_logical_and", mx.sym.broadcast_logical_and,
+         lambda p, q: ((p != 0) & (q != 0)).astype("f")),
+    ]:
+        check_symbolic_forward(build(x, y), {"x": a, "y": b}, [ref(a, b)])
+
+
+def test_nan_reductions():
+    x = mx.sym.Variable("x")
+    a = np.asarray([[1.0, np.nan, 2.0], [np.nan, 3.0, 4.0]], "f")
+    check_symbolic_forward(mx.sym.nansum(x), {"x": a},
+                           [np.nansum(a).reshape(1)])
+    check_symbolic_forward(mx.sym.nanprod(x), {"x": a},
+                           [np.nanprod(a).reshape(1)])
