@@ -1,0 +1,50 @@
+"""Fine-tune a pretrained checkpoint on a new dataset (capability port of
+the reference example/image-classification/fine-tune.py: load the
+checkpoint, replace the classifier head, optionally scale down the lr of
+pretrained layers, train with common/fit.py)."""
+import argparse
+import logging
+
+from common import find_mxnet, data, fit  # noqa: F401
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.DEBUG)
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """Chop the network at ``layer_name`` and attach a fresh classifier
+    (reference fine-tune.py:get_fine_tune_model)."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes,
+                                name="fc-new")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc-new")}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix of the pretrained model")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten0",
+                        help="last layer kept from the pretrained net")
+    parser.set_defaults(image_shape="3,224,224", num_epochs=30,
+                        lr=0.01, lr_step_epochs="20", wd=0, mom=0)
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    sym, arg_params = get_fine_tune_model(
+        sym, arg_params, args.num_classes, args.layer_before_fullc)
+
+    fit.fit(args, sym, data.get_rec_iter,
+            arg_params=arg_params, aux_params=aux_params)

@@ -1,0 +1,60 @@
+"""Score a saved checkpoint on a validation set (capability port of the
+reference example/image-classification/score.py): load prefix-epoch,
+bind for inference, run metrics over the data."""
+import argparse
+import logging
+
+from common import find_mxnet, data  # noqa: F401
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(model_prefix, epoch, data_iter, metrics, batch_size,
+          max_num_examples=None):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(model_prefix,
+                                                           epoch)
+    mod = mx.mod.Module(sym, context=[mx.current_context()])
+    mod.bind(for_training=False, data_shapes=data_iter.provide_data,
+             label_shapes=data_iter.provide_label)
+    mod.set_params(arg_params, aux_params)
+    if not isinstance(metrics, list):
+        metrics = [metrics]
+    num = 0
+    for batch in data_iter:
+        mod.forward(batch, is_train=False)
+        for m in metrics:
+            mod.update_metric(m, batch.label)
+        num += batch_size
+        if max_num_examples is not None and num >= max_num_examples:
+            break
+    return [m.get_name_value() for m in metrics]
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="score a model on a dataset",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--model-prefix", type=str, required=True)
+    parser.add_argument("--load-epoch", type=int, required=True)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--max-num-examples", type=int, default=None)
+    parser.add_argument("--metrics", type=str, default="accuracy",
+                        help="comma-separated metric names")
+    data.add_data_args(parser)
+    data.add_data_aug_args(parser)
+    args = parser.parse_args()
+
+    rgb_mean = [float(i) for i in args.rgb_mean.split(",")]
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val, label_width=1,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        data_name="data", label_name="softmax_label",
+        data_shape=image_shape, batch_size=args.batch_size,
+        rand_crop=False, rand_mirror=False)
+    metrics = [mx.metric.create(m) for m in args.metrics.split(",")]
+    results = score(args.model_prefix, args.load_epoch, val, metrics,
+                    args.batch_size, args.max_num_examples)
+    for r in results:
+        logging.info("%s", r)

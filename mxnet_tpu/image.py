@@ -547,6 +547,8 @@ class _ProcessPipeline(object):
             self._augs = CreateAugmenter(tuple(data_shape), **aug_kwargs)
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._cmd = queue.Queue()
+        self._empty_exc = queue.Empty  # bound now: __del__ may run during
+        self._full_exc = queue.Full    # interpreter shutdown (no imports)
         self._at_end = False
         self._stopping = False
         self._abandon = False
@@ -572,12 +574,11 @@ class _ProcessPipeline(object):
 
     def _put(self, item):
         """Bounded put that stays interruptible for shutdown."""
-        import queue
         while not self._stopping:
             try:
                 self._queue.put(item, timeout=0.2)
                 return
-            except queue.Full:
+            except self._full_exc:
                 continue
 
     def _one_epoch(self):
@@ -683,8 +684,8 @@ class _ProcessPipeline(object):
 
     def shutdown(self):
         """Stop the reader thread BEFORE interpreter/XLA teardown — a
-        daemon thread killed mid-XLA-call aborts the process."""
-        import queue
+        daemon thread killed mid-XLA-call aborts the process.  No imports
+        here: __del__ can run while the interpreter shuts down."""
         self._stopping = True
         try:
             self._cmd.put_nowait("stop")
@@ -693,7 +694,9 @@ class _ProcessPipeline(object):
         try:
             while True:
                 self._queue.get_nowait()   # unblock a full-queue put
-        except queue.Empty:
+        except self._empty_exc:
+            pass
+        except Exception:  # noqa: BLE001
             pass
         try:
             self._thread.join(timeout=5)
