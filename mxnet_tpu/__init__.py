@@ -51,6 +51,7 @@ from . import io
 # live in image.py / image_det.py
 from . import image_det
 io.ImageRecordIter = image.ImageRecordIter
+io.ImageRecordUInt8Iter = image.ImageRecordUInt8Iter
 io.ImageDetRecordIter = image_det.ImageDetRecordIter
 from . import initializer
 from .initializer import init_registry

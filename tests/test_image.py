@@ -190,3 +190,22 @@ def test_record_iter_exhaustion_and_midepoch_reset(rec_dataset):
     it.reset()
     assert sum(1 for _ in it) == 3
     it.close()
+
+
+def test_image_record_uint8_iter(rec_dataset):
+    """Raw-pixel iterator (reference ImageRecordUInt8Iter): uint8 batches,
+    normalization rejected (belongs on device)."""
+    path, idx = rec_dataset
+    it = mx.io.ImageRecordUInt8Iter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+        batch_size=4, preprocess_threads=2)
+    b = it.next()
+    arr = b.data[0].asnumpy()
+    assert arr.dtype == np.uint8 or str(b.data[0].dtype) == "uint8"
+    assert arr.max() > 1  # raw pixel range, not normalized
+    it.close()
+    import pytest
+    with pytest.raises(mx.MXNetError, match="uint8"):
+        mx.io.ImageRecordUInt8Iter(
+            path_imgrec=path, data_shape=(3, 32, 32), batch_size=4,
+            mean_r=123.0)
