@@ -845,7 +845,9 @@ class ImageRecordIter(mxio.DataIter):
 
     @property
     def provide_data(self):
-        return self._it.provide_data
+        return [mxio.DataDesc(d.name, d.shape, dtype=np.dtype(
+            "float32" if self._dtype == "bfloat16" else self._dtype))
+            for d in self._it.provide_data]
 
     @property
     def provide_label(self):
@@ -899,8 +901,11 @@ class ImageRecordIter(mxio.DataIter):
                 data[j] = decoded[i]
                 lab = samples[i][0]
                 label[j] = lab
+            out = data.transpose(0, 3, 1, 2)
+            if np.dtype(self._dtype) == np.uint8:
+                out = np.clip(out, 0, 255)  # clamp, don't wrap
             batch = mxio.DataBatch(
-                [nd.array(data.transpose(0, 3, 1, 2)).astype(self._dtype)],
+                [nd.array(out).astype(self._dtype)],
                 [nd.array(label[:, 0] if self.label_width == 1 else label)],
                 pad=self.batch_size - n,
                 provide_data=self.provide_data,

@@ -107,7 +107,8 @@ def _conv_infer(attrs, in_shapes):
     return shapes, [(data[0], num_filter) + out_sp], []
 
 
-@register("Convolution", input_names=_fc_inputs, infer_shape=_conv_infer)
+@register("Convolution", input_names=_fc_inputs, infer_shape=_conv_infer,
+          aliases=("Convolution_v1",))
 def convolution(data, weight, bias=None, kernel=(), stride=None, dilate=None,
                 pad=None, num_filter=0, num_group=1, no_bias=False,
                 workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
@@ -786,7 +787,7 @@ def _make_loss_bwd(grad_scale, normalization, shape, g):
 _make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
 
 
-@register("MakeLoss")
+@register("MakeLoss", aliases=("make_loss",))
 def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     """Forward identity; backward emits grad_scale (reference
     src/operator/make_loss-inl.h:92-98)."""

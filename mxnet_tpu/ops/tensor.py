@@ -724,3 +724,57 @@ def softmax_cross_entropy(data, label):
     logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
     return -jnp.sum(picked)
+
+
+@register("_CrossDeviceCopy", hidden=True)
+def _cross_device_copy(data):
+    """Placement-boundary copy node (reference cross_device_copy.cc,
+    inserted by the PlaceDevice pass).  Under group2ctx the executor's
+    device_put at boundaries performs the transfer; graphs serialized by
+    the reference load and run with this as identity."""
+    return data
+
+
+def _slice_like_infer(attrs, in_shapes):
+    lhs = in_shapes[0]
+    if lhs is None:
+        return list(in_shapes), [None], []
+    new_in = [tuple(s) if s is not None else None for s in in_shapes]
+    if len(in_shapes) > 1 and in_shapes[1] is None:
+        # infer rhs as the sliced extent (reference SliceAssignOpShape)
+        begin = attrs.get("begin", ())
+        end = attrs.get("end", ())
+        step = attrs.get("step", ()) or (None,) * len(begin)
+        rhs = list(lhs)
+        for ax, (b, e, st) in enumerate(zip(begin, end, step)):
+            sl = slice(b, e, st)
+            start, stop, stride = sl.indices(lhs[ax])
+            rhs[ax] = max(0, -(-(stop - start) // stride))
+        new_in[1] = tuple(rhs)
+    return new_in, [tuple(lhs)], []
+
+
+@register("_slice_assign", input_names=("lhs", "rhs"),
+          aliases=("_crop_assign",), infer_shape=_slice_like_infer,
+          hidden=True)
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """lhs with lhs[begin:end] replaced by rhs (reference matrix_op
+    _slice_assign / _crop_assign — the graph form of x[a:b] = y).
+    begin/end entries may be None (full extent), like the slice op."""
+    def _i(v):
+        return None if v is None else int(v)
+    idx = tuple(slice(_i(b), _i(e), _i(s) if s else None)
+                for b, e, s in zip(begin, end,
+                                   step or (None,) * len(begin)))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_crop_assign_scalar", infer_shape=_slice_like_infer, hidden=True)
+def _crop_assign_scalar(data, scalar=0.0, begin=(), end=()):
+    """data with data[begin:end] = scalar (reference matrix_op
+    _crop_assign_scalar — the graph form of x[a:b] = c); None = full
+    extent."""
+    idx = tuple(slice(None if b is None else int(b),
+                      None if e is None else int(e))
+                for b, e in zip(begin, end))
+    return data.at[idx].set(scalar)

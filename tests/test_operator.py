@@ -731,3 +731,26 @@ def test_nan_reductions():
                            [np.nansum(a).reshape(1)])
     check_symbolic_forward(mx.sym.nanprod(x), {"x": a},
                            [np.nanprod(a).reshape(1)])
+
+
+def test_slice_assign_ops():
+    """Graph forms of x[a:b] = y / x[a:b] = c (reference matrix_op
+    _slice_assign/_crop_assign_scalar) and the _CrossDeviceCopy identity."""
+    a = _u((4, 5), seed=60)
+    r = _u((2, 3), seed=61)
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    sym = mx.sym._slice_assign(x, y, begin=(1, 1), end=(3, 4))
+    expect = a.copy()
+    expect[1:3, 1:4] = r
+    check_symbolic_forward(sym, {"x": a, "y": r}, [expect])
+    check_numeric_gradient(sym, {"x": a, "y": r}, rtol=2e-2, atol=2e-3)
+
+    sym = mx.sym._crop_assign_scalar(x, scalar=7.0, begin=(0, 0),
+                                     end=(2, 2))
+    expect = a.copy()
+    expect[0:2, 0:2] = 7.0
+    check_symbolic_forward(sym, {"x": a}, [expect])
+
+    sym = mx.sym._CrossDeviceCopy(x)
+    check_symbolic_forward(sym, {"x": a}, [a])
