@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import os
 import random as pyrandom
+import threading as _threading
 
 import numpy as np
 
@@ -25,6 +26,54 @@ from . import io as mxio
 from . import ndarray as nd
 from . import recordio
 from .base import MXNetError, get_env
+
+
+# ---------------------------------------------------------------------------
+# Augmentation RNG.  Augmenters draw from a THREAD-LOCAL rng when one has
+# been installed (decode workers, the pipeline reader thread), falling back
+# to the process-global modules otherwise (direct user calls keep reference
+# semantics).  Pipelines reseed per CHUNK, keyed off a monotonically
+# assigned chunk index — so a sample's augmentation is a pure function of
+# (user seed, chunk index), independent of which worker the scheduler
+# happens to hand the chunk to.
+# ---------------------------------------------------------------------------
+
+
+class _AugRngLocal(_threading.local):
+    def __init__(self):
+        self.py = None
+        self.np = None
+
+
+_AUG_RNG = _AugRngLocal()
+
+
+def _rpy():
+    return _AUG_RNG.py if _AUG_RNG.py is not None else pyrandom
+
+
+def _rnp():
+    return _AUG_RNG.np if _AUG_RNG.np is not None else np.random
+
+
+def _seed_aug_rng(seed_val):
+    _AUG_RNG.py = pyrandom.Random(int(seed_val))
+    _AUG_RNG.np = np.random.RandomState(int(seed_val) % (2 ** 31))
+
+
+def _chunk_seed(seed, chunk_idx, epoch=0):
+    """Deterministic per-chunk seed (splitmix64-style mix keeps successive
+    chunks decorrelated even for seed=0).  epoch and chunk mix through
+    separate 64-bit odd multipliers — no bit-packing, so no field-width
+    aliasing at any dataset size or epoch count."""
+    m = (1 << 64) - 1
+    x = (int(seed) * 0x9e3779b97f4a7c15
+         + int(chunk_idx) * 0xbf58476d1ce4e5b9
+         + int(epoch) * 0x2545f4914f6cdd1d) & m
+    x ^= x >> 30
+    x = (x * 0x94d049bb133111eb) & m
+    x ^= x >> 31
+    return x % (2 ** 31)
 
 __all__ = [
     "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
@@ -101,8 +150,8 @@ def random_crop(src, size, interp=2):
     (img, (x0, y0, w, h))."""
     h, w = src.shape[:2]
     new_w, new_h = scale_down((w, h), size)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
+    x0 = _rpy().randint(0, w - new_w)
+    y0 = _rpy().randint(0, h - new_h)
     return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
         (x0, y0, new_w, new_h)
 
@@ -129,7 +178,7 @@ def color_normalize(src, mean, std=None):
 def random_size_crop(src, size, min_area, ratio, interp=2):
     """Random area + aspect-ratio crop (inception-style)."""
     h, w = src.shape[:2]
-    new_ratio = pyrandom.uniform(*ratio)
+    new_ratio = _rpy().uniform(*ratio)
     if new_ratio * h > w:
         max_area = w * int(w / new_ratio)
     else:
@@ -137,12 +186,12 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
     min_area = min_area * h * w
     if max_area < min_area:
         return random_crop(src, size, interp)
-    new_area = pyrandom.uniform(min_area, max_area)
+    new_area = _rpy().uniform(min_area, max_area)
     new_w = int(np.sqrt(new_area * new_ratio))
     new_h = int(np.sqrt(new_area / new_ratio))
     new_w, new_h = min(new_w, w), min(new_h, h)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
+    x0 = _rpy().randint(0, w - new_w)
+    y0 = _rpy().randint(0, h - new_h)
     return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
         (x0, y0, new_w, new_h)
 
@@ -175,7 +224,7 @@ def RandomOrderAug(ts):
     def aug(src):
         src = [src]
         ts_ = list(ts)
-        pyrandom.shuffle(ts_)
+        _rpy().shuffle(ts_)
         for t in ts_:
             src = [j for i in src for j in t(i)]
         return src
@@ -188,20 +237,20 @@ def ColorJitterAug(brightness, contrast, saturation):
     coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
     if brightness > 0:
         def baug(src):
-            alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
+            alpha = 1.0 + _rpy().uniform(-brightness, brightness)
             return [src.astype(np.float32) * alpha]
         ts.append(baug)
     if contrast > 0:
         def caug(src):
             src = src.astype(np.float32)
-            alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
+            alpha = 1.0 + _rpy().uniform(-contrast, contrast)
             gray = (src * coef).sum(axis=2, keepdims=True)
             return [src * alpha + gray.mean() * (1.0 - alpha)]
         ts.append(caug)
     if saturation > 0:
         def saug(src):
             src = src.astype(np.float32)
-            alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
+            alpha = 1.0 + _rpy().uniform(-saturation, saturation)
             gray = (src * coef).sum(axis=2, keepdims=True)
             return [src * alpha + gray * (1.0 - alpha)]
         ts.append(saug)
@@ -211,7 +260,7 @@ def ColorJitterAug(brightness, contrast, saturation):
 def LightingAug(alphastd, eigval, eigvec):
     """PCA-based lighting noise (AlexNet style)."""
     def aug(src):
-        alpha = np.random.normal(0, alphastd, size=(3,))
+        alpha = _rnp().normal(0, alphastd, size=(3,))
         rgb = np.dot(eigvec * alpha, eigval)
         return [src.astype(np.float32) + rgb.astype(np.float32)]
     return aug
@@ -225,7 +274,7 @@ def ColorNormalizeAug(mean, std):
 
 def HorizontalFlipAug(p):
     def aug(src):
-        if pyrandom.random() < p:
+        if _rpy().random() < p:
             src = src[:, ::-1]
         return [src]
     return aug
@@ -292,8 +341,12 @@ class ImageIter(mxio.DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", seed=None, **kwargs):
         super(ImageIter, self).__init__()
+        # seeded shuffle order is reproducible regardless of which thread
+        # calls reset(); seed=None keeps reference semantics (global rng)
+        self._shuffle_rng = pyrandom.Random(seed) if seed is not None \
+            else pyrandom
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         self.imgrec = None
         self.imgidx = None
@@ -372,7 +425,7 @@ class ImageIter(mxio.DataIter):
 
     def reset(self):
         if self.shuffle and self.seq is not None:
-            pyrandom.shuffle(self.seq)
+            self._shuffle_rng.shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0
@@ -475,10 +528,12 @@ _PP_AUG = None
 
 
 def _pp_init(data_shape, aug_kwargs, seed):
+    """Worker initializer.  Installs a thread-local aug rng seeded from the
+    user seed; _pp_work_chunk reseeds it per CHUNK so augmentation is a pure
+    function of (seed, chunk index) — independent of pid and of which
+    worker the scheduler hands a chunk to."""
     global _PP_AUG
-    import os as _os
-    pyrandom.seed(seed + _os.getpid())
-    np.random.seed((seed + _os.getpid()) % (2 ** 31))
+    _seed_aug_rng(_chunk_seed(seed, 0))
     _PP_AUG = CreateAugmenter(tuple(data_shape), **aug_kwargs)
 
 
@@ -497,9 +552,11 @@ def _pp_work(raw, augs=None):
         return None
 
 
-def _pp_work_chunk(raws):
+def _pp_work_chunk(raws, chunk_seed=None):
     """Decode+augment a chunk of records in one IPC round trip (amortizes
     submit/pickle overhead, like the reference's per-chunk omp decode)."""
+    if chunk_seed is not None:
+        _seed_aug_rng(chunk_seed)
     return [_pp_work(r) for r in raws]
 
 
@@ -507,7 +564,7 @@ class _ProcessPipeline(object):
     """Reader thread + spawned decode workers + bounded batch queue."""
 
     def __init__(self, it, data_shape, batch_size, label_width, aug_kwargs,
-                 num_workers, prefetch, dtype, allow_procs=True):
+                 num_workers, prefetch, dtype, allow_procs=True, seed=0):
         import concurrent.futures as cf
         import multiprocessing as mp
         import queue
@@ -540,11 +597,15 @@ class _ProcessPipeline(object):
             self._pool = cf.ProcessPoolExecutor(
                 max_workers=self._workers, mp_context=ctx,
                 initializer=_pp_init,
-                initargs=(tuple(data_shape), dict(aug_kwargs), 0))
+                initargs=(tuple(data_shape), dict(aug_kwargs), seed))
             self._augs = None
         else:
             self._pool = None
             self._augs = CreateAugmenter(tuple(data_shape), **aug_kwargs)
+        self._seed = int(seed)
+        self._epoch_no = 0   # epoch ordinal: chunk seeds derive from
+        # (seed, epoch, chunk-within-epoch), so an abandoned (mid-epoch
+        # reset) epoch can't make later epochs timing-dependent
         self._queue = queue.Queue(maxsize=max(1, prefetch))
         self._cmd = queue.Queue()
         self._empty_exc = queue.Empty  # bound now: __del__ may run during
@@ -585,6 +646,8 @@ class _ProcessPipeline(object):
         from collections import deque
         chunk = max(1, min(16, self._bs))
         max_inflight = self._workers * 4
+        self._epoch_no += 1
+        chunk_in_epoch = 0
         inflight = deque()
         ready = []          # decoded (img, label) awaiting batch assembly
         exhausted = False
@@ -601,12 +664,20 @@ class _ProcessPipeline(object):
                     raws.append(raw)
                     labs.append(np.asarray(lab, dtype=np.float32))
                 if raws:
+                    cseed = _chunk_seed(self._seed, chunk_in_epoch,
+                                        epoch=self._epoch_no)
+                    chunk_in_epoch += 1
                     if self._pool is None:
+                        # inline path: same per-chunk derivation, installed
+                        # on the reader thread's thread-local rng (user
+                        # threads' global RNG state is untouched)
+                        _seed_aug_rng(cseed)
                         inflight.append((_Done([_pp_work(r, self._augs)
                                                 for r in raws]), labs))
                     else:
                         inflight.append(
-                            (self._pool.submit(_pp_work_chunk, raws), labs))
+                            (self._pool.submit(_pp_work_chunk, raws, cseed),
+                             labs))
             if inflight:
                 fut, labs = inflight.popleft()
                 for img, lab in zip(fut.result(), labs):
@@ -798,18 +869,21 @@ class ImageRecordIter(mxio.DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size,
                  path_imgidx=None, label_width=1, shuffle=False,
-                 shuffle_chunk_seed=0, seed=0, part_index=0, num_parts=1,
+                 shuffle_chunk_seed=0, seed=None, part_index=0, num_parts=1,
                  prefetch_buffer=4, preprocess_threads=4, round_batch=True,
                  data_name="data", label_name="softmax_label", dtype="float32",
                  **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
+        from . import random as _random
+        self._eff_seed = _random.get_seed() if seed is None else int(seed)
         aug_kwargs = _translate_cxx_aug_params(aug_kwargs)
         has_custom_augs = "aug_list" in aug_kwargs
         self._it = ImageIter(
             batch_size, data_shape, label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx,
             shuffle=shuffle, part_index=part_index, num_parts=num_parts,
-            data_name=data_name, label_name=label_name, **aug_kwargs)
+            data_name=data_name, label_name=label_name,
+            seed=self._eff_seed, **aug_kwargs)
         # Fast path: spawned decode-worker processes (cv2 holds the GIL, so
         # in-process threading cannot scale; see _ProcessPipeline).  Custom
         # aug_list closures aren't picklable -> engine-threaded fallback,
@@ -827,10 +901,12 @@ class ImageRecordIter(mxio.DataIter):
             self._pipeline = _ProcessPipeline(
                 self._it, tuple(data_shape), batch_size, label_width,
                 aug_kwargs, preprocess_threads, prefetch_buffer, dtype,
-                allow_procs=spawnable_main)
+                allow_procs=spawnable_main, seed=self._eff_seed)
         else:
             from . import engine as eng
             self._engine = eng.Engine(num_workers=max(2, preprocess_threads))
+            self._img_base = 0   # global sample ordinal: engine-path
+            # augmentation seeds derive per image from (seed, ordinal)
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
@@ -876,12 +952,17 @@ class ImageRecordIter(mxio.DataIter):
 
         decoded = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
         valid = [False] * self.batch_size
+        img_base = self._img_base
+        self._img_base += self.batch_size
 
         def decode_i(i):
             samples = raw["samples"]
             if i >= len(samples):
                 return
             try:
+                # per-image deterministic stream: independent of which
+                # engine worker thread runs this op
+                _seed_aug_rng(_chunk_seed(self._eff_seed, img_base + i))
                 decoded[i] = it.decode_augment(samples[i][1])
                 valid[i] = True
             except (RuntimeError, MXNetError) as e:

@@ -464,6 +464,12 @@ class Module(BaseModule):
                 # forward → backward → update contract (metric sees outputs
                 # of pre-update weights) holds with one fused program
                 self._fused_batch = self._fused_feed(data_batch)
+                # this step's RNG key is drawn LAZILY (first of
+                # get_outputs-preview or update) so a forward that is never
+                # followed by either leaves the training key stream
+                # untouched, while a preview still sees the exact masks the
+                # deferred step will apply (advisor r2 finding)
+                self._fused_key = None
                 self._fused_outputs = None
             else:
                 outs = self._fused.eval_step(*self._fused_feed(data_batch))
@@ -488,9 +494,11 @@ class Module(BaseModule):
         if self._fused is not None:
             assert self._fused_batch is not None, \
                 "update() without a prior forward(is_train=True)"
-            outs = self._fused.step(*self._fused_batch)
+            outs = self._fused.step(*self._fused_batch,
+                                    key=self._draw_fused_key())
             self._fused_outputs = [NDArray._from_jax(o) for o in outs]
             self._fused_batch = None
+            self._fused_key = None
             return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -503,14 +511,24 @@ class Module(BaseModule):
                            num_device=len(self._context),
                            kvstore=self._kvstore)
 
+    def _draw_fused_key(self):
+        """Draw the deferred step's key on first need; a repeated call
+        (preview then update) returns the same key."""
+        if getattr(self, "_fused_key", None) is None:
+            from .. import random as _random
+            self._fused_key = _random.next_key()
+        return self._fused_key
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
         if self._fused is not None:
             if self._fused_outputs is None and self._fused_batch is not None:
                 # outputs requested between forward_backward() and update()
-                # (e.g. a custom loop): train-mode forward with a peeked
-                # RNG key (doesn't shift the training stream)
-                outs = self._fused.forward_only(*self._fused_batch)
+                # (e.g. a custom loop): train-mode forward with the SAME key
+                # the deferred step will consume, so stochastic layers show
+                # the outputs that correspond to the applied gradients
+                outs = self._fused.forward_only(
+                    *self._fused_batch, key=self._draw_fused_key())
                 self._fused_outputs = [NDArray._from_jax(o) for o in outs]
             return list(self._fused_outputs or [])
         return self._exec_group.get_outputs(merge_multi_context)

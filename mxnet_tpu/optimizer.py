@@ -374,20 +374,25 @@ class Updater(object):
         if isinstance(loaded, dict) and "states" in loaded \
                 and "num_update" in loaded:
             # blob saved by the fused SPMD path ({name: tuple}) — convert to
-            # this updater's {index_or_name: state} convention
-            name2idx = {n: i for i, n in
-                        (getattr(self.optimizer, "idx2name", {}) or {}).items()}
+            # this updater's {index_or_name: state} convention.  With
+            # multiple contexts idx2name maps SEVERAL indices (i*len(ctx)+k)
+            # to one name, and every per-device slot must get the restored
+            # state, not just one.
+            name2indices = {}
+            for i, n in (getattr(self.optimizer, "idx2name", {}) or {}).items():
+                name2indices.setdefault(n, []).append(i)
             self.optimizer.num_update = max(self.optimizer.num_update,
                                             loaded["num_update"])
             converted = {}
             for name, s in loaded["states"].items():
-                key = name2idx.get(name, name)
                 if len(s) == 0:
-                    converted[key] = None
+                    val = None
                 elif len(s) == 1:
-                    converted[key] = s[0]
+                    val = s[0]
                 else:
-                    converted[key] = tuple(s)
+                    val = tuple(s)
+                for key in name2indices.get(name, [name]):
+                    converted[key] = val
             loaded = converted
         self.states = {k: _state_from_numpy(v) for k, v in loaded.items()}
 

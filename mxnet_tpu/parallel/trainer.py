@@ -340,22 +340,40 @@ class SPMDTrainer(object):
         if not self._multiproc:
             return outs
         from jax.experimental import multihost_utils
+        dp = self.mesh.shape[self.data_axis]
         local = []
         for o in outs:
-            spec = P(self.data_axis, *([None] * (o.ndim - 1)))
+            # prefer the array's ACTUAL sharding over a shape heuristic: a
+            # replicated output whose leading dim happens to divide dp must
+            # not be sliced
+            s = getattr(o, "sharding", None)
+            if o.ndim == 0 or (s is not None and s.is_fully_replicated):
+                spec = P()
+            elif isinstance(s, NamedSharding):
+                spec = s.spec
+            elif o.shape[0] % dp == 0:
+                spec = P(self.data_axis, *([None] * (o.ndim - 1)))
+            else:
+                spec = P()
             local.append(multihost_utils.global_array_to_host_local_array(
                 o, self.mesh, spec))
         return local
 
-    def step(self, *batch_arrays):
-        """One fused train step: data+labels in input_names order."""
+    def step(self, *batch_arrays, key=None):
+        """One fused train step: data+labels in input_names order.
+
+        ``key`` lets a caller that already previewed this step's outputs
+        (module.get_outputs between forward and update) hand in the exact
+        key so stochastic layers draw the same masks in both passes."""
         from .. import random as _random
         data = self._shard_batch(batch_arrays)
         self._num_update += 1
         lr = self.optimizer.lr if self.optimizer.lr_scheduler is None else \
             self.optimizer.lr_scheduler(self._num_update)
+        if key is None:
+            key = _random.next_key()
         self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, data, _random.next_key(),
+            self.params, self.aux, self.opt_state, data, key,
             jnp.asarray(lr, jnp.float32), jnp.asarray(self.optimizer.wd,
                                                       jnp.float32),
             self._num_update)
@@ -369,16 +387,18 @@ class SPMDTrainer(object):
         return self._localize(
             self._eval_fn(self.params, self.aux, data, _random.next_key()))
 
-    def forward_only(self, *batch_arrays):
+    def forward_only(self, *batch_arrays, key=None):
         """Train-mode forward WITHOUT the update, for output inspection
-        between forward_backward() and update().  Uses a peeked RNG key so
-        the training stream is not advanced; stochastic layers (Dropout)
-        therefore draw different masks than the actual step will."""
+        between forward_backward() and update().  Pass the same ``key`` the
+        deferred step() will consume so stochastic layers (Dropout) draw
+        identical masks; with no key, a peeked key is used (training stream
+        not advanced, but masks differ from the eventual step)."""
         from .. import random as _random
         data = self._shard_batch(batch_arrays)
+        if key is None:
+            key = _random.peek_key()
         return self._localize(
-            self._eval_fn(self.params, self.aux, data, _random.peek_key(),
-                          True))
+            self._eval_fn(self.params, self.aux, data, key, True))
 
     @property
     def outputs(self):

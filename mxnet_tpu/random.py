@@ -34,7 +34,21 @@ def seed(seed_state):
     """
     if not isinstance(seed_state, (int, _np.integer)):
         raise ValueError("seed must be an int")
+    global _seed_int
     _state.key = jax.random.PRNGKey(int(seed_state))
+    _seed_int = int(seed_state)
+
+
+_seed_int = _DEFAULT_SEED
+
+
+def get_seed():
+    """The integer last passed to :func:`seed` (framework default if never
+    seeded) — lets host-side components (data-augmentation workers) derive
+    deterministic streams from the same user seed.  Process-global (not
+    thread-local, unlike the PRNG key chain): it is host metadata, and an
+    iterator built on a loader thread must see the main thread's seed."""
+    return _seed_int
 
 
 def next_key():
