@@ -15,7 +15,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libmxtpu.so")
-_SRCS = ("engine.cc", "recordio.cc")
+_SRCS = ("engine.cc", "recordio.cc", "imagedec.cc")
 
 _lock = threading.Lock()
 _lib = None
@@ -30,11 +30,21 @@ def _build():
     """
     srcs = [os.path.join(_DIR, s) for s in _SRCS]
     tmp = _LIB_PATH + ".%d.tmp" % os.getpid()
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-o", tmp] + srcs
+    base = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-o", tmp]
+    # Preferred build includes the libjpeg image pipeline; hosts without
+    # libjpeg still get the engine + recordio codec (image callers fall
+    # back to the cv2 path).
+    attempts = [base + srcs + ["-ljpeg"],
+                base + [s for s in srcs if not s.endswith("imagedec.cc")]]
     try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=300)
-        if proc.returncode != 0 or not os.path.exists(tmp):
+        built = False
+        for cmd in attempts:
+            proc = subprocess.run(cmd, capture_output=True, timeout=300)
+            if proc.returncode == 0 and os.path.exists(tmp):
+                built = True
+                break
+        if not built:
             return False
         os.replace(tmp, _LIB_PATH)
     except (OSError, subprocess.TimeoutExpired):
@@ -99,6 +109,29 @@ def _configure(lib):
     lib.MXTPURecordIOReaderTell.argtypes = [p]
     lib.MXTPURecordIOReaderClose.argtypes = [p]
     lib.MXTPUFree.argtypes = [p]
+
+    # Image pipeline (absent when the host lacks libjpeg — callers probe
+    # with has_imagedec()).
+    try:
+        fp = ctypes.POINTER(ctypes.c_float)
+        pp = ctypes.POINTER(ctypes.c_void_p)
+        lib.MXTPUImgPipeCreate.restype = p
+        lib.MXTPUImgPipeCreate.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, fp, fp]
+        lib.MXTPUImgPipeDecodeBatch.restype = ctypes.c_int
+        lib.MXTPUImgPipeDecodeBatch.argtypes = [
+            p, pp, ctypes.POINTER(u64), ctypes.c_int, p,
+            ctypes.POINTER(ctypes.c_uint8), u64]
+        lib.MXTPUImgPipeDestroy.argtypes = [p]
+        lib.MXTPUImgDecodeDims.restype = ctypes.c_int
+        lib.MXTPUImgDecodeDims.argtypes = [
+            p, u64, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+        lib.MXTPUImgDecode.restype = ctypes.c_int
+        lib.MXTPUImgDecode.argtypes = [p, u64, p, ctypes.c_int]
+        lib._has_imagedec = True
+    except AttributeError:
+        lib._has_imagedec = False
     return lib
 
 

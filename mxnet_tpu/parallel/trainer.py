@@ -65,7 +65,7 @@ class SPMDTrainer(object):
 
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
-                 compute_dtype=None, remat=None):
+                 compute_dtype=None, remat=None, input_transforms=None):
         import jax
         from ..base import get_env
         self.symbol = symbol
@@ -83,6 +83,14 @@ class SPMDTrainer(object):
             d.process_index != jax.process_index()
             for d in mesh.devices.flat)
         self.data_axis = data_axis
+        # On-device input preprocessing, compiled into the fused step: maps
+        # input name -> jax-traceable fn.  The TPU-first feed path sends raw
+        # uint8 NHWC batches over the (slow) host link and does
+        # normalize/transpose/cast here, where they fuse into the first
+        # conv for free (the reference instead normalizes on the host in
+        # its C++ iterator, src/io/iter_normalize.h).  bind() shapes refer
+        # to the POST-transform (symbol-visible) shapes.
+        self.input_transforms = dict(input_transforms or {})
         self.param_shardings = param_shardings or {}
         self.compute_dtype = compute_dtype and np.dtype(compute_dtype)
         if isinstance(optimizer, str):
@@ -271,8 +279,17 @@ class SPMDTrainer(object):
         eval_fn = self._eval
         param_names = tuple(self.param_names)
         compute_dtype = self.compute_dtype
+        transforms = dict(self.input_transforms)
+
+        def xform(data):
+            if not transforms:
+                return dict(data)
+            return {k: (transforms[k](v) if k in transforms else v)
+                    for k, v in data.items()}
 
         def step(params, aux, opt_state, data, rng, lr, wd, t):
+            data = xform(data)
+
             def loss_fn(p):
                 if compute_dtype is not None:
                     p = {k: v.astype(compute_dtype) for k, v in p.items()}
@@ -299,7 +316,7 @@ class SPMDTrainer(object):
             if compute_dtype is not None:
                 params = {k: v.astype(compute_dtype)
                           for k, v in params.items()}
-            merged = dict(data)
+            merged = xform(data)
             merged.update(params)
             outs, _ = eval_fn(merged, aux, rng, is_train)
             return outs

@@ -270,7 +270,169 @@ def test_record_iter_seed_engine_fallback(rec_dataset, monkeypatch):
     """The engine-threaded fallback path honors seed too (per-image streams
     derived from the global sample ordinal)."""
     monkeypatch.setenv("MXNET_RECORDITER_PROCS", "0")
+    monkeypatch.setenv("MXNET_RECORDITER_NATIVE", "0")
     path, idx = rec_dataset
     a = _collect_epoch(path, idx, seed=11)
     b = _collect_epoch(path, idx, seed=11)
     np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# native (libjpeg) pipeline — mxnet_tpu/native/imagedec.cc
+# ---------------------------------------------------------------------------
+
+def _native_available():
+    from mxnet_tpu import native
+    lib = native.get_lib()
+    return lib is not None and getattr(lib, "_has_imagedec", False)
+
+
+needs_native = pytest.mark.skipif(not _native_available(),
+                                  reason="native image pipeline unavailable")
+
+
+@needs_native
+def test_native_pipeline_selected_and_exact(rec_dataset):
+    """Supported aug sets pick the native pipeline, and its unit-scale
+    center crop is byte-exact vs the cv2 decode reference."""
+    import cv2
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, preprocess_threads=2, seed=3)
+    assert isinstance(it._pipeline, image._NativePipeline)
+    b = it.next()
+    got = b.data[0].asnumpy()  # f32 NCHW, center crop (no rand augs)
+    it.close()
+
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    for i in range(4):
+        hdr, raw = recordio.unpack(r.read_idx(i))
+        ref = cv2.imdecode(np.frombuffer(bytes(raw), np.uint8), 1)[..., ::-1]
+        h, w = ref.shape[:2]
+        y0, x0 = (h - 24) // 2, (w - 24) // 2
+        ref_crop = ref[y0:y0 + 24, x0:x0 + 24].transpose(2, 0, 1)
+        np.testing.assert_array_equal(got[i].astype(np.uint8), ref_crop)
+    r.close()
+
+
+@needs_native
+def test_native_pipeline_nhwc_uint8(rec_dataset):
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, dtype="uint8", layout="NHWC", rand_mirror=True,
+        seed=3)
+    b = it.next()
+    arr = b.data[0].asnumpy()
+    assert arr.shape == (4, 24, 24, 3) and arr.dtype == np.uint8
+    assert it.provide_data[0].shape == (4, 24, 24, 3)
+    it.close()
+
+
+@needs_native
+def test_native_pipeline_normalization(rec_dataset):
+    """mean/std run inside the native decoder and match numpy."""
+    import cv2
+    path, idx = rec_dataset
+    mean = [123.68, 116.28, 103.53]
+    std = [58.395, 57.12, 57.375]
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=2, mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+        std_r=std[0], std_g=std[1], std_b=std[2], seed=3)
+    assert isinstance(it._pipeline, image._NativePipeline)
+    got = it.next().data[0].asnumpy()
+    it.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    hdr, raw = recordio.unpack(r.read_idx(0))
+    ref = cv2.imdecode(np.frombuffer(bytes(raw), np.uint8), 1)[..., ::-1]
+    h, w = ref.shape[:2]
+    y0, x0 = (h - 24) // 2, (w - 24) // 2
+    crop = ref[y0:y0 + 24, x0:x0 + 24].astype(np.float32)
+    refn = ((crop - np.array(mean, np.float32))
+            / np.array(std, np.float32)).transpose(2, 0, 1)
+    np.testing.assert_allclose(got[0], refn, atol=1e-4)
+    r.close()
+
+
+@needs_native
+def test_native_pipeline_resize_path(rec_dataset):
+    """resize (shorter-edge) before crop takes the bilinear path; output is
+    close to the cv2 resize+crop reference (DCT prescale divergence only)."""
+    import cv2
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=2, resize=32, seed=3)
+    assert isinstance(it._pipeline, image._NativePipeline)
+    got = it.next().data[0].asnumpy()
+    it.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    hdr, raw = recordio.unpack(r.read_idx(0))
+    ref = cv2.imdecode(np.frombuffer(bytes(raw), np.uint8), 1)[..., ::-1]
+    h, w = ref.shape[:2]
+    if h > w:
+        nh, nw = 32 * h // w, 32
+    else:
+        nh, nw = 32, 32 * w // h
+    rr = cv2.resize(ref, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    y0, x0 = (nh - 24) // 2, (nw - 24) // 2
+    refc = rr[y0:y0 + 24, x0:x0 + 24].astype(np.float32).transpose(2, 0, 1)
+    err = np.abs(got[0] - refc)
+    assert err.mean() < 3.0 and err.max() < 40.0
+    r.close()
+
+
+@needs_native
+def test_native_pipeline_bad_record_skipped(tmp_path):
+    """A corrupt image inside the rec stream is skipped (pad accounts for
+    it), like the reference parser's per-image error tolerance."""
+    import cv2
+    path = str(tmp_path / "bad.rec")
+    idx = str(tmp_path / "bad.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(4):
+        if i == 2:
+            payload = b"notajpeg" * 10
+        else:
+            ok, buf = cv2.imencode(".jpg", _gradient_img(seed=i))
+            payload = buf.tobytes()
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payload))
+    w.close()
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, seed=3)
+    assert isinstance(it._pipeline, image._NativePipeline)
+    b = it.next()
+    assert b.pad == 1  # 3 valid of 4
+    labels = b.label[0].asnumpy()
+    np.testing.assert_array_equal(labels[:3], [0.0, 1.0, 3.0])
+    it.close()
+
+
+@needs_native
+def test_native_pipeline_partial_tail_batch(rec_dataset):
+    """20 images, batch 8 -> last batch pad=4 with zeroed tail."""
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=8, dtype="uint8", layout="NHWC", seed=3)
+    batches = list(it)
+    it.close()
+    assert [b.pad for b in batches] == [0, 0, 4]
+    tail = batches[-1].data[0].asnumpy()
+    assert tail[4:].max() == 0
+
+
+def test_native_pipeline_fallback_unsupported_augs(rec_dataset):
+    """brightness jitter isn't native — the process pipeline takes over."""
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, brightness=0.2, seed=3)
+    assert not isinstance(it._pipeline, image._NativePipeline)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 24, 24)
+    it.close()

@@ -233,3 +233,63 @@ def test_spmd_trainer_remat_matches():
     a, b = run(False), run(True)
     for k in a:
         np.testing.assert_allclose(b[k], a[k], rtol=1e-6, err_msg=k)
+
+
+def test_spmd_trainer_input_transforms():
+    """On-device input preprocessing compiled into the fused step: feeding
+    raw uint8 NHWC batches through a normalize/transpose transform gives
+    the same training trajectory as feeding host-preprocessed f32 NCHW
+    (the TPU-first raw-pixel feed path; reference normalizes on the host
+    in its C++ iterator, src/io/iter_normalize.h)."""
+    import jax.numpy as jnp
+
+    def conv_sym():
+        data = mx.sym.Variable("data")
+        net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                                 name="c1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Flatten(net)
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rs = np.random.RandomState(3)
+    raw = rs.randint(0, 255, (4, 8, 8, 3)).astype(np.uint8)  # NHWC u8
+    labels = rs.randint(0, 3, 4).astype("f")
+    mean = jnp.array([120.0, 115.0, 100.0], jnp.float32)
+    std = jnp.array([58.0, 57.0, 56.0], jnp.float32)
+
+    def tf(x):
+        return jnp.transpose((x.astype(jnp.float32) - mean) / std,
+                             (0, 3, 1, 2))
+
+    tr_a = SPMDTrainer(conv_sym(), "sgd", {"learning_rate": 0.1},
+                       mesh=None, input_transforms={"data": tf})
+    tr_a.bind([("data", (4, 3, 8, 8))], [("softmax_label", (4,))])
+    mx.random.seed(5)
+    tr_a.init_params(mx.initializer.Xavier())
+
+    tr_b = SPMDTrainer(conv_sym(), "sgd", {"learning_rate": 0.1},
+                       mesh=None)
+    tr_b.bind([("data", (4, 3, 8, 8))], [("softmax_label", (4,))])
+    mx.random.seed(5)
+    tr_b.init_params(mx.initializer.Xavier())
+
+    host = ((raw.astype(np.float32) - np.array([120, 115, 100], np.float32))
+            / np.array([58, 57, 56], np.float32)).transpose(0, 3, 1, 2)
+    for _ in range(3):
+        oa = tr_a.step(mx.nd.array(raw, dtype="uint8"),
+                       mx.nd.array(labels))
+        ob = tr_b.step(mx.nd.array(host), mx.nd.array(labels))
+    np.testing.assert_allclose(np.asarray(oa[0]), np.asarray(ob[0]),
+                               rtol=1e-5, atol=1e-5)
+    pa, _ = tr_a.get_params()
+    pb, _ = tr_b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+    # eval path applies the same transform
+    ea = tr_a.eval_step(mx.nd.array(raw, dtype="uint8"),
+                        mx.nd.array(labels))
+    eb = tr_b.eval_step(mx.nd.array(host), mx.nd.array(labels))
+    np.testing.assert_allclose(np.asarray(ea[0]), np.asarray(eb[0]),
+                               rtol=1e-5, atol=1e-5)
