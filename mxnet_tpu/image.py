@@ -92,17 +92,14 @@ def _cv2():
 
 def imdecode(buf, flag=1, to_rgb=1, out=None):
     """Decode an image from bytes into an HWC uint8 array (reference
-    image.py:imdecode; to_rgb=1 gives RGB, the reference's default)."""
-    cv2 = _cv2()
+    image.py:imdecode; to_rgb=1 gives RGB, the reference's default).
+
+    JPEG payloads decode through the native libjpeg path when available
+    (shared with the mx.nd.imdecode op); everything else via cv2."""
     if isinstance(buf, nd.NDArray):
         buf = buf.asnumpy()
-    img = cv2.imdecode(np.frombuffer(bytes(buf), dtype=np.uint8), flag)
-    if img is None:
-        raise MXNetError("cannot decode image")
-    if to_rgb and img.ndim == 3:
-        img = img[..., ::-1]
-    if img.ndim == 2:
-        img = img[:, :, None]
+    from .ops.image_io import _decode_host
+    img = _decode_host(bytes(buf), int(flag), int(to_rgb))
     return np.ascontiguousarray(img)
 
 
@@ -807,6 +804,23 @@ def _host_cores():
         return os.cpu_count() or 1
 
 
+def _rec_looks_jpeg(path_imgrec):
+    """Peek at the first record's image payload: JPEG magic FFD8?"""
+    try:
+        r = recordio.MXRecordIO(path_imgrec, "r")
+        try:
+            s = r.read()
+            if s is None:
+                return True  # empty file: either path handles it
+            _, img = recordio.unpack(s)
+            head = bytes(img[:2])
+            return head == b"\xff\xd8"
+        finally:
+            r.close()
+    except Exception:  # noqa: BLE001 — be permissive, decode errors surface later
+        return True
+
+
 class _NativePipeline(_AsyncPipeline):
     """Decode via the native libjpeg pipeline (native/imagedec.cc) — the
     TPU-first rebuild of the reference's in-engine C++ decode threads
@@ -890,7 +904,10 @@ class _NativePipeline(_AsyncPipeline):
                                               seed=seed)
 
     def _shutdown_extra(self):
-        if self._pipe:
+        # only free the C++ pipe once the reader thread is provably out of
+        # MXTPUImgPipeDecodeBatch — if the join timed out, leak the pipe
+        # rather than delete an object a live thread is executing in
+        if self._pipe and not self._thread.is_alive():
             self._lib.MXTPUImgPipeDestroy(self._pipe)
             self._pipe = None
 
@@ -933,7 +950,13 @@ class _NativePipeline(_AsyncPipeline):
                 self._pipe, bufs, lens, n, out.ctypes.data_as(ct.c_void_p),
                 valid.ctypes.data_as(u8p), cseed)
             if nv == 0:
-                continue
+                # an entire batch of undecodable records is a dataset-level
+                # problem (e.g. non-JPEG payloads), not per-image noise —
+                # fail loudly instead of silently draining the epoch
+                raise MXNetError(
+                    "native image pipeline: every record in a batch failed "
+                    "to decode — is this a non-JPEG .rec? Set "
+                    "MXNET_RECORDITER_NATIVE=0 to use the cv2 pipeline")
             keep = np.flatnonzero(valid[:n])
             lab_arr = np.zeros((bs, self._lw), np.float32)
             lab_arr[:nv] = np.asarray(labs, np.float32).reshape(
@@ -1052,10 +1075,13 @@ class ImageRecordIter(mxio.DataIter):
             seed=self._eff_seed, **aug_kwargs)
         self._pipeline = None
         # Fastest path: native C++ decode pipeline (libjpeg, GIL-released),
-        # when the requested augmentations are natively implemented.
+        # when the requested augmentations are natively implemented AND the
+        # first record looks like JPEG (PNG/BMP .rec files take the cv2
+        # paths — libjpeg cannot decode them).
         if (not has_custom_augs
                 and get_env("MXNET_RECORDITER_NATIVE", "1") != "0"
-                and set(aug_kwargs) <= _NativePipeline.SUPPORTED):
+                and set(aug_kwargs) <= _NativePipeline.SUPPORTED
+                and _rec_looks_jpeg(path_imgrec)):
             try:
                 self._pipeline = _NativePipeline(
                     self._it, tuple(data_shape), batch_size, label_width,

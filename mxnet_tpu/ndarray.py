@@ -579,11 +579,13 @@ def load(fname, ctx=None):
     return arrays
 
 
-def imresize(src, w, h, *args, **kwargs):
-    """Bilinear image resize (reference src/io/image_io.cc imresize analog)."""
-    out = jax.image.resize(src._data.astype(jnp.float32),
-                           (h, w) + src.shape[2:], method="bilinear")
-    return NDArray._from_jax(out.astype(src._data.dtype), src._ctx)
+def imresize(src, w, h, interp=1, **kwargs):
+    """Image resize (reference src/io/image_io.cc _cvimresize) — delegates
+    to the registered `imresize` op (jax.image.resize on device)."""
+    op = get_op("imresize")
+    out, = apply_op(op, (NDArray(src)._data,),
+                    {"w": int(w), "h": int(h), "interp": int(interp)})
+    return NDArray._from_jax(out, getattr(src, "_ctx", None))
 
 
 # ---------------------------------------------------------------------------

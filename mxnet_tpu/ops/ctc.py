@@ -1,0 +1,167 @@
+"""CTC loss — the reference's warpctc plugin rebuilt as an XLA lowering
+(reference plugin/warpctc/warpctc-inl.h: WarpCTC op over baidu warp-ctc;
+example/warpctc/toy_ctc.py is the canonical workload).
+
+TPU-first design: the forward-backward recursion is a `lax.scan` over time
+in log space — one compiled kernel, no host round trips, differentiable by
+JAX's scan autodiff.  The reference computes grad = softmax - alignment
+posteriors inside warp-ctc's C kernel; autodiff through the log-likelihood
+produces exactly that quantity, so the backward needs no hand-derived
+beta pass.
+
+Conventions match the reference plugin:
+  - blank label id = 0 (warpctc-inl.h: info.blank_label = 0)
+  - `label` entries equal to 0 are padding and are compacted out
+    (labelLengths/removeBlank, warpctc-inl.h:84-109)
+  - WarpCTC input `data` is (T*N, alphabet) time-major flattened, output
+    is softmax(data); backward writes the CTC gradient wrt activations and
+    IGNORES the incoming head gradient (loss-layer convention, like
+    SoftmaxOutput)
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _compact_labels(labels):
+    """Move non-blank (non-zero) labels to the front of each row, keeping
+    order (reference removeBlank), and return (compacted, lengths)."""
+    nonblank = labels != 0
+    # stable argsort of "is blank" keeps relative order of the kept labels
+    order = jnp.argsort(~nonblank, axis=1, stable=True)
+    compacted = jnp.take_along_axis(labels, order, axis=1)
+    lengths = nonblank.sum(axis=1)
+    return compacted, lengths
+
+
+def ctc_nll(logits, labels):
+    """Negative log likelihood of `labels` under CTC with blank=0.
+
+    logits: (T, N, A) unnormalized activations (time-major).
+    labels: (N, L) int labels; 0 entries are padding.
+    Returns (N,) per-sample losses.  Differentiable; `jax.grad` of the sum
+    wrt logits equals warp-ctc's gradient (softmax minus posteriors).
+    """
+    T, N, A = logits.shape
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    labels, lab_len = _compact_labels(labels.astype(jnp.int32))
+    L = labels.shape[1]
+    S = 2 * L + 1
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid = s_idx[None, :] < (2 * lab_len + 1)[:, None]          # (N, S)
+    # a path may skip ext[s-2] -> ext[s] only between distinct non-blank
+    # labels (odd s, different char than two slots back)
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (s_idx[None, :] % 2 == 1) & (ext != ext_m2)        # (N, S)
+
+    emit = jnp.take_along_axis(
+        logp.transpose(1, 0, 2), ext[:, None, :].repeat(T, 1), axis=2
+    ).transpose(1, 0, 2)                                          # (T, N, S)
+
+    init = jnp.full((N, S), _NEG_INF, jnp.float32)
+    init = init.at[:, 0].set(emit[0, :, 0])
+    init = init.at[:, 1].set(jnp.where(lab_len > 0, emit[0, :, 1], _NEG_INF))
+
+    def step(alpha, emit_t):
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                     constant_values=_NEG_INF)[:, :S]
+        a2 = jnp.where(can_skip,
+                       jnp.pad(alpha, ((0, 0), (2, 0)),
+                               constant_values=_NEG_INF)[:, :S],
+                       _NEG_INF)
+        m = jnp.maximum(alpha, jnp.maximum(a1, a2))
+        tot = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a1 - m)
+                          + jnp.exp(a2 - m))
+        tot = jnp.where(m <= _NEG_INF / 2, _NEG_INF, tot)
+        new = jnp.where(valid, tot + emit_t, _NEG_INF)
+        return new, None
+
+    alpha, _ = lax.scan(step, init, emit[1:])
+    # logZ = logsumexp over the last two valid extended positions
+    last = 2 * lab_len                                           # S_n - 1
+    aT = alpha
+    a_last = jnp.take_along_axis(aT, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        aT, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(lab_len > 0, a_prev, _NEG_INF)
+    m = jnp.maximum(a_last, a_prev)
+    logz = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    return -logz
+
+
+def _ctc_shape(attrs, in_shapes):
+    data = in_shapes[0]
+    return list(in_shapes), [tuple(data) if data else None], []
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _warpctc_core(data, label, input_length, label_length):
+    return jax.nn.softmax(data.astype(jnp.float32), axis=-1)
+
+
+def _warpctc_fwd(data, label, input_length, label_length):
+    return (jax.nn.softmax(data.astype(jnp.float32), axis=-1),
+            (data, label))
+
+
+def _warpctc_bwd(input_length, label_length, res, g):
+    data, label = res
+    T = input_length
+    N = data.shape[0] // T
+    A = data.shape[1]
+    logits = data.reshape(T, N, A)
+    labels = label.reshape(N, label_length).astype(jnp.int32)
+    grad3 = jax.grad(lambda lg: ctc_nll(lg, labels).sum())(logits)
+    # warp-ctc writes d(sum cost)/d(activations) directly, ignoring the
+    # incoming head gradient (warpctc-inl.h Backward)
+    return grad3.reshape(T * N, A).astype(data.dtype), \
+        jnp.zeros_like(label)
+
+
+_warpctc_core.defvjp(_warpctc_fwd, _warpctc_bwd)
+
+
+@register("WarpCTC", input_names=("data", "label"), infer_shape=_ctc_shape)
+def warpctc(data, label, label_length=0, input_length=0):
+    """CTC loss layer (reference plugin/warpctc).  data: (T*N, alphabet)
+    time-major activations; label: (N, label_length) with 0 = blank/pad.
+    Output: softmax(data); backward = CTC gradient."""
+    label_length = int(label_length)
+    input_length = int(input_length)
+    if input_length <= 0 or label_length <= 0:
+        raise MXNetError("WarpCTC requires input_length and label_length")
+    if data.ndim != 2:
+        raise MXNetError("WarpCTC data must be 2-D (T*N, alphabet)")
+    return _warpctc_core(data, label.reshape(-1, label_length),
+                         input_length, label_length)
+
+
+def _ctc_loss_shape(attrs, in_shapes):
+    data = in_shapes[0]
+    out = (data[1],) if data else None
+    return list(in_shapes), [out], []
+
+
+@register("ctc_loss", input_names=("data", "label"),
+          aliases=("_contrib_ctc_loss", "CTCLoss"),
+          infer_shape=_ctc_loss_shape)
+def ctc_loss_op(data, label):
+    """Per-sample CTC negative log likelihood.  data: (T, N, A) time-major
+    activations, label: (N, L) with 0 = padding.  Returns (N,) losses.
+    Fully differentiable (grad flows to data)."""
+    if data.ndim != 3:
+        raise MXNetError("ctc_loss data must be 3-D (T, N, alphabet)")
+    return ctc_nll(data, label.astype(jnp.int32))

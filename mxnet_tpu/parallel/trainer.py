@@ -127,6 +127,11 @@ class SPMDTrainer(object):
         self.data_names = [d.name for d in data_shapes]
         self.label_names = [l.name for l in label_shapes]
         self.input_names = self.data_names + self.label_names
+        unknown_tf = set(self.input_transforms) - set(self.input_names)
+        if unknown_tf:
+            raise MXNetError(
+                "input_transforms keys %s are not bound inputs %s"
+                % (sorted(unknown_tf), self.input_names))
         shapes = {d.name: d.shape for d in data_shapes + label_shapes}
         arg_shapes, out_shapes, aux_shapes = self.symbol.infer_shape(**shapes)
         self.arg_shapes = dict(zip(self.arg_names, arg_shapes))

@@ -1,0 +1,164 @@
+"""CTC loss tests (reference plugin/warpctc) — brute-force path-enumeration
+oracle + finite-difference gradient oracle (SURVEY §4 test strategy)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.ctc import ctc_nll
+
+
+def brute_force_nll(logits, label):
+    """- log sum over all alignments collapsing to `label` (blank=0)."""
+    T, A = logits.shape
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != 0:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    target = tuple(x for x in label if x != 0)
+    total = 0.0
+    for path in itertools.product(range(A), repeat=T):
+        if collapse(path) == target:
+            total += np.prod([p[t, path[t]] for t in range(T)])
+    return -np.log(total)
+
+
+@pytest.mark.parametrize("label", [[1, 2], [1, 1], [2, 0], [0, 0]])
+def test_ctc_nll_matches_bruteforce(label):
+    rs = np.random.RandomState(0)
+    T, A = 4, 3
+    logits = rs.randn(T, 1, A).astype(np.float32)
+    got = np.asarray(ctc_nll(logits, np.array([label], np.int32)))[0]
+    want = brute_force_nll(logits[:, 0], label)
+    if not np.isfinite(want):  # empty label with no all-blank path mass=0?
+        assert got > 1e5 or np.isfinite(got)
+        return
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_ctc_nll_batch_and_varlen():
+    rs = np.random.RandomState(1)
+    T, N, A = 6, 3, 4
+    logits = rs.randn(T, N, A).astype(np.float32)
+    labels = np.array([[1, 2, 3], [2, 2, 0], [3, 0, 0]], np.int32)
+    got = np.asarray(ctc_nll(logits, labels))
+    for n in range(N):
+        want = brute_force_nll(logits[:, n], labels[n].tolist())
+        np.testing.assert_allclose(got[n], want, rtol=1e-4)
+    # interspersed padding compacts like the reference's removeBlank
+    labels2 = np.array([[1, 0, 2], [0, 2, 2], [0, 3, 0]], np.int32)
+    got2 = np.asarray(ctc_nll(logits, labels2))
+    want2 = np.asarray(ctc_nll(logits, np.array(
+        [[1, 2, 0], [2, 2, 0], [3, 0, 0]], np.int32)))
+    np.testing.assert_allclose(got2, want2, rtol=1e-6)
+
+
+def test_ctc_grad_finite_difference():
+    import jax
+    rs = np.random.RandomState(2)
+    T, N, A = 5, 2, 4
+    logits = rs.randn(T, N, A).astype(np.float64).astype(np.float32)
+    labels = np.array([[1, 3], [2, 0]], np.int32)
+
+    grad = jax.grad(lambda lg: ctc_nll(lg, labels).sum())(logits)
+    grad = np.asarray(grad)
+    eps = 1e-3
+    rs2 = np.random.RandomState(3)
+    for _ in range(12):
+        t, n, a = rs2.randint(T), rs2.randint(N), rs2.randint(A)
+        lp = logits.copy()
+        lp[t, n, a] += eps
+        lm = logits.copy()
+        lm[t, n, a] -= eps
+        fd = (np.asarray(ctc_nll(lp, labels)).sum()
+              - np.asarray(ctc_nll(lm, labels)).sum()) / (2 * eps)
+        np.testing.assert_allclose(grad[t, n, a], fd, rtol=2e-2, atol=2e-3)
+
+
+def test_warpctc_op_forward_backward():
+    """WarpCTC symbol: forward = softmax(data); backward = CTC grad wrt
+    activations regardless of head gradient (reference warpctc-inl.h)."""
+    import jax
+    rs = np.random.RandomState(4)
+    T, N, A, L = 5, 2, 4, 2
+    data = rs.randn(T * N, A).astype(np.float32)
+    labels = np.array([[1, 3], [2, 0]], np.float32)
+
+    d = mx.sym.Variable("data")
+    l = mx.sym.Variable("label")
+    net = mx.sym.WarpCTC(data=d, label=l, label_length=L, input_length=T)
+    ex = net.simple_bind(mx.cpu(), data=(T * N, A), label=(N, L),
+                         grad_req="write")
+    ex.arg_dict["data"][:] = data
+    ex.arg_dict["label"][:] = labels
+    out = ex.forward(is_train=True)[0].asnumpy()
+    e = np.exp(data - data.max(-1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+
+    ex.backward()
+    got = ex.grad_dict["data"].asnumpy()
+    want = np.asarray(jax.grad(
+        lambda lg: ctc_nll(lg, labels.astype(np.int32)).sum())(
+        data.reshape(T, N, A))).reshape(T * N, A)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_ctc_loss_op_and_training():
+    """nd-level ctc_loss + a tiny linear model trained through WarpCTC
+    learns the toy alignment (mini version of example/warpctc/toy_ctc.py)."""
+    rs = np.random.RandomState(5)
+    T, N, A, L = 8, 8, 5, 2
+
+    def make_batch():
+        # feature at step t is a one-hot of the true char active there
+        labels = rs.randint(1, A, (N, L)).astype(np.float32)
+        x = np.zeros((T, N, A), np.float32)
+        for n in range(N):
+            for t in range(T):
+                x[t, n, int(labels[n, t * L // T])] = 1.0
+        return x + 0.1 * rs.randn(T, N, A).astype(np.float32), labels
+
+    x, labels = make_batch()
+    loss = mx.nd.ctc_loss(mx.nd.array(x.reshape(T, N, A)),
+                          mx.nd.array(labels))
+    assert loss.shape == (N,) and np.isfinite(loss.asnumpy()).all()
+
+    # train W through the WarpCTC head
+    d = mx.sym.Variable("data")
+    lsym = mx.sym.Variable("label")
+    w = mx.sym.Variable("w")
+    proj = mx.sym.dot(d, w)
+    net = mx.sym.WarpCTC(data=proj, label=lsym, label_length=L,
+                         input_length=T)
+    ex = net.simple_bind(mx.cpu(), data=(T * N, A), label=(N, L),
+                         w=(A, A),
+                         grad_req={"data": "null", "label": "null",
+                                   "w": "write"})
+    ex.arg_dict["w"][:] = 0.1 * rs.randn(A, A).astype(np.float32)
+
+    def nll_now(x, labels):
+        z = x.reshape(T * N, A) @ ex.arg_dict["w"].asnumpy()
+        return float(np.asarray(ctc_nll(z.reshape(T, N, A),
+                                        labels.astype(np.int32))).mean())
+
+    first = nll_now(x, labels)
+    for i in range(60):
+        x, labels = make_batch()
+        ex.arg_dict["data"][:] = x.reshape(T * N, A)
+        ex.arg_dict["label"][:] = labels
+        ex.forward(is_train=True)
+        ex.backward()
+        ex.arg_dict["w"][:] = ex.arg_dict["w"].asnumpy() \
+            - 0.5 / N * ex.grad_dict["w"].asnumpy()
+    x, labels = make_batch()
+    final = nll_now(x, labels)
+    assert final < first * 0.5, (first, final)

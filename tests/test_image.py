@@ -426,6 +426,27 @@ def test_native_pipeline_partial_tail_batch(rec_dataset):
     assert tail[4:].max() == 0
 
 
+def test_native_pipeline_fallback_png_dataset(tmp_path):
+    """A .rec of PNG payloads must not silently vanish in the native JPEG
+    pipeline — the magic sniff routes it to the cv2 path."""
+    import cv2
+    path = str(tmp_path / "png.rec")
+    idx = str(tmp_path / "png.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(6):
+        ok, buf = cv2.imencode(".png", _gradient_img(seed=i))
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.tobytes()))
+    w.close()
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=3, seed=3)
+    assert not isinstance(it._pipeline, image._NativePipeline)
+    n = sum(b.data[0].shape[0] - b.pad for b in it)
+    assert n == 6
+    it.close()
+
+
 def test_native_pipeline_fallback_unsupported_augs(rec_dataset):
     """brightness jitter isn't native — the process pipeline takes over."""
     path, idx = rec_dataset

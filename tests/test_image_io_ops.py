@@ -1,0 +1,77 @@
+"""Image I/O NDArray ops (reference src/io/image_io.cc: _cvimdecode /
+_cvimresize / _cvcopyMakeBorder, exposed as mx.nd.imdecode etc.)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture(scope="module")
+def jpg_buf():
+    import cv2
+    img = np.zeros((40, 50, 3), np.uint8)
+    img[:, :, 2] = 200
+    img[10:20] = 30
+    ok, j = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    assert ok
+    return j.tobytes(), img
+
+
+def test_imdecode(jpg_buf):
+    import cv2
+    raw, _ = jpg_buf
+    buf = mx.nd.array(np.frombuffer(raw, np.uint8), dtype="uint8")
+    out = mx.nd.imdecode(buf)
+    ref = cv2.imdecode(np.frombuffer(raw, np.uint8), 1)
+    assert out.shape == ref.shape and out.asnumpy().dtype == np.uint8
+    # to_rgb=1 default (RGB); to_rgb=0 matches cv2's BGR exactly
+    np.testing.assert_array_equal(out.asnumpy(), ref[..., ::-1])
+    bgr = mx.nd._cvimdecode(buf, to_rgb=0)
+    np.testing.assert_array_equal(bgr.asnumpy(), ref)
+    # grayscale flag
+    g = mx.nd.imdecode(buf, flag=0)
+    assert g.shape == (40, 50, 1)
+
+
+def test_imdecode_bad_buffer():
+    buf = mx.nd.array(np.frombuffer(b"notanimage" * 3, np.uint8),
+                      dtype="uint8")
+    with pytest.raises(mx.MXNetError):
+        mx.nd.imdecode(buf)
+
+
+def test_imdecode_symbolic_rejected(jpg_buf):
+    """imdecode's output shape depends on buffer content — imperative only
+    (the reference also runs it eagerly on the engine CPU queue)."""
+    raw, _ = jpg_buf
+    v = mx.sym.Variable("buf")
+    with pytest.raises((mx.MXNetError, Exception)):
+        s = mx.sym.imdecode(v)
+        s.simple_bind(mx.cpu(), buf=(len(raw),))
+
+
+def test_imresize(jpg_buf):
+    raw, img = jpg_buf
+    buf = mx.nd.array(np.frombuffer(raw, np.uint8), dtype="uint8")
+    out = mx.nd.imdecode(buf)
+    r = mx.nd.imresize(out, w=25, h=20)
+    assert r.shape == (20, 25, 3)
+    assert r.asnumpy().dtype == np.uint8
+    # nearest on an upscale introduces no new values
+    up = mx.nd.imresize(out, w=100, h=80, interp=0)
+    assert up.shape == (80, 100, 3)
+    src = out.asnumpy()
+    assert np.isin(np.unique(up.asnumpy()), np.unique(src)).all()
+
+
+def test_copy_make_border(jpg_buf):
+    raw, _ = jpg_buf
+    buf = mx.nd.array(np.frombuffer(raw, np.uint8), dtype="uint8")
+    out = mx.nd.imdecode(buf)
+    p = mx.nd.copyMakeBorder(out, top=2, bot=3, left=4, right=5, value=7)
+    assert p.shape == (45, 59, 3)
+    pn = p.asnumpy()
+    assert (pn[:2] == 7).all() and (pn[:, :4] == 7).all()
+    np.testing.assert_array_equal(pn[2:42, 4:54], out.asnumpy())
+    with pytest.raises(mx.MXNetError):
+        mx.nd.copyMakeBorder(out, top=1, type=1)
