@@ -1,0 +1,95 @@
+"""Distributed fused-step timing worker (run under tools/launch.py).
+
+Each worker trains the same conv net through Module.fit's fused SPMD path
+(kvstore='tpu' — grads psum across the process mesh each step) on its
+rank's shard; rank 0 prints one JSON line with the measured steady-state
+step time.  The weak-scaling orchestrator (tools/scaling_evidence.py) runs
+this at n=1,2,4,8 workers and records the curve.
+
+Launch:  python tools/launch.py -n 4 --platform cpu \
+             python tools/dist_step_bench.py --steps 30
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+from mxnet_tpu import distributed
+
+distributed.initialize()
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_net(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-worker-batch", type=int, default=64)
+    ap.add_argument("--image-side", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=8)
+    args = ap.parse_args()
+
+    kv = mx.kv.create("tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    rs = np.random.RandomState(rank)
+    bs = args.per_worker_batch
+    shape = (3, args.image_side, args.image_side)
+    X = rs.rand(bs * 4, *shape).astype("f")
+    y = rs.randint(0, 10, bs * 4).astype("f")
+    it = mx.io.NDArrayIter(X, y, batch_size=bs)
+
+    mod = mx.mod.Module(build_net())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+    assert mod._fused is not None, "fused SPMD path did not engage"
+
+    batches = list(it)
+
+    def run(n):
+        for i in range(n):
+            b = batches[i % len(batches)]
+            mod.forward_backward(b)
+            mod.update()
+        mod.get_params()  # sync point
+
+    run(args.warmup)
+    distributed.barrier("bench_start")
+    tic = time.time()
+    run(args.steps)
+    dt = time.time() - tic
+    distributed.barrier("bench_end")
+    if rank == 0:
+        print(json.dumps({
+            "workers": nworker,
+            "per_worker_batch": bs,
+            "step_ms": round(dt / args.steps * 1e3, 3),
+            "images_per_sec_total": round(bs * nworker * args.steps / dt, 1),
+        }))
+    print("dist_step_bench rank %d/%d: OK" % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

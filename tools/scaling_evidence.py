@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Produce the scaling-evidence artifact (SCALING_r{N}.json).
+
+Records, on a single host (see docs/scaling_model.md for how these carry
+the 8→64-chip claim):
+  - virtual-mesh weak scaling (tools/scaling_bench.py, 1..8 virtual devs)
+  - multi-process launcher weak scaling (tools/launch.py +
+    tools/dist_step_bench.py, 1..8 workers)
+  - collective-bandwidth sweep (tools/bandwidth/measure.py, single- and
+    multi-process)
+  - the analytic ICI communication model with measured inputs
+
+Usage: python tools/scaling_evidence.py [-o SCALING_r03.json]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PY = sys.executable
+
+
+def _run(cmd, timeout=900, env_extra=None):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra or {})
+    res = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout, env=env, cwd=REPO)
+    return res
+
+
+def _json_lines(text):
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        # launcher prefixes worker output with "[worker N] "
+        if "] " in line and line.startswith("[worker"):
+            line = line.split("] ", 1)[1]
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def virtual_mesh_weak_scaling(network="lenet", per_batch=64,
+                              image_shape="1,28,28", classes=10):
+    res = _run([PY, os.path.join("tools", "scaling_bench.py"),
+                "--network", network, "--num-classes", str(classes),
+                "--image-shape", image_shape,
+                "--per-device-batch", str(per_batch),
+                "--steps", "20", "--warmup", "5",
+                "--virtual-devices", "8"])
+    rows = _json_lines(res.stdout)
+    # on one physical core, total throughput at N vs N=1 = sharding overhead
+    if rows:
+        base = rows[0]["images_per_sec"]
+        for r in rows:
+            r["total_vs_1dev"] = round(r["images_per_sec"] / base, 3)
+    return {"note": "8 virtual CPU devices on ONE physical core: "
+                    "total_vs_1dev ~= 1.0 means GSPMD partitioning adds no "
+                    "host-side overhead (per-device falls 1/N by "
+                    "construction; ICI efficiency is carried by the "
+                    "analytic model, docs/scaling_model.md)",
+            "network": network, "rows": rows,
+            "stderr_tail": res.stderr[-400:] if res.returncode else ""}
+
+
+def multiproc_weak_scaling(counts=(1, 2, 4, 8)):
+    rows = []
+    for n in counts:
+        res = _run([PY, os.path.join("tools", "launch.py"), "-n", str(n),
+                    "--platform", "cpu", PY,
+                    os.path.join("tools", "dist_step_bench.py"),
+                    "--steps", "20", "--warmup", "5"])
+        got = _json_lines(res.stdout)
+        if got:
+            rows.append(got[0])
+        else:
+            rows.append({"workers": n, "error": res.stdout[-300:]})
+    base = None
+    for r in rows:
+        if "step_ms" in r:
+            if base is None:
+                base = r["step_ms"]
+            r["step_time_vs_1proc"] = round(r["step_ms"] / base, 3)
+    return {"note": "real multi-process runtime (launcher + gloo "
+                    "collectives — the code path that rides ICI/DCN on "
+                    "pods) on ONE core: step time grows ~N by construction; "
+                    "records the 8-process cluster executing the fused "
+                    "dist step correctly",
+            "rows": rows}
+
+
+def collective_bandwidth():
+    single = _run([PY, os.path.join("tools", "bandwidth", "measure.py"),
+                   "--sizes", "64KB,1MB,16MB,64MB", "--iters", "10",
+                   "--virtual-devices", "8"])
+    dist = _run([PY, os.path.join("tools", "launch.py"), "-n", "4",
+                 "--platform", "cpu", PY,
+                 os.path.join("tools", "bandwidth", "measure.py"),
+                 "--dist", "--sizes", "64KB,1MB,16MB", "--iters", "10"])
+    return {"gspmd_virtual_mesh": _json_lines(single.stdout),
+            "cross_process_gloo": _json_lines(dist.stdout)}
+
+
+def analytic_model(measured_step_ms=2.4):
+    params_m = 25.56e6
+    v_bf16 = params_m * 2
+    ici_axis_bw = 2 * 45e9  # one torus axis, bidirectional
+    out = {"inputs": {
+        "resnet50_params": params_m,
+        "grad_bytes_bf16": v_bf16,
+        "measured_step_ms_b32_bf16": measured_step_ms,
+        "v5e_ici_link_oneway_GBps": 45,
+        "credited_allreduce_bw_GBps": ici_axis_bw / 1e9,
+        "backward_overlap_window_ms": round(measured_step_ms * 2 / 3, 2),
+    }}
+    for n in (8, 64):
+        t_comm = 2 * (n - 1) / n * v_bf16 / ici_axis_bw * 1e3
+        overlap = measured_step_ms * 2 / 3
+        exposed = max(0.0, t_comm - overlap)
+        out["n%d" % n] = {
+            "t_comm_ms_bf16": round(t_comm, 3),
+            "t_exposed_ms_with_overlap": round(exposed, 3),
+            "weak_scaling_efficiency_overlapped": round(
+                measured_step_ms / (measured_step_ms + exposed), 3),
+            "weak_scaling_efficiency_no_overlap": round(
+                measured_step_ms / (measured_step_ms + t_comm), 3),
+        }
+    out["conclusion"] = (
+        "bf16 gradient allreduce fits inside the backward-pass overlap "
+        "window at both N=8 and N=64 -> projected efficiency >=95%; see "
+        "docs/scaling_model.md for the worst-case (no-overlap, f32) "
+        "analysis and remedies")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="SCALING_r03.json")
+    ap.add_argument("--skip-virtual", action="store_true")
+    args = ap.parse_args()
+    art = {"doc": "see docs/scaling_model.md",
+           "analytic_model": analytic_model()}
+    if not args.skip_virtual:
+        art["virtual_mesh_weak_scaling"] = virtual_mesh_weak_scaling()
+    art["multiproc_weak_scaling"] = multiproc_weak_scaling()
+    art["collective_bandwidth"] = collective_bandwidth()
+    with open(os.path.join(REPO, args.output), "w") as f:
+        json.dump(art, f, indent=1)
+    print("wrote", args.output)
+
+
+if __name__ == "__main__":
+    main()
