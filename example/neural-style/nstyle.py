@@ -1,0 +1,172 @@
+"""Neural style transfer (reference example/neural-style/nstyle.py rebuilt
+TPU-first): optimize IN INPUT SPACE — the trained thing is the image, not
+the network.  Exercises executor gradients wrt data (grad_req on the input
+variable), gram-matrix style losses, and a two-term loss group.
+
+The reference extracts relu features from downloaded VGG-19 weights
+(model_vgg19.py); this example builds the same conv topology at reduced
+width and accepts any `.params` checkpoint via --params.  With random
+(fixed) features the optimization mechanics are identical — random conv
+features famously still transfer texture (Ulyanov et al.) — and the
+example needs no downloads.
+
+TPU notes: the whole feature stack + gram losses compile into one fused
+XLA program; the image update loop is Adam on the input buffer.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_feature_sym(widths=(16, 32, 64), content_layer=1):
+    """VGG-ish stack; returns (style_group, content_sym).  Style taps one
+    relu per block (reference style_gram_symbol), content taps block
+    `content_layer`."""
+    data = mx.sym.Variable("data")
+    net = data
+    style_taps = []
+    content = None
+    for i, w in enumerate(widths):
+        net = mx.sym.Convolution(net, num_filter=w, kernel=(3, 3),
+                                 pad=(1, 1), name="conv%d_1" % (i + 1))
+        net = mx.sym.Activation(net, act_type="relu",
+                                name="relu%d_1" % (i + 1))
+        style_taps.append(net)
+        if i == content_layer:
+            content = net
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="avg", name="pool%d" % (i + 1))
+    return style_taps, content
+
+
+def style_gram_symbol(style_taps, size):
+    """Gram matrices of style activations (reference
+    nstyle.py:style_gram_symbol)."""
+    gram_list = []
+    scales = []
+    h, w = size
+    for i, tap in enumerate(style_taps):
+        sh, sw = h >> i, w >> i
+        x = mx.sym.Reshape(tap, shape=(-1, sh * sw))    # (C, H*W)
+        gram = mx.sym.dot(x, x, transpose_b=True)       # (C, C)
+        gram_list.append(gram)
+        scales.append(sh * sw)
+    return gram_list, scales
+
+
+def get_loss_sym(style_taps, content, size, style_weight, content_weight):
+    """Total loss = sum_i w_i ||G_i - target_G_i||^2 + c ||F - target_F||^2
+    (reference get_loss builds the same two groups)."""
+    gram_list, scales = style_gram_symbol(style_taps, size)
+    losses = []
+    for i, (gram, sc) in enumerate(zip(gram_list, scales)):
+        tvar = mx.sym.Variable("target_gram_%d" % i)
+        losses.append(mx.sym.sum(mx.sym.square(tvar - gram))
+                      * (style_weight / (sc ** 2)))
+    cvar = mx.sym.Variable("target_content")
+    losses.append(mx.sym.sum(mx.sym.square(cvar - content))
+                  * content_weight)
+    total = losses[0]
+    for l in losses[1:]:
+        total = total + l
+    return mx.sym.MakeLoss(total)
+
+
+def make_test_images(size=(32, 32), seed=0):
+    """Synthetic content (centered blob) + style (diagonal stripes)."""
+    h, w = size
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    content = np.exp(-((xx - w / 2) ** 2 + (yy - h / 2) ** 2) / (w * 2))
+    stripes = np.sin((xx + yy) * 0.8) * 0.5 + 0.5
+    rs = np.random.RandomState(seed)
+    c = np.stack([content * ch for ch in (1.0, 0.6, 0.3)])
+    s = np.stack([stripes * ch for ch in (0.4, 0.8, 1.0)])
+    return (c[None] * 2 - 1).astype("f"), (s[None] * 2 - 1).astype("f")
+
+
+def train_nstyle(content_np, style_np, num_steps=60, lr=0.1,
+                 style_weight=1.0, content_weight=10.0, params=None,
+                 seed=0, log=logging.info):
+    size = content_np.shape[2:]
+    ctx = mx.current_context()
+    style_taps, content = build_feature_sym()
+    n_style = len(style_taps)
+
+    # 1) extract targets: run the feature net on content/style images
+    feat = mx.sym.Group(style_taps + [content])
+    fex = feat.simple_bind(ctx, data=content_np.shape, grad_req="null")
+    mx.random.seed(seed)
+    init = mx.initializer.Xavier()
+    for name, arr in fex.arg_dict.items():
+        if name != "data":
+            if params and name in params:
+                arr[:] = params[name]
+            else:
+                init(name, arr)
+    fex.arg_dict["data"][:] = style_np
+    outs = fex.forward()
+    target_grams = []
+    for i in range(n_style):
+        a = outs[i].asnumpy().reshape(outs[i].shape[1], -1)
+        target_grams.append(a @ a.T)
+    fex.arg_dict["data"][:] = content_np
+    outs = fex.forward()
+    target_content = outs[n_style].asnumpy()
+
+    # 2) loss executor: grad flows to the IMAGE (grad_req only on data)
+    loss = get_loss_sym(style_taps, content, size, style_weight,
+                        content_weight)
+    shapes = {"data": content_np.shape}
+    for i, g in enumerate(target_grams):
+        shapes["target_gram_%d" % i] = g.shape
+    shapes["target_content"] = target_content.shape
+    grad_req = {k: "null" for k in loss.list_arguments()}
+    grad_req["data"] = "write"
+    lex = loss.simple_bind(ctx, grad_req=grad_req, **shapes)
+    for name, arr in fex.arg_dict.items():
+        if name != "data":
+            lex.arg_dict[name][:] = arr
+    for i, g in enumerate(target_grams):
+        lex.arg_dict["target_gram_%d" % i][:] = g
+    lex.arg_dict["target_content"][:] = target_content
+
+    # 3) Adam on the image, starting from noise (the reference also
+    # initializes the optimized image with random noise)
+    rs = np.random.RandomState(seed)
+    img = mx.nd.array(rs.uniform(-0.1, 0.1,
+                                 content_np.shape).astype("f"))
+    opt = mx.optimizer.create("adam", learning_rate=lr)
+    state = opt.create_state(0, img)
+    losses = []
+    for step in range(num_steps):
+        lex.arg_dict["data"][:] = img
+        out = lex.forward(is_train=True)[0]
+        lex.backward()
+        losses.append(float(out.asnumpy()))
+        opt.update(0, img, lex.grad_dict["data"], state)
+        img[:] = mx.nd.clip(img, -1.0, 1.0)
+        if step % 20 == 0:
+            log("step %d loss %.4f" % (step, losses[-1]))
+    return img.asnumpy(), losses
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="neural style (toy)")
+    ap.add_argument("--num-steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--params", default=None,
+                    help=".params checkpoint with conv weights to use as "
+                         "the feature extractor (e.g. converted VGG-19)")
+    args = ap.parse_args()
+    params = None
+    if args.params:
+        params = {k.split(":", 1)[-1]: v
+                  for k, v in mx.nd.load(args.params).items()}
+    c, s = make_test_images()
+    img, losses = train_nstyle(c, s, num_steps=args.num_steps, lr=args.lr,
+                               params=params, log=print)
+    print("loss %.4f -> %.4f" % (losses[0], losses[-1]))

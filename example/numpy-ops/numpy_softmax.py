@@ -1,0 +1,93 @@
+"""Custom numpy softmax op in an MLP (reference
+example/numpy-ops/numpy_softmax.py) — docs-by-example for the legacy
+NumpyOp protocol (mx.operator.NumpyOp: list_arguments/list_outputs/
+infer_shape/forward/backward with numpy arrays).
+
+TPU note: NumpyOp runs its callbacks on the host (the reference runs them
+on the engine's CPU queue); graphs containing one execute eagerly around
+it.  For production ops write a registry lowering (mxnet_tpu/ops/) or a
+Pallas kernel (mx.rtc) instead — this example exists to keep the
+reference's extension protocol working unmodified.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super(NumpySoftmax, self).__init__(False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1].reshape((in_data[1].size,)).astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+def build_mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(data=act2, name="fc3", num_hidden=10)
+    mysoftmax = NumpySoftmax()
+    return mysoftmax(data=fc3, name="softmax")
+
+
+def make_blobs(n=2048, d=32, c=10, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 2.5
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+
+def train(num_epoch=6, batch_size=64, lr=0.1, log=print):
+    X, y = make_blobs()
+    split = len(X) * 3 // 4
+    train_it = mx.io.NDArrayIter(X[:split], y[:split],
+                                 batch_size=batch_size, shuffle=True)
+    val_it = mx.io.NDArrayIter(X[split:], y[split:], batch_size=batch_size)
+    mod = mx.mod.Module(build_mlp())
+    mx.random.seed(0)
+    mod.fit(train_it, eval_data=val_it, num_epoch=num_epoch,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(batch_size, 20))
+    acc = dict(mod.score(val_it, "acc"))["accuracy"]
+    log("final val accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epoch", type=int, default=6)
+    ap.add_argument("--lr", type=float, default=0.1)
+    args = ap.parse_args()
+    train(num_epoch=args.num_epoch, lr=args.lr)

@@ -9,3 +9,4 @@ from . import symbol
 from . import symbol as sym
 from . import ndarray
 from . import ndarray as nd
+from . import tensorboard

@@ -1,0 +1,67 @@
+"""TensorBoard bridge tests (reference python/mxnet/contrib/tensorboard.py):
+event files written by the self-contained writer parse with TensorBoard's
+own protos, and LogMetricsCallback logs metrics from Module.fit."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.tensorboard import LogMetricsCallback, SummaryWriter
+
+tb_proto = pytest.importorskip(
+    "tensorboard.compat.proto.event_pb2",
+    reason="tensorboard protos unavailable to verify against")
+
+
+def _read_events(path):
+    raw = open(path, "rb").read()
+    off = 0
+    events = []
+    while off < len(raw):
+        (ln,) = struct.unpack("<Q", raw[off:off + 8])
+        off += 12  # length + masked len-crc
+        rec = raw[off:off + ln]
+        off += ln + 4  # payload + masked data-crc
+        events.append(tb_proto.Event.FromString(rec))
+    return events
+
+
+def _event_file(d):
+    files = [os.path.join(d, x) for x in os.listdir(d)]
+    assert len(files) == 1
+    return files[0]
+
+
+def test_summary_writer_roundtrip(tmp_path):
+    d = str(tmp_path / "logs")
+    w = SummaryWriter(d)
+    w.add_scalar("loss", 0.25, 3)
+    w.add_scalar("acc", 0.75)   # auto-incremented step
+    w.close()
+    events = _read_events(_event_file(d))
+    assert events[0].file_version == "brain.Event:2"
+    scalars = [(v.tag, v.simple_value, e.step)
+               for e in events for v in e.summary.value]
+    assert ("loss", 0.25, 3) in scalars
+    assert ("acc", 0.75, 4) in scalars
+
+
+def test_log_metrics_callback_with_fit(tmp_path):
+    X = np.random.RandomState(0).randn(256, 10).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    d = str(tmp_path / "fitlogs")
+    cb = LogMetricsCallback(d, prefix="train")
+    mod.fit(it, num_epoch=2, optimizer="sgd", batch_end_callback=cb)
+    events = _read_events(_event_file(d))
+    tags = {v.tag for e in events for v in e.summary.value}
+    assert "train-accuracy" in tags
+    vals = [v.simple_value for e in events for v in e.summary.value
+            if v.tag == "train-accuracy"]
+    assert len(vals) >= 2 and all(0.0 <= v <= 1.0 for v in vals)
