@@ -67,6 +67,8 @@ from . import parallel
 from . import autograd
 from . import contrib
 from . import rtc
+from . import torch_bridge
+from .torch_bridge import th
 # both addressing styles work: mx.contrib.symbol.X (the reference's v0.9.5
 # layout) and mx.sym.contrib.X / mx.nd.contrib.X (later-API convenience)
 symbol.contrib = contrib.symbol

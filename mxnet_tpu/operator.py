@@ -180,8 +180,25 @@ def _custom_output_names(attrs):
 def _custom_infer_shape(attrs, in_shapes):
     prop = _prop_for(attrs)
     n_out = len(prop.list_outputs())
-    if any(s is None for s in in_shapes):
+    if all(s is None for s in in_shapes):
         return list(in_shapes), [None] * n_out, []
+    if any(s is None for s in in_shapes):
+        # partial knowledge: the prop may be able to fill the rest (e.g.
+        # weight shapes derived from data, the reference's standard
+        # simple_bind flow); props that need every input just bail
+        try:
+            ret = prop.infer_shape([list(s) if s is not None else None
+                                    for s in in_shapes])
+        except (TypeError, IndexError, AttributeError):
+            return list(in_shapes), [None] * n_out, []
+        if len(ret) == 2:
+            in_sh, out_sh = ret
+            aux_sh = []
+        else:
+            in_sh, out_sh, aux_sh = ret
+        return ([tuple(s) if s is not None else None for s in in_sh],
+                [tuple(s) if s is not None else None for s in out_sh],
+                [tuple(s) for s in aux_sh])
     ret = prop.infer_shape([list(s) for s in in_shapes])
     if len(ret) == 2:
         in_sh, out_sh = ret

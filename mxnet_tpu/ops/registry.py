@@ -58,14 +58,14 @@ class OpDef(object):
         "name", "fn", "input_names", "aux_names", "num_outputs",
         "infer_shape", "needs_is_train", "needs_rng", "variable_inputs",
         "aliases", "output_names", "hidden", "param_indices", "doc",
-        "no_jit", "extra_attrs", "_accepted",
+        "no_jit", "extra_attrs", "dynamic_attrs", "_accepted",
     )
 
     def __init__(self, name, fn, input_names=("data",), aux_names=(),
                  num_outputs=1, infer_shape=None, needs_is_train=False,
                  needs_rng=False, variable_inputs=False, aliases=(),
                  output_names=None, hidden=False, no_jit=False,
-                 extra_attrs=()):
+                 extra_attrs=(), dynamic_attrs=()):
         self.name = name
         self.fn = fn
         self.input_names = input_names          # tuple | callable(attrs)->tuple
@@ -80,6 +80,13 @@ class OpDef(object):
         self.hidden = hidden
         self.no_jit = no_jit    # host-callback ops: run eagerly, never jit
         self.extra_attrs = tuple(extra_attrs)  # attrs consumed outside fn
+        # scalar attrs passed as TRACED args, not compile-time constants:
+        # the imperative jit cache stays one entry per op+shape even when
+        # the value changes every call (optimizer lr schedules/bias
+        # correction — the reference likewise passes lr at call time,
+        # src/operator/optimizer_op-inl.h SGDParam fields are runtime
+        # kwargs, not compile specializations)
+        self.dynamic_attrs = tuple(dynamic_attrs)
         self._accepted = None   # lazy cache for accepted_attrs()
         self.doc = fn.__doc__
 
@@ -194,9 +201,11 @@ def list_ops():
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=8192)
-def _jitted(op_name, attr_items, is_train, with_rng):
-    """One compiled callable per (op, attrs, is_train) — the TPU analog of the
-    reference's cached engine ops (graph_executor.cc:556)."""
+def _jitted(op_name, attr_items, dyn_names, is_train, with_rng):
+    """One compiled callable per (op, static attrs, is_train) — the TPU
+    analog of the reference's cached engine ops (graph_executor.cc:556).
+    ``dyn_names`` attrs arrive as traced scalars (first positional arg, a
+    tuple) so their values don't key the cache."""
     import jax
     op = get_op(op_name)
     attrs = dict(attr_items)
@@ -205,11 +214,13 @@ def _jitted(op_name, attr_items, is_train, with_rng):
         kw["is_train"] = is_train
 
     if with_rng:
-        def call(rng, *arrays):
-            return op.fn(*arrays, rng=rng, **attrs, **kw)
+        def call(rng, dyn_vals, *arrays):
+            return op.fn(*arrays, rng=rng, **attrs,
+                         **dict(zip(dyn_names, dyn_vals)), **kw)
     else:
-        def call(*arrays):
-            return op.fn(*arrays, **attrs, **kw)
+        def call(dyn_vals, *arrays):
+            return op.fn(*arrays, **attrs,
+                         **dict(zip(dyn_names, dyn_vals)), **kw)
     return jax.jit(call)
 
 
@@ -268,15 +279,18 @@ def apply_op(op, arrays, attrs, is_train=False, rng=None):
         if isinstance(out, (tuple, list)):
             return tuple(out)
         return (out,)
-    items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
-    fn = _jitted(op.name, items, is_train, with_rng)
+    dyn_names = tuple(k for k in op.dynamic_attrs if k in attrs)
+    dyn_vals = tuple(float(attrs[k]) for k in dyn_names)
+    items = tuple(sorted((k, _hashable(v)) for k, v in attrs.items()
+                         if k not in dyn_names))
+    fn = _jitted(op.name, items, dyn_names, is_train, with_rng)
     if with_rng:
         if rng is None:
             from .. import random as _random
             rng = _random.next_key()
-        out = fn(rng, *arrays)
+        out = fn(rng, dyn_vals, *arrays)
     else:
-        out = fn(*arrays)
+        out = fn(dyn_vals, *arrays)
     if isinstance(out, (tuple, list)):
         return tuple(out)
     return (out,)

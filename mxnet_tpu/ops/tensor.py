@@ -664,13 +664,15 @@ def _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd):
     return grad + wd * weight
 
 
-@register("sgd_update", input_names=("weight", "grad"))
+@register("sgd_update", input_names=("weight", "grad"),
+          dynamic_attrs=("lr", "wd", "rescale_grad"))
 def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
     return weight - lr * g
 
 
-@register("sgd_mom_update", input_names=("weight", "grad", "mom"), num_outputs=2)
+@register("sgd_mom_update", input_names=("weight", "grad", "mom"),
+          num_outputs=2, dynamic_attrs=("lr", "wd", "rescale_grad"))
 def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
                    rescale_grad=1.0, clip_gradient=-1.0):
     g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
@@ -678,7 +680,8 @@ def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     return weight + mom, mom
 
 
-@register("adam_update", input_names=("weight", "grad", "mean", "var"), num_outputs=3)
+@register("adam_update", input_names=("weight", "grad", "mean", "var"),
+          num_outputs=3, dynamic_attrs=("lr", "wd", "rescale_grad"))
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
@@ -688,7 +691,8 @@ def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return weight, mean, var
 
 
-@register("rmsprop_update", input_names=("weight", "grad", "n"), num_outputs=2)
+@register("rmsprop_update", input_names=("weight", "grad", "n"),
+          num_outputs=2, dynamic_attrs=("lr", "wd", "rescale_grad"))
 def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
     g = _apply_wd_clip(weight, grad, rescale_grad, clip_gradient, wd)
@@ -700,7 +704,7 @@ def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
 
 
 @register("rmspropalex_update", input_names=("weight", "grad", "n", "g", "delta"),
-          num_outputs=4)
+          num_outputs=4, dynamic_attrs=("lr", "wd", "rescale_grad"))
 def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):

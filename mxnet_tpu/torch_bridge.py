@@ -1,0 +1,207 @@
+"""Torch interoperability (the reference's plugin/torch + python torch.py).
+
+The reference embeds Torch7 modules/criterions into MXNet graphs
+(plugin/torch/torch_module-inl.h: module parameters become MXNet args,
+forward/backward call into TH) and exposes TH math as `mx.th.*`
+(python/mxnet/torch.py).  This rebuild wraps modern PyTorch (CPU) through
+the CustomOp protocol:
+
+- ``TorchModule``: a ``torch.nn.Module`` as a symbol-producing layer whose
+  torch parameters are MXNet arguments (initialized/updated/checkpointed
+  by MXNet optimizers; gradients via torch autograd on the host).
+- ``TorchCriterion``: a torch loss as an output layer (backward injects
+  the torch gradient, ignoring head grads — loss-layer convention).
+- ``mx.th``: TH-style math functions executed by torch on host arrays.
+
+TPU note: torch runs on the host CPU, so graphs containing these layers
+execute eagerly around them (same engine-callback behavior as the
+reference plugin, which runs TH on the engine's CPU/GPU queue).  Use them
+for interop/porting, not hot paths.
+"""
+from __future__ import annotations
+
+import numpy as np  # noqa: F401 — host copies for torch interop
+
+from . import operator as _op
+from . import symbol as _sym
+from .base import MXNetError
+
+__all__ = ["TorchModule", "TorchCriterion", "th"]
+
+_MODULE_REGISTRY = {}
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover - torch is in the image
+        raise MXNetError("the torch bridge needs pytorch installed") from e
+
+
+class _TorchModuleOp(_op.CustomOp):
+    def __init__(self, tmod, param_names):
+        self._tmod = tmod
+        self._param_names = param_names
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _torch()
+        params = dict(self._tmod.named_parameters())
+        with torch.no_grad():
+            for name, arr in zip(self._param_names, in_data[1:]):
+                params[name].copy_(torch.from_numpy(
+                    np.array(arr.asnumpy())))
+        x = torch.from_numpy(np.array(in_data[0].asnumpy()))
+        if is_train:
+            self._x = x.requires_grad_(True)
+            self._y = self._tmod(self._x)
+            out = self._y.detach().numpy()
+        else:
+            with torch.no_grad():
+                out = self._tmod(x).numpy()
+        self.assign(out_data[0], req[0], out)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _torch()
+        params = [dict(self._tmod.named_parameters())[n]
+                  for n in self._param_names]
+        head = torch.from_numpy(np.array(out_grad[0].asnumpy()))
+        grads = torch.autograd.grad(
+            self._y, [self._x] + params, grad_outputs=head,
+            allow_unused=True)
+        for i, g in enumerate(grads):
+            gnp = np.zeros(in_data[i].shape, np.float32) if g is None \
+                else g.detach().numpy()
+            self.assign(in_grad[i], req[i], gnp)
+
+
+class _TorchModuleProp(_op.CustomOpProp):
+    def __init__(self, torch_key=None, **_):
+        super().__init__(need_top_grad=True)
+        self._tmod, self._out_shape_fn = _MODULE_REGISTRY[str(torch_key)]
+        self._param_names = [n for n, _ in self._tmod.named_parameters()]
+
+    def list_arguments(self):
+        return ["data"] + ["torch_%s" % n.replace(".", "_")
+                           for n in self._param_names]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        params = dict(self._tmod.named_parameters())
+        p_shapes = [tuple(params[n].shape) for n in self._param_names]
+        out = self._out_shape_fn(tuple(in_shape[0]))
+        return [tuple(in_shape[0])] + p_shapes, [out], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _TorchModuleOp(self._tmod, self._param_names)
+
+
+_op.register("_TorchModule")(_TorchModuleProp)
+
+
+def _infer_out_shape(tmod, in_shape):
+    torch = _torch()
+    with torch.no_grad():
+        y = tmod(torch.zeros(*in_shape))
+    return tuple(y.shape)
+
+
+def TorchModule(torch_module, data, name="torch"):
+    """Wrap a ``torch.nn.Module`` as a symbol layer.
+
+    The module's parameters appear as MXNet arguments named
+    ``<name>_torch_<param>`` — initialized, updated, and checkpointed by
+    MXNet like any other weight (reference plugin/torch/torch_module).
+
+    Example::
+
+        net = mx.torch_bridge.TorchModule(torch.nn.Linear(10, 4), data,
+                                          name="tl")
+    """
+    key = "%s@%d" % (name, id(torch_module))
+    _MODULE_REGISTRY[key] = (
+        torch_module, lambda s: _infer_out_shape(torch_module, s))
+    return _sym.Custom(data, op_type="_TorchModule", torch_key=key,
+                       name=name)
+
+
+class _TorchCriterionOp(_op.CustomOp):
+    def __init__(self, crit):
+        self._crit = crit
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        torch = _torch()
+        self._x = torch.from_numpy(
+            np.array(in_data[0].asnumpy())).requires_grad_(True)
+        self._t = torch.from_numpy(np.array(in_data[1].asnumpy()))
+        loss = self._crit(self._x, self._t)
+        self._loss = loss
+        self.assign(out_data[0], req[0],
+                    np.asarray(loss.detach().numpy()).reshape(1))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        torch = _torch()
+        (g,) = torch.autograd.grad(self._loss, [self._x])
+        # loss layer: inject the criterion gradient, ignore head grads
+        self.assign(in_grad[0], req[0], g.detach().numpy())
+        self.assign(in_grad[1], req[1],
+                    np.zeros(in_data[1].shape, np.float32))
+
+
+class _TorchCriterionProp(_op.CustomOpProp):
+    def __init__(self, torch_key=None, **_):
+        super().__init__(need_top_grad=False)
+        self._crit = _MODULE_REGISTRY[str(torch_key)][0]
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["loss"]
+
+    def infer_shape(self, in_shape):
+        # loss emitted as shape (1,) like the reference criterion
+        return [tuple(in_shape[0]), tuple(in_shape[1])], [(1,)], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _TorchCriterionOp(self._crit)
+
+
+_op.register("_TorchCriterion")(_TorchCriterionProp)
+
+
+def TorchCriterion(criterion, data, label, name="torchloss"):
+    """Wrap a torch loss (e.g. ``torch.nn.MSELoss()``) as an output layer
+    (reference plugin/torch/torch_criterion)."""
+    key = "%s@%d" % (name, id(criterion))
+    _MODULE_REGISTRY[key] = (criterion, None)
+    return _sym.Custom(data, label, op_type="_TorchCriterion",
+                       torch_key=key, name=name)
+
+
+class _ThNamespace(object):
+    """`mx.th.*` — TH-style math executed by torch on the host (reference
+    python/mxnet/torch.py exposes the TH function registry the same way).
+    Accepts/returns NDArray."""
+
+    def __getattr__(self, fname):
+        torch = _torch()
+        fn = getattr(torch, fname, None)
+        if fn is None:
+            raise AttributeError("torch has no function %r" % fname)
+
+        def call(*args, **kwargs):
+            from . import ndarray as nd
+            targs = [torch.from_numpy(np.array(a.asnumpy()))
+                     if isinstance(a, nd.NDArray) else a for a in args]
+            out = fn(*targs, **kwargs)
+            if isinstance(out, torch.Tensor):
+                return nd.array(out.numpy(), dtype=out.numpy().dtype)
+            return out
+        call.__name__ = fname
+        return call
+
+
+th = _ThNamespace()

@@ -6,6 +6,8 @@ tests/python/gpu/test_operator_gpu.py's check_consistency axis).
 
 Shapes are kept tiny because the finite-difference oracle runs 2*numel
 forwards per input."""
+import zlib
+
 import numpy as np
 import pytest
 
@@ -73,13 +75,24 @@ UNARY = [
 @pytest.mark.parametrize("name,build,ref,dom",
                          UNARY, ids=[u[0] for u in UNARY])
 def test_unary_forward_and_gradient(name, build, ref, dom):
+    import jax
     x = mx.sym.Variable("x")
     sym = build(x)
-    a = _u((3, 4), dom[0], dom[1], seed=hash(name) % 1000)
-    check_symbolic_forward(sym, {"x": a}, [ref(a)], rtol=1e-4, atol=1e-5)
+    a = _u((3, 4), dom[0], dom[1], seed=zlib.crc32(name.encode()) % 1000)
+    # Accelerator transcendentals are polynomial approximations good to
+    # ~1e-5 ABSOLUTE (vs CPU libm's ~1 ULP): forward tolerances widen a
+    # little, and the finite-difference oracle needs a larger eps so the
+    # approximation error (~1e-5/eps) stays below tolerance.
+    on_cpu = jax.default_backend() == "cpu"
+    rtol = 1e-4 if on_cpu else 5e-4
+    check_symbolic_forward(sym, {"x": a}, [ref(a)], rtol=rtol, atol=1e-5)
     if name != "sign":  # zero-gradient op
-        check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-3,
-                               rtol=2e-2, atol=2e-3)
+        if on_cpu:
+            check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-3,
+                                   rtol=2e-2, atol=2e-3)
+        else:
+            check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-2,
+                                   rtol=5e-2, atol=5e-3)
 
 
 # ---------------------------------------------------------------------------

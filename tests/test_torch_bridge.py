@@ -1,0 +1,93 @@
+"""Torch bridge tests (reference plugin/torch + python/mxnet/torch.py):
+TorchModule layers train inside MXNet graphs, TorchCriterion losses
+backprop, mx.th math round-trips."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+torch = pytest.importorskip("torch")
+
+
+def test_th_namespace():
+    a = mx.nd.array(np.array([1.0, 2.0, 3.0], "f"))
+    b = mx.nd.array(np.array([4.0, 5.0, 6.0], "f"))
+    np.testing.assert_allclose(mx.th.add(a, b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose(mx.th.sum(a).asnumpy(), 6.0)
+
+
+def test_torch_module_trains():
+    tl = torch.nn.Linear(10, 4)
+    data = mx.sym.Variable("data")
+    net = mx.torch_bridge.TorchModule(tl, data, name="tl")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(8, 10), grad_req="write")
+    # torch params surfaced as MXNet args
+    assert any("torch_weight" in n for n in ex.arg_dict)
+    rs = np.random.RandomState(0)
+    for n, arr in ex.arg_dict.items():
+        if n not in ("data", "softmax_label"):
+            arr[:] = rs.randn(*arr.shape).astype("f") * 0.1
+    X = rs.randn(8, 10).astype("f")
+    W = rs.randn(10, 4).astype("f")
+    y = (X @ W).argmax(1).astype("f")
+    ex.arg_dict["data"][:] = X
+    ex.arg_dict["softmax_label"][:] = y
+    for _ in range(100):
+        ex.forward(is_train=True)
+        ex.backward()
+        for n in ex.arg_dict:
+            if n in ("data", "softmax_label"):
+                continue
+            ex.arg_dict[n][:] = ex.arg_dict[n].asnumpy() \
+                - 0.5 * ex.grad_dict[n].asnumpy()
+    out = ex.forward()[0].asnumpy()
+    assert (out.argmax(1) == y).mean() > 0.9
+
+
+def test_torch_module_grad_matches_fd():
+    tl = torch.nn.Linear(6, 3)
+    data = mx.sym.Variable("data")
+    net = mx.torch_bridge.TorchModule(tl, data, name="fdl")
+    # sum output so head grads are ones
+    net = mx.sym.MakeLoss(mx.sym.sum(net * net))
+    ex = net.simple_bind(mx.cpu(), data=(4, 6), grad_req="write")
+    rs = np.random.RandomState(1)
+    for n, arr in ex.arg_dict.items():
+        arr[:] = rs.randn(*arr.shape).astype("f") * 0.5
+    ex.forward(is_train=True)
+    ex.backward()
+    gname = [n for n in ex.arg_dict if "torch_weight" in n][0]
+    g = ex.grad_dict[gname].asnumpy()
+    w0 = ex.arg_dict[gname].asnumpy().copy()
+    eps = 1e-3
+    for (i, j) in [(0, 0), (2, 5), (1, 3)]:
+        wp = w0.copy()
+        wp[i, j] += eps
+        ex.arg_dict[gname][:] = wp
+        lp = float(ex.forward(is_train=True)[0].asnumpy())
+        wm = w0.copy()
+        wm[i, j] -= eps
+        ex.arg_dict[gname][:] = wm
+        lm = float(ex.forward(is_train=True)[0].asnumpy())
+        ex.arg_dict[gname][:] = w0
+        fd = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(g[i, j], fd, rtol=2e-2, atol=1e-3)
+
+
+def test_torch_criterion():
+    rs = np.random.RandomState(2)
+    d = mx.sym.Variable("d")
+    l = mx.sym.Variable("l")
+    lsym = mx.torch_bridge.TorchCriterion(torch.nn.MSELoss(), d, l)
+    ex = lsym.simple_bind(mx.cpu(), d=(4, 3), l=(4, 3),
+                          grad_req={"d": "write", "l": "null"})
+    dv = rs.randn(4, 3).astype("f")
+    lv = rs.randn(4, 3).astype("f")
+    ex.arg_dict["d"][:] = dv
+    ex.arg_dict["l"][:] = lv
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, ((dv - lv) ** 2).mean(), rtol=1e-5)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["d"].asnumpy(),
+                               2 * (dv - lv) / 12, rtol=1e-5)
