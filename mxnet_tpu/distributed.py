@@ -176,3 +176,87 @@ class Collective:
             return x
         contrib = x if self.rank == root else np.zeros_like(x)
         return self._local_view(self._sum(self._global(contrib)))
+
+
+# ---------------------------------------------------------------------------
+# Liveness heartbeats (the reference's ps-lite heartbeat machinery behind
+# KVStore::get_num_dead_node, kvstore_dist.h:158-167).  Each process
+# periodically stamps a key in the JAX coordination service's key-value
+# store; any process can then count peers whose stamp has gone stale.
+# Collectives themselves remain all-or-nothing (a dead rank fails the next
+# collective on every rank) — heartbeats exist so monitoring/driver code
+# can OBSERVE which rank died, like the reference's dead-node query.
+# ---------------------------------------------------------------------------
+
+_HB_PREFIX = "mxtpu_hb/"
+_HB_THREAD = None
+_HB_STOP = None
+HEARTBEAT_INTERVAL = 2.0
+
+
+def _kv_client():
+    if not _INITIALIZED:
+        return None
+    from jax._src import distributed as _jd
+    return _jd.global_state.client
+
+
+def start_heartbeat(interval=None):
+    """Begin stamping this process's liveness key (idempotent).  Runs on a
+    daemon thread; dist kvstores start it automatically."""
+    global _HB_THREAD, _HB_STOP
+    client = _kv_client()
+    if client is None or _HB_THREAD is not None:
+        return False
+    import threading
+    import time as _time
+
+    interval = float(interval or HEARTBEAT_INTERVAL)
+    key = _HB_PREFIX + str(rank())
+    stop = threading.Event()
+
+    def beat():
+        while not stop.is_set():
+            try:
+                client.key_value_set(key, repr(_time.time()),
+                                     allow_overwrite=True)
+            except Exception:  # noqa: BLE001 — coordinator gone: job is over
+                return
+            stop.wait(interval)
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name="mxtpu-heartbeat")
+    t.start()
+    _HB_THREAD, _HB_STOP = t, stop
+    import atexit
+    atexit.register(stop.set)
+    return True
+
+
+def heartbeat_ages():
+    """rank -> seconds since its last heartbeat (None = never seen)."""
+    import time as _time
+    client = _kv_client()
+    if client is None:
+        return {}
+    now = _time.time()
+    ages = {}
+    for r in range(num_workers()):
+        try:
+            stamp = client.key_value_try_get(_HB_PREFIX + str(r))
+            ages[r] = now - float(stamp)
+        except Exception:  # noqa: BLE001 — not yet written
+            ages[r] = None
+    return ages
+
+
+def num_dead_nodes(node_id=-1, timeout=60):
+    """Count workers whose heartbeat is older than ``timeout`` seconds
+    (reference get_num_dead_node semantics; node_id filtering reduces to
+    "any worker" here — there are no separate server/scheduler roles).
+    Workers that never heartbeat (pre-start) are not counted dead."""
+    dead = 0
+    for r, age in heartbeat_ages().items():
+        if age is not None and age > timeout:
+            dead += 1
+    return dead

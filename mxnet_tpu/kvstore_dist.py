@@ -40,9 +40,17 @@ class KVStoreTPU(KVStore):
                 "use 'tpu' / 'dist_sync'. (SURVEY §5.8 design decision)")
         super().__init__(kind)
         distributed.initialize()  # no-op unless launched via tools/launch.py
+        distributed.start_heartbeat()  # liveness stamps for dead-node query
         import jax
         self._jax = jax
         self._coll = None  # built lazily, after the backend is up
+
+    def get_num_dead_node(self, node_id=-1, timeout=60):
+        """Count workers with stale liveness stamps (reference ps-lite
+        heartbeat query, kvstore_dist.h:158-167; see
+        distributed.num_dead_nodes — collectives stay all-or-nothing, this
+        is the monitoring-side observation mechanism)."""
+        return distributed.num_dead_nodes(node_id=node_id, timeout=timeout)
 
     @property
     def _collective(self):

@@ -1,0 +1,58 @@
+"""Dead-node detection worker (run under tools/launch.py with -n 3).
+
+Rank 2 exits after its first heartbeat; rank 0 polls
+kv.get_num_dead_node until the stale stamp is reported.  The launcher is
+invoked with --no-fail-fast-equivalent via env (rank 2 exits 0, a clean
+"death" for the detector's purposes).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mxnet_tpu import distributed
+
+distributed.initialize()
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    distributed.HEARTBEAT_INTERVAL = 0.3
+    kv = mx.kv.create("tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == 3
+    # everyone heartbeats at least once and syncs
+    time.sleep(0.6)
+    distributed.barrier("hb_started")
+
+    if rank == 2:
+        # die silently (stop heartbeating but leave the coordinator up:
+        # the observable is the stale stamp, like a ps-lite heartbeat
+        # timeout before the TCP session drops)
+        import mxnet_tpu.distributed as d
+        d._HB_STOP.set()
+        time.sleep(6.0)
+        print("dist_dead_node rank 2/3: OK (went silent)")
+        return
+
+    assert kv.get_num_dead_node(timeout=60) == 0
+    if rank == 0:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            dead = kv.get_num_dead_node(timeout=2)
+            if dead == 1:
+                break
+            time.sleep(0.5)
+        assert dead == 1, "dead=%d" % dead
+        ages = distributed.heartbeat_ages()
+        assert ages[2] is not None and ages[2] > 2, ages
+        assert ages[0] is not None and ages[0] < 2, ages
+    time.sleep(1.0)
+    print("dist_dead_node rank %d/3: OK" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -63,3 +63,18 @@ def test_launcher_propagates_failure():
          sys.executable, "-c", "import sys; sys.exit(3)"],
         env=_clean_env(), capture_output=True, text=True, timeout=120)
     assert res.returncode != 0
+
+
+def test_dist_dead_node_detection():
+    """Liveness heartbeats over the coordination KV store: a silent worker
+    is observed via kv.get_num_dead_node (the reference's ps-lite
+    heartbeat query, kvstore_dist.h:158-167)."""
+    worker = os.path.join(REPO, "tests", "dist", "dist_dead_node.py")
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "3", "--platform", "cpu",
+         sys.executable, worker],
+        env=_clean_env(), capture_output=True, text=True, timeout=600)
+    sys.stdout.write(res.stdout[-4000:])
+    assert res.returncode == 0, res.stdout[-4000:]
+    assert "dist_dead_node rank 0/3: OK" in res.stdout
+    assert "rank 2/3: OK (went silent)" in res.stdout
