@@ -1,0 +1,104 @@
+"""Per-op cpu-vs-default-device consistency sweep (the reference's
+tests/python/gpu/test_operator_gpu.py axis: the same symbol runs on the
+CPU backend and the default device, outputs must agree).
+
+Under MXTPU_TEST_PLATFORM=tpu the default device is the real chip and
+this is the genuine CPU-reference-vs-TPU oracle per op family; on the CPU
+platform both contexts are CPU and the sweep still guards determinism and
+the multi-context bind path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _v(name="data"):
+    return mx.sym.Variable(name)
+
+
+SWEEP = [
+    ("conv_stride", lambda: mx.sym.Convolution(
+        _v(), kernel=(3, 3), stride=(2, 2), num_filter=8, name="c"),
+     {"data": (2, 3, 13, 13)}),
+    ("conv_dilate_group", lambda: mx.sym.Convolution(
+        _v(), kernel=(3, 3), dilate=(2, 2), num_group=2, num_filter=8,
+        pad=(2, 2), name="c"), {"data": (2, 4, 11, 11)}),
+    ("deconv", lambda: mx.sym.Deconvolution(
+        _v(), kernel=(4, 4), stride=(2, 2), pad=(1, 1), num_filter=4,
+        name="d"), {"data": (2, 3, 8, 8)}),
+    ("pool_max", lambda: mx.sym.Pooling(
+        _v(), kernel=(3, 3), stride=(2, 2), pool_type="max"),
+     {"data": (2, 3, 11, 11)}),
+    ("pool_avg_pad", lambda: mx.sym.Pooling(
+        _v(), kernel=(2, 2), stride=(2, 2), pad=(1, 1), pool_type="avg"),
+     {"data": (2, 3, 10, 10)}),
+    ("pool_global", lambda: mx.sym.Pooling(
+        _v(), kernel=(1, 1), global_pool=True, pool_type="max"),
+     {"data": (2, 3, 9, 9)}),
+    ("batchnorm", lambda: mx.sym.BatchNorm(_v(), name="bn"),
+     {"data": (4, 3, 6, 6)}),
+    ("fullyconnected", lambda: mx.sym.FullyConnected(
+        _v(), num_hidden=16, name="fc"), {"data": (4, 12)}),
+    ("activation_tanh", lambda: mx.sym.Activation(_v(), act_type="tanh"),
+     {"data": (3, 7)}),
+    ("leakyrelu_elu", lambda: mx.sym.LeakyReLU(
+        _v(), act_type="elu", slope=0.3), {"data": (3, 7)}),
+    ("softmax_act", lambda: mx.sym.SoftmaxActivation(_v()),
+     {"data": (4, 9)}),
+    ("lrn", lambda: mx.sym.LRN(_v(), nsize=3), {"data": (2, 6, 5, 5)}),
+    ("dot", lambda: mx.sym.dot(_v("a"), _v("b")),
+     {"a": (5, 7), "b": (7, 3)}),
+    ("batch_dot", lambda: mx.sym.batch_dot(_v("a"), _v("b")),
+     {"a": (4, 5, 6), "b": (4, 6, 3)}),
+    ("reduce_sum_axis", lambda: mx.sym.sum(_v(), axis=1, keepdims=True),
+     {"data": (4, 5, 6)}),
+    ("reduce_max", lambda: mx.sym.max(_v(), axis=(0, 2)),
+     {"data": (4, 5, 6)}),
+    ("broadcast_chain", lambda: mx.sym.broadcast_mul(
+        mx.sym.broadcast_add(_v("a"), _v("b")), _v("b")),
+     {"a": (4, 1, 6), "b": (1, 5, 6)}),
+    ("transpose_reshape", lambda: mx.sym.Reshape(mx.sym.transpose(
+        _v(), axes=(1, 0, 2)), shape=(-1, 6)), {"data": (4, 5, 6)}),
+    ("slice_axis_concat", lambda: mx.sym.Concat(
+        mx.sym.slice_axis(_v(), axis=1, begin=0, end=2),
+        mx.sym.slice_axis(_v(), axis=1, begin=3, end=5), dim=1),
+     {"data": (3, 6, 4)}),
+    ("embedding", lambda: mx.sym.Embedding(
+        _v(), input_dim=11, output_dim=5, name="emb"), {"data": (4, 7)}),
+    ("topk_sort", lambda: mx.sym.topk(_v(), axis=1, k=3, ret_typ="value"),
+     {"data": (4, 9)}),
+    ("sequence_mask", lambda: mx.sym.SequenceMask(
+        _v(), use_sequence_length=False, value=-1.0), {"data": (5, 3, 2)}),
+    ("upsampling", lambda: mx.sym.UpSampling(
+        _v(), scale=2, sample_type="nearest"), {"data": (2, 3, 4, 4)}),
+    ("pad_reflect", lambda: mx.sym.Pad(
+        _v(), mode="edge", pad_width=(0, 0, 0, 0, 1, 1, 2, 2)),
+     {"data": (2, 3, 5, 5)}),
+    ("swapaxis_flip", lambda: mx.sym.flip(mx.sym.SwapAxis(
+        _v(), dim1=1, dim2=2), axis=0), {"data": (3, 4, 5)}),
+    ("instance_norm", lambda: mx.sym.InstanceNorm(_v(), name="in"),
+     {"data": (3, 4, 5, 5)}),
+    ("l2_normalization", lambda: mx.sym.L2Normalization(_v()),
+     {"data": (4, 6)}),
+    ("roipooling", lambda: mx.sym.ROIPooling(
+        _v(), _v("rois"), pooled_size=(2, 2), spatial_scale=1.0),
+     {"data": (1, 2, 6, 6), "rois": (2, 5)}),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes", SWEEP,
+                         ids=[s[0] for s in SWEEP])
+def test_op_consistency(name, build, shapes):
+    import jax
+    sym = build()
+    # accelerator transcendental/accumulation slack; matmul precision is
+    # pinned "highest" in TPU test mode (conftest)
+    on_cpu = jax.default_backend() == "cpu"
+    rtol = 1e-4 if on_cpu else 2e-3
+    atol = 1e-5 if on_cpu else 5e-4
+    check_consistency(sym, [
+        {"ctx": mx.cpu(0), "shapes": shapes},
+        {"ctx": mx.current_context(), "shapes": shapes},
+    ], rtol=rtol, atol=atol)
