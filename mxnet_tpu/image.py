@@ -834,11 +834,27 @@ class _NativePipeline(_AsyncPipeline):
     SUPPORTED = frozenset(("resize", "rand_crop", "rand_mirror",
                            "mean", "std"))
 
+    #: device-upload threads: each nd.array() call may BLOCK for a full
+    #: host->device round trip (tunneled/remote devices have ~100 ms
+    #: transfer latency at fine batch sizes even when bandwidth is ample),
+    #: so uploads run on a small pool with order-preserving delivery.
+    #: MXNET_UPLOAD_THREADS overrides (1 = serial uploads on the pool).
+    UPLOAD_THREADS = int(get_env("MXNET_UPLOAD_THREADS", "4"))
+
     def __init__(self, it, data_shape, batch_size, label_width, aug_kwargs,
-                 num_workers, prefetch, dtype, layout="NCHW", seed=0):
+                 num_workers, prefetch, dtype, layout="NCHW", seed=0,
+                 device_transform=None):
+        import concurrent.futures as _cf
         import ctypes
 
         from . import native as _native
+        self._uploader = _cf.ThreadPoolExecutor(
+            max_workers=self.UPLOAD_THREADS,
+            thread_name_prefix="mxtpu-upload")
+        # optional device-side per-batch map (e.g. a jitted
+        # normalize/transpose/cast): runs on the uploader threads so its
+        # dispatch latency overlaps across in-flight batches
+        self._device_transform = device_transform
 
         lib = _native.get_lib()
         if lib is None or not getattr(lib, "_has_imagedec", False):
@@ -904,6 +920,10 @@ class _NativePipeline(_AsyncPipeline):
                                               seed=seed)
 
     def _shutdown_extra(self):
+        try:
+            self._uploader.shutdown(wait=False, cancel_futures=True)
+        except Exception:  # noqa: BLE001
+            pass
         # only free the C++ pipe once the reader thread is provably out of
         # MXTPUImgPipeDecodeBatch — if the join timed out, leak the pipe
         # rather than delete an object a live thread is executing in
@@ -911,7 +931,17 @@ class _NativePipeline(_AsyncPipeline):
             self._lib.MXTPUImgPipeDestroy(self._pipe)
             self._pipe = None
 
+    def _upload(self, out, lab_arr, pad):
+        """Host batch -> device DataBatch (runs on an uploader thread; the
+        nd.array device transfer may block for a full link round trip)."""
+        data = nd.array(out, dtype=out.dtype)
+        if self._device_transform is not None:
+            data = nd.NDArray._from_jax(self._device_transform(data._data))
+        labels = nd.array(lab_arr[:, 0] if self._lw == 1 else lab_arr)
+        return mxio.DataBatch([data], [labels], pad=pad)
+
     def _one_epoch(self):
+        from collections import deque
         ct = self._ct
         bs = self._bs
         c, h, w = self._shape
@@ -922,6 +952,12 @@ class _NativePipeline(_AsyncPipeline):
         u8p = ct.POINTER(ct.c_uint8)
         valid = np.empty(bs, np.uint8)
         exhausted = False
+        inflight = deque()   # ordered upload futures
+
+        def drain(block):
+            while inflight and (block or inflight[0].done()):
+                self._put(inflight.popleft().result())
+
         while not exhausted and not self._stopping and not self._abandon:
             raws, labs = [], []
             for _ in range(bs):
@@ -938,8 +974,8 @@ class _NativePipeline(_AsyncPipeline):
             cseed = _chunk_seed(self._seed, chunk_in_epoch,
                                 epoch=self._epoch_no)
             chunk_in_epoch += 1
-            # fresh buffer per batch: the device transfer below is async,
-            # so a shared buffer could be rewritten mid-copy
+            # fresh buffer per batch: the device transfer is async wrt this
+            # loop, so a shared buffer could be rewritten mid-copy
             out = np.empty(bshape, self._np_dtype) if n == bs \
                 else np.zeros(bshape, self._np_dtype)
             bufs = (ct.c_void_p * n)(
@@ -964,11 +1000,12 @@ class _NativePipeline(_AsyncPipeline):
             if nv < n:   # compact valid samples to the front, zero the pad
                 out[:nv] = out[keep]
                 out[nv:] = 0
-            batch = mxio.DataBatch(
-                [nd.array(out, dtype=out.dtype)],
-                [nd.array(lab_arr[:, 0] if self._lw == 1 else lab_arr)],
-                pad=bs - nv)
-            self._put(batch)
+            inflight.append(
+                self._uploader.submit(self._upload, out, lab_arr, bs - nv))
+            drain(block=False)
+            while len(inflight) > self.UPLOAD_THREADS + 2:  # backpressure
+                self._put(inflight.popleft().result())
+        drain(block=True)
 
 
 _live_pipelines = None
@@ -1058,7 +1095,7 @@ class ImageRecordIter(mxio.DataIter):
                  shuffle_chunk_seed=0, seed=None, part_index=0, num_parts=1,
                  prefetch_buffer=4, preprocess_threads=4, round_batch=True,
                  data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NCHW", **aug_kwargs):
+                 layout="NCHW", device_transform=None, **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
         from . import random as _random
         self._eff_seed = _random.get_seed() if seed is None else int(seed)
@@ -1086,9 +1123,13 @@ class ImageRecordIter(mxio.DataIter):
                 self._pipeline = _NativePipeline(
                     self._it, tuple(data_shape), batch_size, label_width,
                     aug_kwargs, preprocess_threads, prefetch_buffer, dtype,
-                    layout=layout, seed=self._eff_seed)
+                    layout=layout, seed=self._eff_seed,
+                    device_transform=device_transform)
             except MXNetError:
                 self._pipeline = None
+        if device_transform is not None and self._pipeline is None:
+            raise MXNetError(
+                "device_transform needs the native image pipeline")
         if self._pipeline is None and layout != "NCHW":
             raise MXNetError(
                 "layout='NHWC' needs the native image pipeline (libjpeg); "
