@@ -191,20 +191,172 @@ def _fed_bench(batch, steps, warmup, trials):
         return batch * steps / (time.time() - tic)
 
     fed = _best_of(trial, trials)
+    it.close()
+    trainer.close()  # release HBM (params/momentum/exe) before the next bench
+    return fed
 
-    # iterator-only decode+augment rate (reference pipeline row analog)
-    def it_trial():
+
+def _decode_bench(batch=128, n_img=1024, trials=3):
+    """Pure host-side decode+augment throughput with ZERO device
+    involvement: the iterator runs in host_batches mode (numpy output, the
+    exact product the reference's C++ parser hands out) on the CPU
+    platform, in this metric's own subprocess.  Reports total img/s per
+    thread count (1/2/4/8) plus the 1-thread per-core number — on a
+    single-core host the scaling rows are flat by construction and the
+    per-core number IS the capability claim.
+
+    Reference anchor: "~3,000 images/sec decode+augment" for the whole
+    2017 multi-core host (docs/tutorials/computer_vision/imagenet_full.md:37,
+    C++ parser src/io/iter_image_recordio_2.cc:27-80)."""
+    import mxnet_tpu as mx
+
+    prefix = _make_dataset(n_img)
+    scaling = {}
+    for threads in (1, 2, 4, 8):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+            rand_crop=True, rand_mirror=True, preprocess_threads=threads,
+            prefetch_buffer=4, dtype="uint8", layout="NHWC", seed=0,
+            host_batches=True)
+        for b in it:   # warm epoch (thread pools, buffers, page cache)
+            pass
+
+        def it_trial():
+            it.reset()
+            n = 0
+            tic = time.time()
+            for b in it:
+                n += b.data[0].shape[0]
+            return n / (time.time() - tic)
+
+        scaling[threads] = round(_best_of(it_trial, trials), 2)
+        it.close()
+    return {
+        "decode": max(scaling.values()),
+        "decode_per_core": scaling[1],
+        "decode_scaling": scaling,
+        "ncores": os.cpu_count(),
+    }
+
+
+def _fed_cpu_bench(batch=64, steps=40, warmup=8, trials=3):
+    """Overlap proof on the CPU backend (no tunneled link): pipeline ->
+    device_put -> fused step.  Computes decode-only rate D, staged
+    step-only rate S, and the fed rate F.  The feed machinery hides its
+    latency when F reaches the host's ceiling: min(D, S) when decode and
+    compute can run on different cores, else the single-core serial bound
+    1/(1/D + 1/S) — one core cannot decode and matmul at once, so on a
+    1-core host the demonstrable property is that the pipeline adds no
+    extra serialization on top of the CPU-bound work."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    prefix = _make_dataset(512, side=96)
+    shape = (3, 64, 64)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=32, kernel=(3, 3),
+                             pad=(1, 1), name="c2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def make_it(host):
+        return mx.io.ImageRecordIter(
+            path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+            data_shape=shape, batch_size=batch, shuffle=True,
+            rand_crop=True, rand_mirror=True, preprocess_threads=2,
+            prefetch_buffer=4, dtype="float32", seed=0, host_batches=host)
+
+    trainer = SPMDTrainer(
+        net, "sgd", {"learning_rate": 0.01, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+        mesh=None, compute_dtype="float32")
+    trainer.bind([("data", (batch,) + shape)],
+                 [("softmax_label", (batch,))])
+    trainer.init_params(mx.initializer.Xavier())
+
+    # D: decode-only
+    it = make_it(host=True)
+    for b in it:
+        pass
+
+    def d_trial():
+        it.reset()
         n = 0
         tic = time.time()
-        for _ in range(steps):
-            next(gen)
-            n += batch
+        for b in it:
+            n += b.data[0].shape[0]
         return n / (time.time() - tic)
 
-    decode_rate = _best_of(it_trial, trials)
+    D = _best_of(d_trial, trials)
     it.close()
-    del trainer  # release HBM (params/momentum/exe) before the next bench
-    return fed, decode_rate
+
+    # S: step-only on staged device batches
+    rs = np.random.RandomState(0)
+    staged = []
+    for _ in range(4):
+        d = mx.nd.array(rs.rand(batch, *shape).astype("f"))
+        l = mx.nd.array(rs.randint(0, 10, (batch,)).astype("f"))
+        d.wait_to_read()
+        staged.append((d, l))
+    for i in range(warmup):
+        trainer.step(*staged[i % 4])
+    jax.block_until_ready(trainer.params)
+
+    def s_trial():
+        tic = time.time()
+        for i in range(steps):
+            trainer.step(*staged[i % 4])
+        jax.block_until_ready(trainer.params)
+        return batch * steps / (time.time() - tic)
+
+    S = _best_of(s_trial, trials)
+
+    # F: fed end-to-end
+    it = make_it(host=False)
+
+    def batches():
+        while True:
+            it.reset()
+            for b in it:
+                yield b
+
+    gen = batches()
+    for _ in range(warmup):
+        b = next(gen)
+        trainer.step(b.data[0], b.label[0])
+    jax.block_until_ready(trainer.params)
+
+    def f_trial():
+        tic = time.time()
+        for _ in range(steps):
+            b = next(gen)
+            trainer.step(b.data[0], b.label[0])
+        jax.block_until_ready(trainer.params)
+        return batch * steps / (time.time() - tic)
+
+    F = _best_of(f_trial, trials)
+    it.close()
+
+    ncores = os.cpu_count() or 1
+    ceiling = min(D, S) if ncores > 1 else 1.0 / (1.0 / D + 1.0 / S)
+    return {
+        "fed_cpu": round(F, 2),
+        "fed_cpu_decode": round(D, 2),
+        "fed_cpu_step": round(S, 2),
+        "fed_cpu_ceiling": round(ceiling, 2),
+        "fed_cpu_overlap": round(F / ceiling, 3),
+    }
 
 
 def _lstm_bench(batch, seq_len, steps, warmup, trials):
@@ -258,10 +410,18 @@ def _run_mode(mode):
     trials = _env_int("BENCH_TRIALS", 2)
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
-    if mode == "fed":
-        fed, decode_rate = _fed_bench(batch, steps, warmup, trials)
-        out["fed"] = round(fed, 2)
-        out["decode"] = round(decode_rate, 2)
+    if mode in ("decode", "fed-cpu"):
+        # host-side metrics: force the CPU backend BEFORE any jax client
+        # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
+        # every nd.array would cross the tunneled device link
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if mode == "decode":
+        out.update(_decode_bench())
+    elif mode == "fed-cpu":
+        out.update(_fed_cpu_bench())
+    elif mode == "fed":
+        out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
     elif mode == "compute":
         tr = _make_trainer("resnet-50", batch)
         out["compute"] = round(
@@ -310,6 +470,8 @@ def main():
     result = {}
     parts = {}
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
+        parts.update(_collect("decode"))
+        parts.update(_collect("fed-cpu"))
         parts.update(_collect("fed"))
     parts.update(_collect("compute"))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
@@ -327,15 +489,25 @@ def main():
             "unit": "images/sec",
             "vs_baseline": round(fed / baseline, 3),
         })
-        if "decode" in parts:
-            # reference RecordIO pipeline row: ~3,000 img/s decode+augment
-            result["pipeline_decode_img_s"] = parts["decode"]
-            result["pipeline_decode_vs_baseline"] = round(
-                parts["decode"] / 3000.0, 3)
         result["pipeline_note"] = (
             "fed number is bound by this harness's tunneled device link "
             "(~100ms/op RTT under concurrent traffic), not the pipeline: "
-            "decode sustains >3k img/s/core and the step >12k img/s staged")
+            "see pipeline_decode_img_s (host-only, zero device) and "
+            "fed_cpu_overlap (feed machinery vs the host's ceiling)")
+    if "decode" in parts:
+        # reference RecordIO pipeline row: ~3,000 img/s decode+augment
+        # (imagenet_full.md:37) — measured here with zero device
+        # involvement, per-thread-count scaling rows included
+        result["pipeline_decode_img_s"] = parts["decode"]
+        result["pipeline_decode_vs_baseline"] = round(
+            parts["decode"] / 3000.0, 3)
+        result["pipeline_decode_per_core_img_s"] = parts["decode_per_core"]
+        result["pipeline_decode_scaling"] = parts["decode_scaling"]
+        result["pipeline_ncores"] = parts["ncores"]
+    for k in ("fed_cpu", "fed_cpu_decode", "fed_cpu_step",
+              "fed_cpu_ceiling", "fed_cpu_overlap"):
+        if k in parts:
+            result[k] = parts[k]
     if compute is not None:
         if fed is None:
             result.update({

@@ -52,6 +52,9 @@ def _masked_crc(data):
 # ---------------------------------------------------------------------------
 
 def _varint(n):
+    # protobuf encodes negative int64 as 10-byte two's complement; without
+    # the mask a negative Python int never reaches 0 and the loop spins
+    n &= (1 << 64) - 1
     out = bytearray()
     while True:
         b = n & 0x7F

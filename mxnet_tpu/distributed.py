@@ -233,20 +233,34 @@ def start_heartbeat(interval=None):
     return True
 
 
+# Observer-side liveness cache: rank -> (last stamp value seen, local
+# monotonic time it changed).  Ages are measured with the *observer's*
+# clock from the moment the stamp last changed — never by differencing a
+# remote wall clock against ours, so NTP steps / cross-host skew cannot
+# fake a dead (or alive) worker.  Same discipline as ps-lite, which uses
+# the receiver's own timestamps for heartbeat staleness.
+_HB_OBSERVED = {}
+
+
 def heartbeat_ages():
-    """rank -> seconds since its last heartbeat (None = never seen)."""
+    """rank -> seconds since its heartbeat value last changed, measured on
+    the local monotonic clock (None = never seen)."""
     import time as _time
     client = _kv_client()
     if client is None:
         return {}
-    now = _time.time()
+    now = _time.monotonic()
     ages = {}
     for r in range(num_workers()):
         try:
             stamp = client.key_value_try_get(_HB_PREFIX + str(r))
-            ages[r] = now - float(stamp)
         except Exception:  # noqa: BLE001 — not yet written
             ages[r] = None
+            continue
+        prev = _HB_OBSERVED.get(r)
+        if prev is None or prev[0] != stamp:
+            _HB_OBSERVED[r] = (stamp, now)
+        ages[r] = now - _HB_OBSERVED[r][1]
     return ages
 
 

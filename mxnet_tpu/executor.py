@@ -129,7 +129,8 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
                 kw["is_train"] = is_train
             if node.op.needs_rng:
                 kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
-            out = node.op.fn(*ins, **call_attrs, **kw)
+            with jax.named_scope(node.name):
+                out = node.op.fn(*ins, **call_attrs, **kw)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
             env[id(node)] = tuple(out[:n_out])
@@ -177,7 +178,12 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
             kw["is_train"] = is_train
         if node.op.needs_rng:
             kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
-        out = node.op.fn(*ins, **call_attrs, **kw)
+        # named_scope stamps the symbol node name into HLO op_name
+        # metadata, so device profiles attribute fused-program time back
+        # to graph nodes (reference per-op profiler semantics,
+        # src/engine/profiler.cc AddOprStat with opr_name)
+        with jax.named_scope(node.name):
+            out = node.op.fn(*ins, **call_attrs, **kw)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         env[id(node)] = tuple(out[:n_out])
@@ -596,3 +602,42 @@ def _req_dict(grad_req, arg_names):
     if isinstance(grad_req, dict):
         return {n: grad_req.get(n, "null") for n in arg_names}
     raise MXNetError("invalid grad_req %r" % (grad_req,))
+
+
+def _executor_close(self):
+    """Release this executor's compiled programs and the buffers it owns
+    (its outputs), and drop its references to the bound arrays (reference
+    ~GraphExecutor frees its memory pool; jax buffers otherwise wait for
+    GC and retained jit wrappers pin executables).  The bound
+    arg/grad/aux arrays are CALLER-owned — they may be shared with other
+    executors (shared_exec bucketing) or still be the caller's parameter
+    NDArrays — so close() must not delete them, only unpin them.  The
+    executor is unusable afterwards; safe to call twice."""
+    for o in (self._outputs or []):
+        data = getattr(o, "_data", None)
+        if isinstance(data, jax.Array):
+            try:
+                data.delete()
+            except Exception:  # noqa: BLE001
+                pass
+    self._outputs = None
+    self.arg_dict = {}
+    self.aux_dict = {}
+    self.grad_dict = {}
+    for attr in ("_jit_fwd", "_jit_fwd_train", "_jit_train"):
+        fn = getattr(self, attr, None)
+        if fn is not None and hasattr(fn, "clear_cache"):
+            try:
+                fn.clear_cache()
+            except Exception:  # noqa: BLE001
+                pass
+        setattr(self, attr, None)
+    self._eval = None
+    import gc
+    gc.collect()
+
+
+Executor.close = _executor_close
+Executor.__enter__ = lambda self: self
+Executor.__exit__ = (
+    lambda self, exc_type, exc_val, exc_tb: (self.close(), False)[1])

@@ -118,7 +118,8 @@ def _configure(lib):
         lib.MXTPUImgPipeCreate.restype = p
         lib.MXTPUImgPipeCreate.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, fp, fp]
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, fp, fp,
+            ctypes.c_int]
         lib.MXTPUImgPipeDecodeBatch.restype = ctypes.c_int
         lib.MXTPUImgPipeDecodeBatch.argtypes = [
             p, pp, ctypes.POINTER(u64), ctypes.c_int, p,

@@ -99,6 +99,7 @@ struct PipeConfig {
   int rand_mirror;  // 1 = flip horizontally with p=0.5
   int dtype;        // DType
   int layout;       // Layout
+  int fast_dct;     // 1 = JDCT_IFAST (~1.5x decode speed, +-2 LSB vs exact)
   float mean[3];
   float std_inv[3];
   bool normalize;
@@ -334,6 +335,14 @@ bool DecodeOne(const PipeConfig& cfg, const Lut& lut, const uint8_t* buf,
     return false;
   }
   cinfo.out_color_space = JCS_RGB;  // grayscale sources convert in-decode
+  if (cfg.fast_dct) {
+    // training profile: IFAST is the fastest SIMD IDCT in libjpeg-turbo
+    // (measured ~1.5x the default ISLOW on 256px q90 photos on this
+    // host); output differs from the exact path by at most a couple of
+    // 8-bit steps, which augmentation noise dwarfs.  Exact mode
+    // (MXNET_JPEG_DECODE_FAST=0) keeps byte parity with cv2.
+    cinfo.dct_method = JDCT_IFAST;
+  }
 
   const int src_w = static_cast<int>(cinfo.image_width);
   const int src_h = static_cast<int>(cinfo.image_height);
@@ -664,7 +673,7 @@ extern "C" {
 // mean/std: pointers to 3 floats (RGB) or null for no normalization.
 void* MXTPUImgPipeCreate(int nthreads, int out_h, int out_w, int resize,
                          int rand_crop, int rand_mirror, int dtype, int layout,
-                         const float* mean, const float* stdv) {
+                         const float* mean, const float* stdv, int fast_dct) {
   mxtpu::PipeConfig cfg;
   cfg.out_h = out_h;
   cfg.out_w = out_w;
@@ -673,6 +682,7 @@ void* MXTPUImgPipeCreate(int nthreads, int out_h, int out_w, int resize,
   cfg.rand_mirror = rand_mirror;
   cfg.dtype = dtype;
   cfg.layout = layout;
+  cfg.fast_dct = fast_dct;
   cfg.normalize = (mean != nullptr) || (stdv != nullptr);
   for (int c = 0; c < 3; ++c) {
     cfg.mean[c] = mean ? mean[c] : 0.0f;

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -127,8 +129,12 @@ def _warpctc_bwd(input_length, label_length, res, g):
     grad3 = jax.grad(lambda lg: ctc_nll(lg, labels).sum())(logits)
     # warp-ctc writes d(sum cost)/d(activations) directly, ignoring the
     # incoming head gradient (warpctc-inl.h Backward)
-    return grad3.reshape(T * N, A).astype(data.dtype), \
-        jnp.zeros_like(label)
+    if jnp.issubdtype(jnp.asarray(label).dtype, jnp.integer):
+        # integer primals take a float0 cotangent under custom_vjp
+        label_ct = np.zeros(np.shape(label), dtype=jax.dtypes.float0)
+    else:
+        label_ct = jnp.zeros_like(label)
+    return grad3.reshape(T * N, A).astype(data.dtype), label_ct
 
 
 _warpctc_core.defvjp(_warpctc_fwd, _warpctc_bwd)

@@ -19,7 +19,7 @@ import os
 from .base import MXNetError
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "State", "Mode"]
+           "dumps", "get_op_stats", "State", "Mode"]
 
 
 class Mode(object):
@@ -79,6 +79,107 @@ def dump_profile(finished=True):
     with open(_config["filename"], "w") as f:
         f.write(data)
     return _config["filename"]
+
+
+def _latest_device_trace(trace_dir=None):
+    """Newest <trace_dir>/plugins/profile/*/*.trace.json.gz written by
+    jax.profiler (already Chrome traceEvents format)."""
+    import glob
+    trace_dir = trace_dir or _config["trace_dir"] or \
+        os.path.splitext(_config["filename"])[0] + "_xla"
+    cands = glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz"))
+    if not cands:
+        raise MXNetError(
+            "no XLA device trace under %r — profile with "
+            "mode='all_xla' first" % (trace_dir,))
+    return max(cands, key=os.path.getmtime)
+
+
+def _scope_of(event):
+    """Graph-node name for one device HLO event.
+
+    XLA stamps the jax named_scope path into the event's ``tf_op``
+    metadata (e.g. ``jit(step)/conv2/conv_general_dilated:``); the
+    executor wraps every symbol node in named_scope(node.name), so the
+    middle path segments ARE graph node names.  Events without tf_op
+    (DMA copies, infeed) fall back to their HLO category."""
+    args = event.get("args") or {}
+    tf_op = args.get("tf_op", "")
+    parts = [p for p in tf_op.rstrip(":").split("/") if p]
+    if parts and parts[0].startswith("jit("):
+        parts = parts[1:]
+    if len(parts) >= 2:
+        name = "/".join(parts[:-1])     # named-scope path, primitive off
+    elif parts:
+        name = parts[0]
+    else:
+        return args.get("hlo_category", event.get("name", "?"))
+    # autodiff wrappers -> the reference's fwd/bwd naming: jvp(conv1) is
+    # the forward op, transpose(jvp(conv1)) its backward
+    # (_backward_Convolution in the reference's profile)
+    import re
+    m = re.fullmatch(r"transpose\(jvp\((.+)\)\)", name)
+    if m:
+        return "_backward_" + m.group(1)
+    m = re.fullmatch(r"jvp\((.+)\)", name)
+    if m:
+        return m.group(1)
+    return name
+
+
+def get_op_stats(trace_dir=None):
+    """Per-graph-node device-time stats from the newest XLA trace:
+    {name: {"count": n, "total_us": t, "avg_us": a, "min_us": m,
+    "max_us": M}}.  Works on fused (jit) programs — the reference's
+    per-op profile needed per-op engine dispatch; here HLO metadata
+    attributes fused-program time back to symbol nodes."""
+    import gzip
+    import json
+    path = _latest_device_trace(trace_dir)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    stats = {}
+    for ev in data.get("traceEvents", []):
+        args = ev.get("args") or {}
+        if "device_duration_ps" not in args:
+            continue    # host-side event
+        if "tf_op" not in args and "hlo_category" not in args:
+            continue    # step marker / whole-module span, not an HLO op
+        us = int(args["device_duration_ps"]) / 1e6
+        s = stats.setdefault(_scope_of(ev), {
+            "count": 0, "total_us": 0.0,
+            "min_us": float("inf"), "max_us": 0.0})
+        s["count"] += 1
+        s["total_us"] += us
+        s["min_us"] = min(s["min_us"], us)
+        s["max_us"] = max(s["max_us"], us)
+    for s in stats.values():
+        s["total_us"] = round(s["total_us"], 3)
+        s["min_us"] = round(s["min_us"], 3)
+        s["max_us"] = round(s["max_us"], 3)
+        s["avg_us"] = round(s["total_us"] / s["count"], 3)
+    return stats
+
+
+def dumps(reset=False, trace_dir=None):
+    """Per-op device-time table from the newest XLA trace (reference
+    mx.profiler.dumps / profiler.cc:134-216 per-op stats, over the FUSED
+    program).  ``reset`` is accepted for API parity (traces are
+    per-start_trace already)."""
+    del reset
+    stats = get_op_stats(trace_dir)
+    order = sorted(stats.items(), key=lambda kv: -kv[1]["total_us"])
+    w = max([len("Name")] + [len(k) for k, _ in order]) + 2
+    lines = ["Profile Statistics (device time, fused program)",
+             "%-*s %10s %12s %12s %12s %12s" % (
+                 w, "Name", "Count", "Total-us", "Min-us", "Max-us",
+                 "Avg-us")]
+    for name, s in order:
+        lines.append("%-*s %10d %12.3f %12.3f %12.3f %12.3f" % (
+            w, name, s["count"], s["total_us"], s["min_us"], s["max_us"],
+            s["avg_us"]))
+    return "\n".join(lines) + "\n"
 
 
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":

@@ -163,3 +163,17 @@ def test_ctc_loss_op_and_training():
     x, labels = make_batch()
     final = nll_now(x, labels)
     assert final < first * 0.5, (first, final)
+
+
+def test_warpctc_integer_label_grad():
+    """Integer-dtype labels need a float0 cotangent from the custom vjp —
+    float32-only coverage let jax.grad raise for int32 labels."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import ctc as ctc_mod
+    rs = np.random.RandomState(7)
+    T, N, A, L = 4, 2, 5, 2
+    data = jnp.asarray(rs.randn(T * N, A).astype(np.float32))
+    labels = jnp.asarray([[1, 3], [2, 0]], jnp.int32)
+    g = jax.grad(lambda d: ctc_mod._warpctc_core(d, labels, T, L).sum())(data)
+    assert g.shape == data.shape and np.all(np.isfinite(np.asarray(g)))

@@ -78,3 +78,41 @@ def test_dist_dead_node_detection():
     assert res.returncode == 0, res.stdout[-4000:]
     assert "dist_dead_node rank 0/3: OK" in res.stdout
     assert "rank 2/3: OK (went silent)" in res.stdout
+
+
+def test_heartbeat_ages_observer_side(monkeypatch):
+    """Liveness must be measured on the observer's monotonic clock from the
+    moment a stamp last *changed* — never by differencing a remote
+    wall-clock stamp against local time (clock skew / NTP steps would then
+    fake dead or alive workers; ps-lite uses receive timestamps)."""
+    from mxnet_tpu import distributed as dist
+
+    stamps = {0: "1.0"}   # remote clock decades in the past
+
+    class FakeClient:
+        def key_value_try_get(self, key):
+            r = int(key.rsplit("/", 1)[-1])
+            if r not in stamps:
+                raise KeyError(key)
+            return stamps[r]
+
+    monkeypatch.setattr(dist, "_kv_client", lambda: FakeClient())
+    monkeypatch.setattr(dist, "num_workers", lambda: 2)
+    monkeypatch.setattr(dist, "_HB_OBSERVED", {})
+
+    ages = dist.heartbeat_ages()
+    # a stale-looking *value* just observed for the first time is age ~0,
+    # not (now - 1.0) ~ decades
+    assert ages[0] is not None and ages[0] < 5.0
+    assert ages[1] is None      # never written
+    assert dist.num_dead_nodes(timeout=60) == 0
+
+    # value unchanged -> age measured locally since first observation
+    import time
+    time.sleep(0.05)
+    a2 = dist.heartbeat_ages()[0]
+    assert 0.05 <= a2 < 5.0
+
+    # value changes -> age resets (worker is alive)
+    stamps[0] = "2.0"
+    assert dist.heartbeat_ages()[0] < 0.05

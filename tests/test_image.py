@@ -292,10 +292,13 @@ needs_native = pytest.mark.skipif(not _native_available(),
 
 
 @needs_native
-def test_native_pipeline_selected_and_exact(rec_dataset):
+def test_native_pipeline_selected_and_exact(rec_dataset, monkeypatch):
     """Supported aug sets pick the native pipeline, and its unit-scale
-    center crop is byte-exact vs the cv2 decode reference."""
+    center crop in exact-decode mode is byte-exact vs the cv2 decode
+    reference (the default training profile uses the fast SIMD IDCT —
+    see test_native_pipeline_fast_dct_tolerance)."""
     import cv2
+    monkeypatch.setenv("MXNET_JPEG_DECODE_FAST", "0")
     path, idx = rec_dataset
     it = image.ImageRecordIter(
         path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
@@ -331,9 +334,10 @@ def test_native_pipeline_nhwc_uint8(rec_dataset):
 
 
 @needs_native
-def test_native_pipeline_normalization(rec_dataset):
+def test_native_pipeline_normalization(rec_dataset, monkeypatch):
     """mean/std run inside the native decoder and match numpy."""
     import cv2
+    monkeypatch.setenv("MXNET_JPEG_DECODE_FAST", "0")
     path, idx = rec_dataset
     mean = [123.68, 116.28, 103.53]
     std = [58.395, 57.12, 57.375]
@@ -457,3 +461,76 @@ def test_native_pipeline_fallback_unsupported_augs(rec_dataset):
     b = it.next()
     assert b.data[0].shape == (4, 3, 24, 24)
     it.close()
+
+
+def test_native_pipeline_importerror_falls_back(rec_dataset, monkeypatch):
+    """A non-MXNetError failure inside the native pipeline init (e.g. an
+    ImportError for ml_dtypes, or a ctypes OSError) must fall back to the
+    process/cv2 path instead of breaking iterator construction — and must
+    not leak the already-created uploader pool."""
+    path, idx = rec_dataset
+    created = []
+    orig = image._NativePipeline._init_native
+
+    def boom(self, *a, **kw):
+        created.append(self._uploader)
+        raise ImportError("no ml_dtypes on this host")
+
+    monkeypatch.setattr(image._NativePipeline, "_init_native", boom)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, shuffle=False, preprocess_threads=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert created and created[0]._shutdown   # pool released on failure
+    assert not isinstance(getattr(it, "_pipeline", None),
+                          image._NativePipeline)
+
+
+@needs_native
+def test_native_pipeline_fast_dct_tolerance(rec_dataset):
+    """The default training decode profile (fast SIMD IDCT,
+    MXNET_JPEG_DECODE_FAST=1) stays within a few 8-bit steps of the exact
+    cv2 decode — augmentation noise dwarfs this, and exact mode remains
+    available for byte-parity."""
+    import cv2
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, preprocess_threads=1, seed=3)
+    assert isinstance(it._pipeline, image._NativePipeline)
+    got = it.next().data[0].asnumpy()
+    it.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    for i in range(4):
+        hdr, raw = recordio.unpack(r.read_idx(i))
+        ref = cv2.imdecode(np.frombuffer(bytes(raw), np.uint8), 1)[..., ::-1]
+        h, w = ref.shape[:2]
+        y0, x0 = (h - 24) // 2, (w - 24) // 2
+        ref_crop = ref[y0:y0 + 24, x0:x0 + 24].transpose(2, 0, 1)
+        diff = np.abs(got[i].astype(np.int32) - ref_crop.astype(np.int32))
+        assert diff.max() <= 4, "fast-DCT drift too large: %d" % diff.max()
+        assert diff.mean() < 1.5
+        assert (diff <= 2).mean() > 0.85
+    r.close()
+
+
+@needs_native
+def test_native_pipeline_host_batches(rec_dataset):
+    """host_batches=True yields numpy-backed DataBatches with no device
+    transfer (the reference's C++ parser product: CPU tensors)."""
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+        batch_size=4, dtype="uint8", layout="NHWC", host_batches=True,
+        seed=3)
+    b = it.next()
+    assert isinstance(b.data[0], np.ndarray)
+    assert b.data[0].shape == (4, 24, 24, 3)
+    assert isinstance(b.label[0], np.ndarray)
+    it.close()
+    # host_batches without the native pipeline is a hard error
+    with pytest.raises(mx.MXNetError):
+        image.ImageRecordIter(
+            path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
+            batch_size=4, host_batches=True, brightness=0.3, seed=3)

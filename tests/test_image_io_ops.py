@@ -75,3 +75,18 @@ def test_copy_make_border(jpg_buf):
     np.testing.assert_array_equal(pn[2:42, 4:54], out.asnumpy())
     with pytest.raises(mx.MXNetError):
         mx.nd.copyMakeBorder(out, top=1, type=1)
+
+
+def test_imdecode_unchanged_flag_grayscale():
+    """flag=-1 (IMREAD_UNCHANGED) on a grayscale JPEG must keep one channel
+    (reference _cvimdecode returns the source's own channel count); the
+    always-3-channel native JPEG path must not swallow it."""
+    import cv2
+    g = np.tile(np.arange(48, dtype=np.uint8)[:, None], (1, 32))
+    ok, j = cv2.imencode(".jpg", g, [cv2.IMWRITE_JPEG_QUALITY, 95])
+    assert ok
+    buf = mx.nd.array(np.frombuffer(j.tobytes(), np.uint8), dtype="uint8")
+    out = mx.nd.imdecode(buf, flag=-1)
+    assert out.shape == (48, 32, 1)
+    ref = cv2.imdecode(np.frombuffer(j.tobytes(), np.uint8), -1)
+    np.testing.assert_array_equal(out.asnumpy()[:, :, 0], ref)

@@ -88,3 +88,53 @@ def test_plot_network():
     dot = mx.viz.plot_network(net, shape={"data": (8, 10)})
     src = dot.source
     assert "fc1" in src and "softmax" in src
+
+
+def test_per_op_stats_over_fused_program(tmp_path):
+    """Per-op device times from a FUSED (jit) training step: HLO op_name
+    metadata (stamped by the executor's named_scope per symbol node) maps
+    device events back to graph node names — the reference's per-op
+    profile (src/engine/profiler.cc:134-216) over an XLA program.
+    Device-side HLO events only exist on a real accelerator backend."""
+    import jax
+    if jax.default_backend() == "cpu":
+        pytest.skip("XLA device-op trace events need a TPU backend")
+    from mxnet_tpu import profiler
+    import numpy as np
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(np.random.rand(64, 3, 16, 16).astype("f"),
+                           np.random.randint(0, 10, 64).astype("f"),
+                           batch_size=32)
+    profiler.profiler_set_config(
+        mode="all_xla", filename=str(tmp_path / "prof.json"),
+        trace_dir=str(tmp_path / "xla"))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    b = next(iter(it))
+    mod.forward_backward(b)
+    mod.update()          # compile outside the trace
+    profiler.profiler_set_state("run")
+    for _ in range(3):
+        mod.forward_backward(b)
+        mod.update()
+    for v in mod.get_outputs():
+        v.wait_to_read()
+    profiler.profiler_set_state("stop")
+
+    stats = profiler.get_op_stats(str(tmp_path / "xla"))
+    names = set(stats)
+    # forward and backward of named layers appear with device times
+    assert any(n.startswith("conv1") or n == "conv1" for n in names), names
+    assert "_backward_conv1" in names, names
+    assert all(s["total_us"] > 0 for s in stats.values())
+    table = profiler.dumps(trace_dir=str(tmp_path / "xla"))
+    assert "Profile Statistics" in table and "_backward_conv1" in table

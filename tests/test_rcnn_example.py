@@ -29,6 +29,9 @@ def test_rcnn_trains():
     finally:
         sys.path.pop(0)
 
+    # the toy set is seeded, but parameter init draws from the global
+    # stream — pin it so suite ordering can't change the outcome
+    mx.random.seed(11)
     it = train.ToyDetIter(n=16, batch_size=4)
     net = train.get_symbol_train(batch_rois=16)
     mod = mx.mod.Module(net, data_names=("data", "im_info", "gt_boxes"),

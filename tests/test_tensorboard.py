@@ -65,3 +65,15 @@ def test_log_metrics_callback_with_fit(tmp_path):
     vals = [v.simple_value for e in events for v in e.summary.value
             if v.tag == "train-accuracy"]
     assert len(vals) >= 2 and all(0.0 <= v <= 1.0 for v in vals)
+
+
+def test_negative_step_does_not_hang(tmp_path):
+    """protobuf int64 varint: negatives are 10-byte two's complement; an
+    unmasked Python int would spin _varint forever."""
+    d = str(tmp_path / "neglogs")
+    w = SummaryWriter(d)
+    w.add_scalar("warmup", 1.5, -1)
+    w.close()
+    events = _read_events(_event_file(d))
+    got = [(v.tag, e.step) for e in events for v in e.summary.value]
+    assert ("warmup", -1) in got
