@@ -1,0 +1,111 @@
+"""Fast Gradient Sign Method adversarial examples (reference
+example/adversary/adversary_generation.ipynb): train a small CNN, then
+bind with ``inputs_need_grad=True``, take the loss gradient W.R.T. THE
+INPUT PIXELS, and perturb each image by eps * sign(grad).  Accuracy on
+the perturbed batch collapses while the perturbation stays invisible.
+
+Data is a generated two-class "digit" set (egress-free stand-in for the
+notebook's MNIST): noisy renderings of a cross vs a square.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_dataset(n, rs, side=16):
+    """Noisy crosses (class 0) vs hollow squares (class 1)."""
+    X = rs.rand(n, 1, side, side).astype(np.float32) * 0.3
+    y = rs.randint(0, 2, n)
+    for i in range(n):
+        c = side // 2 + rs.randint(-2, 3)
+        if y[i] == 0:
+            X[i, 0, c - 1:c + 1, 2:side - 2] += 0.8
+            X[i, 0, 2:side - 2, c - 1:c + 1] += 0.8
+        else:
+            X[i, 0, 3:side - 3, 3:5] += 0.8
+            X[i, 0, 3:side - 3, side - 5:side - 3] += 0.8
+            X[i, 0, 3:5, 3:side - 3] += 0.8
+            X[i, 0, side - 5:side - 3, 3:side - 3] += 0.8
+    return np.clip(X, 0, 1), y.astype(np.float32)
+
+
+def get_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def run(eps=0.3, batch_size=64, num_epoch=3, seed=0):
+    rs = np.random.RandomState(seed)
+    mx.random.seed(seed)
+    Xtr, ytr = make_dataset(640, rs)
+    Xte, yte = make_dataset(256, rs)
+    net = get_symbol()
+
+    train_it = mx.io.NDArrayIter(Xtr, ytr, batch_size=batch_size,
+                                 shuffle=True)
+    mod = mx.mod.Module(net)
+    mod.fit(train_it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+
+    # rebind for_training WITH input gradients (the notebook's second bind)
+    atk = mx.mod.Module(net)
+    atk.bind(data_shapes=[("data", (batch_size, 1, 16, 16))],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=True, inputs_need_grad=True)
+    atk.set_params(arg_params, aux_params)
+
+    def accuracy(X, y):
+        correct = total = 0
+        for i in range(0, len(X) - batch_size + 1, batch_size):
+            atk.forward(mx.io.DataBatch(
+                [mx.nd.array(X[i:i + batch_size])],
+                [mx.nd.array(y[i:i + batch_size])]), is_train=False)
+            pred = atk.get_outputs()[0].asnumpy().argmax(1)
+            correct += (pred == y[i:i + batch_size]).sum()
+            total += batch_size
+        return correct / total
+
+    clean_acc = accuracy(Xte, yte)
+
+    # FGSM: x' = clip(x + eps * sign(dL/dx))
+    Xadv = Xte.copy()
+    for i in range(0, len(Xte) - batch_size + 1, batch_size):
+        atk.forward(mx.io.DataBatch(
+            [mx.nd.array(Xte[i:i + batch_size])],
+            [mx.nd.array(yte[i:i + batch_size])]), is_train=True)
+        atk.backward()
+        g = atk.get_input_grads()[0].asnumpy()
+        Xadv[i:i + batch_size] = np.clip(
+            Xte[i:i + batch_size] + eps * np.sign(g), 0, 1)
+    adv_acc = accuracy(Xadv, yte)
+    logging.info("clean accuracy %.3f -> adversarial accuracy %.3f "
+                 "(eps=%.3f, max |dx|=%.3f)", clean_acc, adv_acc, eps, eps)
+    return clean_acc, adv_acc
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="FGSM adversarial demo")
+    parser.add_argument("--eps", type=float, default=0.3)
+    parser.add_argument("--num-epoch", type=int, default=3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    clean, adv = run(eps=args.eps, num_epoch=args.num_epoch)
+    print("clean: %.3f adversarial: %.3f" % (clean, adv))

@@ -1,0 +1,127 @@
+"""A3C-style advantage actor-critic on the Catch environment (reference
+example/reinforcement-learning/a3c/a3c.py train(), with the gym feed
+replaced by the built-in vectorized env).
+
+Exercises the reference's distinctive mechanics end-to-end:
+- ``grad_req='add'``: gradients accumulate across the t_max timestep
+  backwards of one update, explicitly zeroed between updates;
+- ``SoftmaxOutput(out_grad=True)``: the policy gradient arrives as an
+  explicit head gradient — advantage-scaled — multiplied into the
+  label-based softmax gradient;
+- interleaved is_train=False rollout forwards and training forwards on
+  the same Module;
+- a Group output (policy / entropy / value) with mixed loss heads.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from catch_env import CatchDataIter
+from sym import get_symbol_catch
+
+
+def train(num_updates=300, batch_size=32, t_max=4, gamma=0.99, beta=0.01,
+          lr=0.02, ctx=None, log_every=50, seed=0):
+    """Returns the list of mean episode rewards (one entry per update)."""
+    mx.random.seed(seed)
+    dataiter = CatchDataIter(batch_size, seed=seed)
+    net = get_symbol_catch(dataiter.act_dim)
+    module = mx.mod.Module(
+        net, data_names=("data",),
+        label_names=("policy_label", "value_label"),
+        context=ctx or mx.current_context())
+    module.bind(data_shapes=dataiter.provide_data,
+                label_shapes=[("policy_label", (batch_size,)),
+                              ("value_label", (batch_size, 1))],
+                grad_req="add")
+    init = mx.initializer.Mixed(
+        ["fc_value_weight|fc_policy_weight", ".*"],
+        [mx.initializer.Uniform(0.001),
+         mx.initializer.Xavier(rnd_type="gaussian", factor_type="in",
+                               magnitude=2)])
+    module.init_params(initializer=init)
+    module.init_optimizer(optimizer="adam",
+                          optimizer_params={"learning_rate": lr,
+                                            "epsilon": 1e-3})
+    act_dim = dataiter.act_dim
+    rs = np.random.RandomState(seed + 1)
+    reward_hist = []
+    ep_reward = np.zeros(batch_size, np.float32)
+    finished = []
+    for update in range(num_updates):
+        tic = time.time()
+        # clear accumulated gradients (grad_req='add'), the reference's own
+        # idiom: a3c.py pokes module._exec_group.grad_arrays directly
+        for grads in module._exec_group.grad_arrays:
+            for g in grads:
+                if g is not None:
+                    g[:] = 0
+        S, A, V, r, D = [], [], [], [], []
+        for t in range(t_max + 1):
+            data = [mx.nd.array(dataiter.data())]
+            module.forward(mx.io.DataBatch(data=data, label=None),
+                           is_train=False)
+            act, _, val = module.get_outputs()
+            V.append(val.asnumpy())
+            if t < t_max:
+                p = act.asnumpy()
+                p = p / p.sum(1, keepdims=True)
+                acts = np.array([rs.choice(act_dim, p=p[i])
+                                 for i in range(batch_size)])
+                reward, done = dataiter.act(acts)
+                S.append(data)
+                A.append(acts)
+                r.append(reward.reshape(-1, 1))
+                D.append(done.reshape(-1, 1))
+                ep_reward += reward
+                for j in np.flatnonzero(done):
+                    finished.append(ep_reward[j])
+                    ep_reward[j] = 0.0
+        R = V[t_max]
+        for i in reversed(range(t_max)):
+            R = r[i] + gamma * (1 - D[i]) * R
+            adv = (R - V[i]).astype(np.float32)
+            batch = mx.io.DataBatch(
+                data=S[i],
+                label=[mx.nd.array(A[i].astype(np.float32)),
+                       mx.nd.array(R.astype(np.float32))])
+            module.forward(batch, is_train=True)
+            pi = module.get_outputs()[1].asnumpy()
+            # policy head grad: advantage, tiled over actions — multiplied
+            # into (p - onehot(a)) by SoftmaxOutput(out_grad=True)
+            pol_head = np.tile(adv, (1, act_dim)).astype(np.float32)
+            # entropy bonus: descend on -beta*H  (dL/dpi = beta*(log pi+1))
+            ent_head = beta * (np.log(pi + 1e-7) + 1.0)
+            module.backward([mx.nd.array(pol_head),
+                             mx.nd.array(ent_head),
+                             mx.nd.zeros(V[i].shape)])
+        module.update()
+        recent = float(np.mean(finished[-200:])) if finished else 0.0
+        reward_hist.append(recent)
+        if log_every and update % log_every == 0:
+            logging.info("update %d mean-episode-reward %.3f fps %.0f",
+                         update, recent,
+                         batch_size * t_max / (time.time() - tic))
+    return reward_hist
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="Train A3C on Catch")
+    parser.add_argument("--num-updates", type=int, default=300)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--t-max", type=int, default=4)
+    parser.add_argument("--gamma", type=float, default=0.99)
+    parser.add_argument("--beta", type=float, default=0.01)
+    parser.add_argument("--lr", type=float, default=0.02)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    hist = train(args.num_updates, args.batch_size, args.t_max, args.gamma,
+                 args.beta, args.lr)
+    print("final mean episode reward:", hist[-1])

@@ -1,0 +1,56 @@
+"""Vectorized "Catch" environment: a ball falls down a WxH grid, a paddle
+on the bottom row moves left/stay/right; +1 for catching the ball, -1 for
+missing, 0 elsewhere.  Stands in for the reference's gym Atari feed
+(example/reinforcement-learning/a3c/rl_data.py GymDataIter) in an
+egress-free environment: same batch-of-environments interface — data()
+returns the current observation batch, act(actions) advances every env
+and returns (reward, done) arrays."""
+import numpy as np
+
+
+class CatchDataIter(object):
+    def __init__(self, batch_size, height=8, width=8, seed=0):
+        self.batch_size = batch_size
+        self.h, self.w = height, width
+        self.act_dim = 3                      # left / stay / right
+        self._rs = np.random.RandomState(seed)
+        self._ball_r = np.zeros(batch_size, np.int64)
+        self._ball_c = np.zeros(batch_size, np.int64)
+        self._paddle = np.zeros(batch_size, np.int64)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, 1, self.h, self.w))]
+
+    def reset(self):
+        self._reset_envs(np.ones(self.batch_size, bool))
+
+    def _reset_envs(self, mask):
+        n = int(mask.sum())
+        if n == 0:
+            return
+        self._ball_r[mask] = 0
+        self._ball_c[mask] = self._rs.randint(0, self.w, n)
+        self._paddle[mask] = self._rs.randint(0, self.w, n)
+
+    def data(self):
+        """Observation batch (B, 1, H, W) float32 with ball and paddle."""
+        obs = np.zeros((self.batch_size, 1, self.h, self.w), np.float32)
+        b = np.arange(self.batch_size)
+        obs[b, 0, self._ball_r, self._ball_c] = 1.0
+        obs[b, 0, self.h - 1, self._paddle] = 0.5
+        return obs
+
+    def act(self, actions):
+        """Advance every env one step.  Returns (reward, done) float arrays
+        of shape (B,); finished envs auto-reset (reference GymDataIter
+        resets on done inside act)."""
+        a = np.asarray(actions).reshape(-1)
+        self._paddle = np.clip(self._paddle + (a - 1), 0, self.w - 1)
+        self._ball_r += 1
+        done = self._ball_r >= self.h - 1
+        caught = done & (self._ball_c == self._paddle)
+        reward = np.where(done, np.where(caught, 1.0, -1.0), 0.0)
+        self._reset_envs(done)
+        return reward.astype(np.float32), done.astype(np.float32)

@@ -1,0 +1,22 @@
+"""A3C network (reference example/reinforcement-learning/a3c/sym.py
+get_symbol_atari): shared conv trunk, a policy head with out_grad=True
+(the policy gradient arrives as an explicit head gradient), an entropy
+head, and a value head."""
+import mxnet_tpu as mx
+
+
+def get_symbol_catch(act_dim):
+    net = mx.sym.Variable("data")
+    net = mx.sym.Convolution(net, name="conv1", kernel=(3, 3),
+                             stride=(1, 1), pad=(1, 1), num_filter=8)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, name="fc4", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu4", act_type="relu")
+    fc_policy = mx.sym.FullyConnected(net, name="fc_policy",
+                                      num_hidden=act_dim)
+    policy = mx.sym.SoftmaxOutput(fc_policy, name="policy", out_grad=True)
+    entropy = mx.sym.SoftmaxActivation(fc_policy, name="entropy")
+    value = mx.sym.FullyConnected(net, name="fc_value", num_hidden=1)
+    value = mx.sym.LinearRegressionOutput(value, name="value")
+    return mx.sym.Group([policy, entropy, value])

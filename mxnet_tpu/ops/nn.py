@@ -615,9 +615,9 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1,
 # regression_output-inl.h, make_loss-inl.h, svm_output-inl.h
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _softmax_output(data, label, grad_scale, ignore_label, use_ignore,
-                    multi_output, normalization):
+                    multi_output, normalization, out_grad):
     return _softmax_fwd_only(data, multi_output)
 
 
@@ -628,13 +628,13 @@ def _softmax_fwd_only(data, multi_output):
 
 
 def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
-                        multi_output, normalization):
+                        multi_output, normalization, out_grad):
     out = _softmax_fwd_only(data, multi_output)
     return out, (out, label)
 
 
 def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
-                        normalization, res, g):
+                        normalization, out_grad, res, g):
     out, label = res
     axis = 1 if (multi_output and out.ndim > 2) else out.ndim - 1
     if label.shape == out.shape:
@@ -657,6 +657,11 @@ def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
         grad = grad * scale / valid
     else:
         grad = grad * scale
+    if out_grad:
+        # reference softmax_output-inl.h:127-129,220-224: with out_grad=True
+        # the label-based gradient is modulated elementwise by the incoming
+        # head gradient (policy-gradient / custom-loss escape hatch)
+        grad = grad * g
     return grad, jnp.zeros_like(label)
 
 
@@ -694,7 +699,7 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     incoming head gradient — reference src/operator/softmax_output-inl.h."""
     return _softmax_output(data, label, float(grad_scale), float(ignore_label),
                            bool(use_ignore), bool(multi_output),
-                           str(normalization))
+                           str(normalization), bool(out_grad))
 
 
 @register("SoftmaxActivation")

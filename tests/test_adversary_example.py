@@ -1,0 +1,19 @@
+"""FGSM example smoke test: inputs_need_grad end-to-end — gradients w.r.t.
+input pixels through a trained net flip its predictions."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fgsm_drops_accuracy():
+    path = os.path.join(REPO, "example", "adversary",
+                        "adversary_generation.py")
+    spec = importlib.util.spec_from_file_location("fgsm_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["fgsm_t"] = mod
+    spec.loader.exec_module(mod)
+    clean, adv = mod.run(eps=0.4, num_epoch=3, seed=0)
+    assert clean > 0.9, clean
+    assert adv < clean - 0.5, (clean, adv)

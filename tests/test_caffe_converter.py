@@ -1,0 +1,169 @@
+"""Caffe prototxt -> Symbol converter (reference
+tools/caffe_converter/convert_symbol.py): the text-format parser and the
+layer mapping, checked by binding + running the converted nets."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools", "caffe_converter"))
+from convert_symbol import parse_prototxt, proto_to_symbol  # noqa: E402
+
+LENET = """
+name: "LeNet"
+input: "data"
+input_dim: 1  input_dim: 1  input_dim: 28  input_dim: 28
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 20 kernel_size: 5 stride: 1 } }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "relu1" type: "ReLU" bottom: "pool1" top: "pool1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 64 } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "drop" type: "Dropout" bottom: "ip1" top: "ip1"
+  dropout_param { dropout_ratio: 0.4 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label"
+  top: "loss" }
+"""
+
+RESBLOCK = """
+name: "resblock"
+layer { name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 2 dim: 3 dim: 16 dim: 16 } } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1 bias_term: false } }
+layer { name: "bn1" type: "BatchNorm" bottom: "conv1" top: "conv1" }
+layer { name: "scale1" type: "Scale" bottom: "conv1" top: "conv1" }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "conv2" type: "Convolution" bottom: "conv1" top: "conv2"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1 bias_term: false } }
+layer { name: "shortcut" type: "Convolution" bottom: "data" top: "shortcut"
+  convolution_param { num_output: 8 kernel_size: 1 } }
+layer { name: "sum" type: "Eltwise" bottom: "conv2" bottom: "shortcut"
+  top: "sum" eltwise_param { operation: SUM } }
+layer { name: "gpool" type: "Pooling" bottom: "sum" top: "gpool"
+  pooling_param { pool: AVE global_pooling: true } }
+layer { name: "fc" type: "InnerProduct" bottom: "gpool" top: "fc"
+  inner_product_param { num_output: 4 } }
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+def test_text_format_parser():
+    msg = parse_prototxt(LENET)
+    assert msg.one("name") == "LeNet"
+    assert msg.get("input_dim") == [1, 1, 28, 28]
+    layers = msg.get("layer")
+    assert [l.one("name") for l in layers][:3] == ["conv1", "pool1", "relu1"]
+    cp = layers[0].one("convolution_param")
+    assert cp.one("num_output") == 20 and cp.one("kernel_size") == 5
+    assert layers[1].one("pooling_param").one("pool") == "MAX"
+
+
+def test_lenet_converts_and_runs():
+    sym, input_name = proto_to_symbol(LENET)
+    assert input_name == "data"
+    ex = sym.simple_bind(mx.cpu(), data=(1, 1, 28, 28))
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.RandomState(0).randn(*arr.shape) * 0.05
+    ex.arg_dict["data"][:] = np.random.rand(1, 1, 28, 28)
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 10)
+    np.testing.assert_allclose(out.sum(), 1.0, rtol=1e-5)
+
+
+def test_resnet_block_with_bn_scale_eltwise():
+    sym, input_name = proto_to_symbol(RESBLOCK)
+    args = sym.list_arguments()
+    assert "bn1_gamma" in args and "bn1_beta" in args  # Scale folded
+    ex = sym.simple_bind(mx.cpu(), data=(2, 3, 16, 16))
+    rs = np.random.RandomState(1)
+    for name, arr in ex.arg_dict.items():
+        arr[:] = rs.randn(*arr.shape).astype("f") * 0.1
+    out = ex.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+
+def test_unsupported_layer_raises():
+    bad = 'layer { name: "x" type: "SPP" bottom: "data" top: "x" }'
+    with pytest.raises(ValueError, match="SPP"):
+        proto_to_symbol('input: "data"\n' + bad)
+
+
+def test_cli_writes_symbol_json(tmp_path):
+    import subprocess
+    p = tmp_path / "net.prototxt"
+    p.write_text(LENET)
+    outj = tmp_path / "net-symbol.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "caffe_converter", "convert_symbol.py"),
+         str(p), str(outj)], capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    loaded = mx.sym.load(str(outj))
+    assert "ip2_weight" in loaded.list_arguments()
+
+
+def test_pooling_hw_and_eltwise_coeff():
+    txt = """
+input: "data"
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+  pooling_param { pool: MAX kernel_h: 3 kernel_w: 2 stride: 1 } }
+layer { name: "a" type: "Convolution" bottom: "p" top: "a"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "b" type: "Convolution" bottom: "p" top: "b"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "diff" type: "Eltwise" bottom: "a" bottom: "b" top: "diff"
+  eltwise_param { operation: SUM coeff: 1 coeff: -1 } }
+"""
+    sym, _ = proto_to_symbol(txt)
+    ex = sym.simple_bind(mx.cpu(), data=(1, 2, 8, 8))
+    rs = np.random.RandomState(0)
+    for n, arr in ex.arg_dict.items():
+        arr[:] = rs.randn(*arr.shape).astype("f")
+    # identical conv weights -> a - b == 0 proves the -1 coeff applied
+    ex.arg_dict["b_weight"][:] = ex.arg_dict["a_weight"].asnumpy()
+    ex.arg_dict["b_bias"][:] = ex.arg_dict["a_bias"].asnumpy()
+    out = ex.forward(is_train=False)[0].asnumpy()
+    # kernel_h=3/kernel_w=2, stride 1, 'full' convention -> 6x7 spatial
+    assert out.shape == (1, 2, 6, 7)
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_standalone_scale_rejected():
+    txt = """
+input: "data"
+layer { name: "s" type: "Scale" bottom: "data" top: "s" }
+"""
+    with pytest.raises(ValueError, match="Scale"):
+        proto_to_symbol(txt)
+
+
+def test_multi_loss_heads_grouped():
+    txt = """
+input: "data"
+layer { name: "fc1" type: "InnerProduct" bottom: "data" top: "fc1"
+  inner_product_param { num_output: 4 } }
+layer { name: "loss1" type: "SoftmaxWithLoss" bottom: "fc1" top: "loss1" }
+layer { name: "fc2" type: "InnerProduct" bottom: "data" top: "fc2"
+  inner_product_param { num_output: 4 } }
+layer { name: "loss2" type: "SoftmaxWithLoss" bottom: "fc2" top: "loss2" }
+"""
+    sym, _ = proto_to_symbol(txt)
+    assert len(sym.list_outputs()) == 2
+
+
+def test_empty_prototxt_raises():
+    with pytest.raises(ValueError, match="no convertible layers"):
+        proto_to_symbol('input: "data"')

@@ -1,0 +1,297 @@
+"""Caffe prototxt -> Symbol converter (reference
+tools/caffe_converter/convert_symbol.py).
+
+Self-contained: parses the protobuf TEXT format directly (the reference
+compiles caffe.proto; the text grammar — `key: value` scalars and nested
+`block { ... }` messages with repeated keys — needs no schema), then maps
+the classic layer zoo onto mx.sym calls.  Covers the layers the Caffe
+model zoo's classification nets use: Input/data, Convolution,
+Pooling (incl. global), InnerProduct, ReLU, Dropout, LRN, Concat,
+Eltwise(SUM/MAX/PROD), BatchNorm(+folded Scale), Flatten,
+Softmax/SoftmaxWithLoss.
+
+Weight import (.caffemodel) is out of scope: the binary format needs the
+full caffe.proto schema; architecture import plus our reference-format
+.params loading covers the practical migration path.
+
+Usage:
+    python convert_symbol.py net.prototxt out-symbol.json
+or  sym, input_name = proto_to_symbol(open("net.prototxt").read())
+"""
+from __future__ import annotations
+
+import re
+import sys
+
+
+# ---------------------------------------------------------------------------
+# protobuf text-format parser (schema-free)
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"""
+    (?P<comment>\#[^\n]*)
+  | (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<colon>:)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>[-+]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][-+]?\d+)?)
+  | (?P<ws>\s+)
+""", re.X)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError("prototxt parse error at %r" % text[pos:pos + 20])
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        yield kind, m.group()
+
+
+class Message(dict):
+    """dict with repeated-field semantics: every value is a LIST."""
+
+    def add(self, key, value):
+        self.setdefault(key, []).append(value)
+
+    def one(self, key, default=None):
+        v = self.get(key)
+        return v[0] if v else default
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into a Message tree."""
+    root = Message()
+    stack = [root]
+    toks = _tokens(text)
+    pending = None
+    for kind, tok in toks:
+        if kind == "name":
+            if pending is not None:
+                # bare enum after a name without colon? treat prev as flag
+                raise ValueError("unexpected name %r after %r"
+                                 % (tok, pending))
+            pending = tok
+        elif kind == "colon":
+            if pending is None:
+                raise ValueError("stray ':'")
+            kind2, tok2 = next(toks)
+            if kind2 == "string":
+                val = tok2[1:-1].encode().decode("unicode_escape")
+            elif kind2 == "number":
+                val = float(tok2) if ("." in tok2 or "e" in tok2.lower()) \
+                    else int(tok2)
+            elif kind2 == "name":   # enum / bool literal
+                val = {"true": True, "false": False}.get(tok2, tok2)
+            else:
+                raise ValueError("bad value token %r" % tok2)
+            stack[-1].add(pending, val)
+            pending = None
+        elif kind == "brace" and tok == "{":
+            if pending is None:
+                raise ValueError("stray '{'")
+            child = Message()
+            stack[-1].add(pending, child)
+            stack.append(child)
+            pending = None
+        elif kind == "brace" and tok == "}":
+            stack.pop()
+            if not stack:
+                raise ValueError("unbalanced '}'")
+    if len(stack) != 1:
+        raise ValueError("unbalanced '{'")
+    return root
+
+
+# ---------------------------------------------------------------------------
+# layer mapping
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    v = int(v or 0)
+    return (v, v)
+
+
+def _conv_args(p):
+    args = {
+        "num_filter": int(p.one("num_output")),
+        "kernel": _pair(p.one("kernel_size", 1)),
+        "stride": _pair(p.one("stride", 1)),
+        "pad": _pair(p.one("pad", 0)),
+        "no_bias": not p.one("bias_term", True),
+    }
+    if p.one("kernel_h"):
+        args["kernel"] = (int(p.one("kernel_h")), int(p.one("kernel_w")))
+    if p.one("stride_h"):
+        args["stride"] = (int(p.one("stride_h")), int(p.one("stride_w")))
+    if p.one("pad_h") is not None and (p.one("pad_h") or p.one("pad_w")):
+        args["pad"] = (int(p.one("pad_h", 0)), int(p.one("pad_w", 0)))
+    d = p.one("dilation")
+    if d and int(d) > 1:
+        args["dilate"] = _pair(d)
+    g = p.one("group")
+    if g and int(g) > 1:
+        args["num_group"] = int(g)
+    return args
+
+
+def proto_to_symbol(text):
+    """Returns (output_symbol, input_name).  Caffe blob names become node
+    names; in-place layers (top == bottom) chain naturally."""
+    import mxnet_tpu as mx
+
+    proto = parse_prototxt(text)
+    layers = proto.get("layer") or proto.get("layers") or []
+
+    blobs = {}
+
+    # input declaration: `input:` field or an Input layer
+    input_name = proto.one("input")
+    if layers and layers[0].one("type") in ("Input", "Data", "DATA"):
+        lay0 = layers[0]
+        input_name = lay0.one("top", lay0.one("name"))
+        layers = layers[1:]
+    if input_name is None and layers:
+        input_name = layers[0].get("bottom", ["data"])[0]
+    input_name = input_name or "data"
+    blobs[input_name] = mx.sym.Variable(input_name)
+    prev_type = {}    # top blob -> producing layer type (Scale pairing)
+    loss_heads = []
+    out = None
+
+    for lay in layers:
+        ltype = lay.one("type")
+        name = lay.one("name")
+        bottoms = [blobs[b] for b in lay.get("bottom", []) if b in blobs]
+        tops = lay.get("top", [name])
+        # phase-gated layers (TEST-only accuracy etc.) and data layers skip
+        if ltype in ("Accuracy", "ACCURACY", "Silence"):
+            continue
+        if not bottoms:
+            continue
+        x = bottoms[0]
+        if ltype in ("Convolution", "CONVOLUTION"):
+            out = mx.sym.Convolution(
+                x, name=name, **_conv_args(lay.one("convolution_param",
+                                                   Message())))
+        elif ltype in ("InnerProduct", "INNER_PRODUCT"):
+            p = lay.one("inner_product_param", Message())
+            out = mx.sym.FullyConnected(
+                mx.sym.Flatten(x), name=name,
+                num_hidden=int(p.one("num_output")),
+                no_bias=not p.one("bias_term", True))
+        elif ltype in ("Pooling", "POOLING"):
+            p = lay.one("pooling_param", Message())
+            pool = {0: "max", "MAX": "max", 1: "avg", "AVE": "avg"}.get(
+                p.one("pool", "MAX"), "max")
+            if p.one("global_pooling", False):
+                out = mx.sym.Pooling(x, name=name, kernel=(1, 1),
+                                     global_pool=True, pool_type=pool)
+            else:
+                kernel = _pair(p.one("kernel_size", 1))
+                stride = _pair(p.one("stride", 1))
+                pad = _pair(p.one("pad", 0))
+                if p.one("kernel_h"):
+                    kernel = (int(p.one("kernel_h")), int(p.one("kernel_w")))
+                if p.one("stride_h"):
+                    stride = (int(p.one("stride_h")), int(p.one("stride_w")))
+                if p.one("pad_h") or p.one("pad_w"):
+                    pad = (int(p.one("pad_h", 0)), int(p.one("pad_w", 0)))
+                out = mx.sym.Pooling(
+                    x, name=name, pool_type=pool, kernel=kernel,
+                    stride=stride, pad=pad,
+                    pooling_convention="full")   # caffe ceil semantics
+        elif ltype in ("ReLU", "RELU"):
+            out = mx.sym.Activation(x, name=name, act_type="relu")
+        elif ltype in ("Sigmoid", "SIGMOID"):
+            out = mx.sym.Activation(x, name=name, act_type="sigmoid")
+        elif ltype in ("TanH", "TANH"):
+            out = mx.sym.Activation(x, name=name, act_type="tanh")
+        elif ltype in ("Dropout", "DROPOUT"):
+            p = lay.one("dropout_param", Message())
+            out = mx.sym.Dropout(x, name=name,
+                                 p=float(p.one("dropout_ratio", 0.5)))
+        elif ltype in ("LRN", "LRN_V1"):
+            p = lay.one("lrn_param", Message())
+            out = mx.sym.LRN(x, name=name,
+                             nsize=int(p.one("local_size", 5)),
+                             alpha=float(p.one("alpha", 1e-4)),
+                             beta=float(p.one("beta", 0.75)))
+        elif ltype in ("Concat", "CONCAT"):
+            out = mx.sym.Concat(*bottoms, name=name)
+        elif ltype in ("Eltwise", "ELTWISE"):
+            p = lay.one("eltwise_param", Message())
+            op = p.one("operation", "SUM")
+            coeff = [float(c) for c in p.get("coeff", [])]
+            if op in ("SUM", 1):
+                if coeff and len(coeff) != len(bottoms):
+                    raise ValueError(
+                        "Eltwise %r: %d coeffs for %d bottoms"
+                        % (name, len(coeff), len(bottoms)))
+                terms = [b if not coeff or coeff[i] == 1.0 else b * coeff[i]
+                         for i, b in enumerate(bottoms)]
+                out = terms[0]
+                for b in terms[1:]:
+                    out = out + b
+            elif op in ("MAX", 2):
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = mx.sym.maximum(out, b)
+            else:
+                out = bottoms[0]
+                for b in bottoms[1:]:
+                    out = out * b
+        elif ltype in ("BatchNorm", "BATCHNORM"):
+            # caffe always pairs BatchNorm with a following Scale layer for
+            # the learnable affine; our BatchNorm carries gamma/beta itself
+            # (fix_gamma=False), so the Scale folds into this node
+            p = lay.one("batch_norm_param", Message())
+            out = mx.sym.BatchNorm(
+                x, name=name, fix_gamma=False,
+                eps=float(p.one("eps", 1e-5)))
+        elif ltype in ("Scale", "SCALE"):
+            if prev_type.get(lay.get("bottom", [None])[0]) not in (
+                    "BatchNorm", "BATCHNORM"):
+                raise ValueError(
+                    "standalone Scale layer %r is unsupported (only the "
+                    "canonical BatchNorm+Scale pair folds into "
+                    "BatchNorm gamma/beta)" % (name,))
+            out = x   # folded into the preceding BatchNorm's gamma/beta
+        elif ltype in ("Flatten", "FLATTEN"):
+            out = mx.sym.Flatten(x, name=name)
+        elif ltype in ("Softmax", "SOFTMAX", "SoftmaxWithLoss",
+                       "SOFTMAX_LOSS"):
+            out = mx.sym.SoftmaxOutput(x, name=name or "softmax")
+            loss_heads.append(out)
+        else:
+            raise ValueError("unsupported caffe layer type %r (layer %r)"
+                             % (ltype, name))
+        for t in tops:
+            blobs[t] = out
+            prev_type[t] = ltype
+
+    if out is None:
+        raise ValueError("prototxt contains no convertible layers")
+    if len(loss_heads) > 1:
+        # multi-loss nets (GoogLeNet train_val aux heads) keep every head
+        out = mx.sym.Group(loss_heads)
+    return out, input_name
+
+
+def main():
+    if len(sys.argv) != 3:
+        print("usage: convert_symbol.py net.prototxt out-symbol.json")
+        return 1
+    with open(sys.argv[1]) as f:
+        sym, input_name = proto_to_symbol(f.read())
+    sym.save(sys.argv[2])
+    print("input blob: %s -> wrote %s" % (input_name, sys.argv[2]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
