@@ -1,0 +1,42 @@
+"""Notebook utilities (reference python/mxnet/notebook/callback.py):
+PandasLogger dataframes fill during fit; LiveLearningCurve renders."""
+import matplotlib
+matplotlib.use("Agg")
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.notebook.callback import LiveLearningCurve, PandasLogger
+
+
+def _fit(callback_args, epochs=2):
+    X = np.random.RandomState(0).randn(256, 16).astype("f")
+    y = (X.sum(1) > 0).astype("f")
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    val = mx.io.NDArrayIter(X[:64], y[:64], batch_size=32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, eval_data=val, num_epoch=epochs, optimizer="sgd",
+            initializer=mx.initializer.Xavier(), **callback_args)
+
+
+def test_pandas_logger_collects_all_frames():
+    logger = PandasLogger(batch_size=32, frequent=2)
+    _fit(logger.callback_args())
+    assert len(logger.train_df) > 0
+    assert "accuracy" in logger.train_df.columns
+    assert "records_per_sec" in logger.train_df.columns
+    assert len(logger.eval_df) >= 2          # one row per epoch
+    assert len(logger.epoch_df) == 2
+    assert logger.eval_df["accuracy"].iloc[-1] <= 1.0
+
+
+def test_live_learning_curve_saves_png(tmp_path):
+    logger = PandasLogger(batch_size=32, frequent=2)
+    curve = LiveLearningCurve(logger, "accuracy", display_freq=10**9)
+    _fit(curve.callback_args())
+    out = tmp_path / "curve.png"
+    curve.savefig(str(out))
+    assert out.stat().st_size > 1000
