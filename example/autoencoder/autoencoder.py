@@ -1,0 +1,164 @@
+"""Stacked (denoising) autoencoder with layer-wise pretraining + joint
+fine-tuning (reference example/autoencoder/autoencoder.py
+AutoEncoderModel, rebuilt on the Module API).
+
+Exercises the unsupervised path: LinearRegressionOutput against
+continuous targets, per-stack parameter transfer via
+get_params/set_params(allow_extra), and data==label iterators.
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def _encoder_sym(dims, act="relu"):
+    """data -> fc_enc_i (+act except last)."""
+    net = mx.sym.Variable("data")
+    for i in range(1, len(dims)):
+        net = mx.sym.FullyConnected(net, num_hidden=dims[i],
+                                    name="enc_%d" % i)
+        if i < len(dims) - 1:
+            net = mx.sym.Activation(net, act_type=act)
+    return net
+
+
+def _decoder_sym(net, dims, act="relu"):
+    for i in reversed(range(1, len(dims))):
+        net = mx.sym.FullyConnected(net, num_hidden=dims[i - 1],
+                                    name="dec_%d" % i)
+        if i > 1:
+            net = mx.sym.Activation(net, act_type=act)
+    return net
+
+
+class AutoEncoderModel(object):
+    def __init__(self, dims, ctx=None, pt_dropout=0.2, seed=0):
+        self.dims = list(dims)
+        self.ctx = ctx or mx.current_context()
+        self.pt_dropout = pt_dropout
+        self.arg_params = {}
+        mx.random.seed(seed)
+
+    def _ae_sym(self, n_in_idx, corrupt):
+        """One-stack denoising autoencoder symbol (train stack i)."""
+        data = mx.sym.Variable("data")
+        net = data
+        if corrupt > 0:
+            net = mx.sym.Dropout(net, p=corrupt)
+        net = mx.sym.FullyConnected(net, num_hidden=self.dims[n_in_idx + 1],
+                                    name="enc_%d" % (n_in_idx + 1))
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=self.dims[n_in_idx],
+                                    name="dec_%d" % (n_in_idx + 1))
+        return mx.sym.LinearRegressionOutput(net, name="rec")
+
+    def _full_sym(self):
+        net = _encoder_sym(self.dims)
+        net = _decoder_sym(net, self.dims)
+        return mx.sym.LinearRegressionOutput(net, name="rec")
+
+    def _fit(self, sym, X, Y, epochs, lr, transfer=True):
+        it = mx.io.NDArrayIter(X, Y, batch_size=128, shuffle=True,
+                               label_name="rec_label")
+        mod = mx.mod.Module(sym, label_names=("rec_label",),
+                            context=self.ctx)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        if transfer and self.arg_params:
+            cur_args, _ = mod.get_params()
+            merged = dict(cur_args)
+            merged.update({k: v for k, v in self.arg_params.items()
+                           if k in cur_args})
+            mod.set_params(merged, {})
+        mod.init_optimizer(optimizer="adam",
+                           optimizer_params={"learning_rate": lr})
+        metric = mx.metric.MSE()
+        for _ in range(epochs):
+            it.reset()
+            metric.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+                mod.update_metric(metric, batch.label)
+        args, _ = mod.get_params()
+        self.arg_params.update(args)
+        return metric.get()[1]
+
+    def layerwise_pretrain(self, X, epochs=8, lr=1e-3):
+        feats = X
+        for i in range(len(self.dims) - 1):
+            sym = self._ae_sym(i, self.pt_dropout)
+            mse = self._fit(sym, feats, feats, epochs, lr)
+            logging.info("pretrain stack %d mse %.5f", i + 1, mse)
+            # encode THIS stack's features for the next one
+            data = mx.sym.Variable("data")
+            enc = mx.sym.FullyConnected(data, num_hidden=self.dims[i + 1],
+                                        name="enc_%d" % (i + 1))
+            enc = mx.sym.Activation(enc, act_type="relu")
+            mod = mx.mod.Module(enc, label_names=(), context=self.ctx)
+            it = mx.io.NDArrayIter(feats, batch_size=128)
+            mod.bind(data_shapes=it.provide_data, for_training=False)
+            enc_args = {k: v for k, v in self.arg_params.items()
+                        if k.startswith("enc_%d" % (i + 1))}
+            mod.set_params(enc_args, {})
+            n = len(feats)
+            feats = mod.predict(it).asnumpy()[:n]
+        return feats
+
+    def finetune(self, X, epochs=15, lr=1e-3):
+        mse = self._fit(self._full_sym(), X, X, epochs, lr)
+        logging.info("finetune mse %.5f", mse)
+        return mse
+
+    def reconstruction_error(self, X):
+        sym = self._full_sym()
+        it = mx.io.NDArrayIter(X, X, batch_size=128,
+                               label_name="rec_label")
+        mod = mx.mod.Module(sym, label_names=("rec_label",),
+                            context=self.ctx)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label, for_training=False)
+        mod.init_params(initializer=mx.initializer.Xavier())
+        if self.arg_params:
+            cur, _ = mod.get_params()
+            cur.update({k: v for k, v in self.arg_params.items()
+                        if k in cur})
+            mod.set_params(cur, {})
+        errs = []
+        for batch in it:
+            mod.forward(batch, is_train=False)
+            rec = mod.get_outputs()[0].asnumpy()
+            k = 128 - batch.pad
+            errs.append(((rec[:k] - batch.data[0].asnumpy()[:k]) ** 2)
+                        .mean())
+        return float(np.mean(errs))
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    # low-rank structured data: 8 latent factors in 64-d observations
+    Z = rs.randn(4096, 8).astype("f")
+    W = rs.randn(8, 64).astype("f")
+    X = np.tanh(Z @ W) + rs.randn(4096, 64).astype("f") * 0.05
+    model = AutoEncoderModel([64, 32, 8])
+    base = model.reconstruction_error(X)   # random weights
+    model.layerwise_pretrain(X)
+    after_pt = model.reconstruction_error(X)
+    model.finetune(X)
+    final = model.reconstruction_error(X)
+    print("reconstruction mse: random %.4f -> pretrained %.4f -> "
+          "finetuned %.4f" % (base, after_pt, final))
+    return base, after_pt, final
+
+
+if __name__ == "__main__":
+    main()

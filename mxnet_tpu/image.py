@@ -80,7 +80,7 @@ __all__ = [
     "random_crop", "center_crop", "color_normalize", "random_size_crop",
     "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
     "RandomOrderAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
-    "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+    "HorizontalFlipAug", "CastAug", "PadAug", "CreateAugmenter", "ImageIter",
     "ImageRecordIter", "ImageRecordUInt8Iter",
 ]
 
@@ -283,13 +283,34 @@ def CastAug():
     return aug
 
 
+class PadAug(object):
+    """Pad every border by ``pad`` pixels with ``fill_value`` before
+    cropping — the reference C++ augmenter's ``pad`` param
+    (image_aug_default.cc; the CIFAR recipe is pad=4 + rand_crop 32)."""
+
+    def __init__(self, pad, fill_value=0):
+        self.pad = int(pad)
+        self.fill = fill_value
+
+    def __call__(self, src, rs=None):
+        import cv2
+        p = self.pad
+        out = cv2.copyMakeBorder(src, p, p, p, p, cv2.BORDER_CONSTANT,
+                                 value=[self.fill] * 3)
+        return [out]
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2,
+                    pad=0, fill_value=0):
     """Create the standard augmenter list (reference image.py:CreateAugmenter)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
+
+    if pad > 0:
+        auglist.append(PadAug(pad, fill_value))
 
     crop_size = (data_shape[2], data_shape[1])
     if rand_resize:
@@ -1101,8 +1122,8 @@ def _translate_cxx_aug_params(kwargs):
     for name in ("max_rotate_angle", "max_random_rotate_angle",
                  "max_aspect_ratio", "max_random_aspect_ratio",
                  "max_shear_ratio", "max_random_shear_ratio",
-                 "max_random_h", "max_random_s", "max_random_l", "pad",
-                 "fill_value", "inter_method", "max_img_size",
+                 "max_random_h", "max_random_s", "max_random_l",
+                 "inter_method", "max_img_size",
                  "min_img_size", "mirror", "rand_gray", "scale", "max_crop_size",
                  "min_crop_size", "random_h", "random_s", "random_l",
                  "rotate", "verbose"):

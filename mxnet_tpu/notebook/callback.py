@@ -139,13 +139,15 @@ class LiveLearningCurve(object):
             self._setup()
         ax = self._ax
         ax.clear()
+        # common x-axis: elapsed wall-clock — train rows (every `frequent`
+        # batches) and eval rows (once per epoch) land on one timeline
         for df_name, style in (("train", "-"), ("eval", "--")):
             df = self.pandas_logger.all_dataframes[df_name]
             if self.metric_name in getattr(df, "columns", []):
-                ax.plot(df.index.values,
-                        df[self.metric_name].astype(float).values,
+                xs = [td.total_seconds() for td in df["elapsed"]]
+                ax.plot(xs, df[self.metric_name].astype(float).values,
                         style, label=df_name)
-        ax.set_xlabel("samples (x frequent batches)")
+        ax.set_xlabel("elapsed (s)")
         ax.set_ylabel(self.metric_name)
         ax.legend(loc="best")
         ax.grid(True, alpha=0.3)

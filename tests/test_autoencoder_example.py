@@ -1,0 +1,20 @@
+"""Stacked-autoencoder example smoke test: layer-wise pretraining +
+fine-tuning drive reconstruction error down (unsupervised
+LinearRegressionOutput path, parameter transfer across Modules)."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_autoencoder_reduces_reconstruction_error():
+    path = os.path.join(REPO, "example", "autoencoder", "autoencoder.py")
+    spec = importlib.util.spec_from_file_location("sae_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["sae_t"] = mod
+    spec.loader.exec_module(mod)
+    base, after_pt, final = mod.main()
+    assert after_pt < base * 0.75, (base, after_pt)
+    assert final < after_pt * 0.5, (after_pt, final)
+    assert final < 0.15

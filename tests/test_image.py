@@ -534,3 +534,27 @@ def test_native_pipeline_host_batches(rec_dataset):
         image.ImageRecordIter(
             path_imgrec=path, path_imgidx=idx, data_shape=(3, 24, 24),
             batch_size=4, host_batches=True, brightness=0.3, seed=3)
+
+
+def test_pad_crop_augmentation(rec_dataset):
+    """pad=N + rand_crop (the reference CIFAR recipe, C++ augmenter
+    'pad' param): borders padded before the crop, so crops can include
+    fill pixels; the native pipeline declines and the cv2 path serves."""
+    path, idx = rec_dataset
+    it = image.ImageRecordIter(
+        path_imgrec=path, path_imgidx=idx, data_shape=(3, 60, 80),
+        batch_size=4, pad=6, fill_value=0, rand_crop=True, seed=3)
+    assert not isinstance(it._pipeline, image._NativePipeline)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 60, 80)
+    it.close()
+    # deterministic geometry check: pad then center crop of the padded
+    # size returns the padded image, whose border is the fill value
+    augs = image.CreateAugmenter((3, 72, 92), pad=6, fill_value=7)
+    img = _gradient_img()           # 60x80
+    out = img
+    for a in augs:
+        out = a(out)[0]
+    assert out.shape == (72, 92, 3)
+    assert (out[0] == 7).all() and (out[-1] == 7).all()
+    assert (out[:, 0] == 7).all() and (out[:, -1] == 7).all()

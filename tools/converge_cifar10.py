@@ -59,14 +59,17 @@ def main():
     sym = net_mod.get_symbol(num_classes=10, num_layers=20,
                              image_shape="3,32,32")
 
-    tmp = "/tmp/converge_cifar"
+    # cache keyed on the dataset sizes, and only valid when complete
+    tmp = "/tmp/converge_cifar_%d_%d" % (args.num_train, args.num_val)
     os.makedirs(tmp, exist_ok=True)
     Xtr, ytr = synthetic_cifar(args.num_train, seed=0)
     Xv, yv = synthetic_cifar(args.num_val, seed=1)
     t_pack = time.time()
-    if not os.path.exists(os.path.join(tmp, "train.rec")):
+    done_mark = os.path.join(tmp, "PACKED")
+    if not os.path.exists(done_mark):
         pack_rec(Xtr, ytr, os.path.join(tmp, "train"))
         pack_rec(Xv, yv, os.path.join(tmp, "val"))
+        open(done_mark, "w").write("ok")
     t_pack = time.time() - t_pack
 
     import jax

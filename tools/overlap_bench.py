@@ -104,9 +104,11 @@ def main():
                          out_specs=P(), check_vma=False)(p)
 
     def timeit(fn, *a):
+        out = None
         for _ in range(args.warmup):
             out = fn(*a)
-        jax.block_until_ready(out)
+        if out is not None:
+            jax.block_until_ready(out)
         best = float("inf")
         for _ in range(3):
             tic = time.time()

@@ -64,15 +64,17 @@ def layer_param_bytes(dtype_bytes=2):
 
 
 def simulate(profile_path, n_devices, ici_gbps, hops_factor=1.0,
-             time_scale=1.0):
+             time_scale=1.0, _cache={}):
     """Bucketed-allreduce timeline simulation.  ``time_scale`` calibrates
     the profiled per-layer times to unprofiled wall-clock: profiling on
     this backend inflates device durations ~5x (profiled step 13.9 ms vs
     2.4-2.9 ms wall, measured 2026-07-30), so the per-layer DISTRIBUTION
     comes from the profile and the absolute scale from the wall clock."""
-    fwd, bwd = parse_profile(profile_path)
+    if profile_path not in _cache:
+        _cache[profile_path] = (parse_profile(profile_path),
+                                layer_param_bytes())
+    (fwd, bwd), pbytes = _cache[profile_path]
     bwd = {k: v * time_scale for k, v in bwd.items()}
-    pbytes = layer_param_bytes()
     # backward completion order: output-side layers first.  The profile
     # doesn't carry start timestamps, so order backward rows by reversed
     # forward topological position — approximate topo order = the order

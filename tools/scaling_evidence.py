@@ -107,6 +107,43 @@ def collective_bandwidth():
             "cross_process_gloo": _json_lines(dist.stdout)}
 
 
+def measured_overlap_model():
+    """tools/overlap_model.py at the four (wall-clock x ICI-credit)
+    corners: allreduce laid onto the MEASURED per-layer backward timeline
+    from the committed on-chip ResNet-50 profile (round 3's assumed
+    1.6 ms window replaced; see docs/scaling_model.md for what is
+    measured vs structural vs calibrated)."""
+    corners = {}
+    for wall in ("2.4", "2.9"):
+        for bw in ("45", "90"):
+            res = _run([PY, os.path.join("tools", "overlap_model.py")],
+                       env_extra={"OVERLAP_WALL_STEP_MS": wall,
+                                  "OVERLAP_ICI_GBPS": bw})
+            try:
+                # overlap_model prints ONE pretty-printed JSON object
+                corners["wall%s_bw%s" % (wall, bw)] = json.loads(res.stdout)
+            except ValueError:
+                corners["wall%s_bw%s" % (wall, bw)] = {
+                    "error": (res.stderr or res.stdout)[-400:]}
+    return corners
+
+
+def allreduce_ablation(nproc=8):
+    """tools/overlap_bench.py on the real multi-process cluster:
+    step-with-psum vs psum-ablated vs psum-solo over ResNet-50-sized
+    bf16 gradients.  On the CPU backend this is the honest no-overlap
+    lower bound (gloo collectives are not hidden there); the TPU
+    projection carries the measured-timeline model above."""
+    res = _run([PY, os.path.join("tools", "launch.py"), "-n", str(nproc),
+                "--platform", "cpu", PY,
+                os.path.join("tools", "overlap_bench.py"),
+                "--steps", "6", "--warmup", "2"], timeout=1800)
+    for line in res.stdout.splitlines():
+        if "OVERLAP_BENCH" in line:
+            return json.loads(line.split("OVERLAP_BENCH ", 1)[1])
+    return {"error": (res.stderr or res.stdout)[-400:]}
+
+
 def analytic_model(measured_step_ms=2.4):
     params_m = 25.56e6
     v_bf16 = params_m * 2
@@ -132,20 +169,23 @@ def analytic_model(measured_step_ms=2.4):
                 measured_step_ms / (measured_step_ms + t_comm), 3),
         }
     out["conclusion"] = (
-        "bf16 gradient allreduce fits inside the backward-pass overlap "
-        "window at both N=8 and N=64 -> projected efficiency >=95%; see "
-        "docs/scaling_model.md for the worst-case (no-overlap, f32) "
-        "analysis and remedies")
+        "legacy round-3 closed-form model kept for comparison; the "
+        "round-4 projection lives in measured_overlap_model (per-layer "
+        "backward timeline from the on-chip profile) with "
+        "allreduce_ablation as the CPU no-overlap lower bound — see "
+        "docs/scaling_model.md")
     return out
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("-o", "--output", default="SCALING_r03.json")
+    ap.add_argument("-o", "--output", default="SCALING_r04.json")
     ap.add_argument("--skip-virtual", action="store_true")
     args = ap.parse_args()
     art = {"doc": "see docs/scaling_model.md",
-           "analytic_model": analytic_model()}
+           "measured_overlap_model": measured_overlap_model(),
+           "allreduce_ablation_cpu8": allreduce_ablation(),
+           "legacy_analytic_model": analytic_model()}
     if not args.skip_virtual:
         art["virtual_mesh_weak_scaling"] = virtual_mesh_weak_scaling()
     art["multiproc_weak_scaling"] = multiproc_weak_scaling()
