@@ -19,13 +19,14 @@ import mxnet_tpu as mx
 
 
 def _encoder_sym(dims, act="relu"):
-    """data -> fc_enc_i (+act except last)."""
+    """data -> relu(fc_enc_i) for every stack — matching _ae_sym, which
+    pretrains each stack with relu codes; a linear bottleneck here would
+    evaluate transferred weights on inputs they never saw."""
     net = mx.sym.Variable("data")
     for i in range(1, len(dims)):
         net = mx.sym.FullyConnected(net, num_hidden=dims[i],
                                     name="enc_%d" % i)
-        if i < len(dims) - 1:
-            net = mx.sym.Activation(net, act_type=act)
+        net = mx.sym.Activation(net, act_type=act)
     return net
 
 

@@ -53,20 +53,37 @@ def main():
     ap.add_argument("--out", type=str, default="CONVERGE_r04.json")
     args = ap.parse_args()
 
-    from train_cifar10 import synthetic_cifar
+    def synthetic_cifar(num, num_classes=10, seed=0):
+        """Harder variant of the example's synthetic set: weaker class
+        signal + per-image geometric jitter, so resnet-20 needs several
+        epochs to reach 90% — a convergence CURVE, not a one-shot fit."""
+        templates = np.random.RandomState(42).rand(num_classes, 3, 32, 32)
+        rs = np.random.RandomState(seed)
+        labels = rs.randint(0, num_classes, size=num).astype("f")
+        images = templates[labels.astype(int)] * 90
+        images += rs.randn(num, 3, 32, 32) * 40
+        # random roll = translation jitter (defeats pure pixel matching)
+        for i in range(num):
+            images[i] = np.roll(images[i],
+                                (rs.randint(-2, 3), rs.randint(-2, 3)),
+                                axis=(1, 2))
+        return (np.clip(images, 0, 255).astype(np.float32) / 255,
+                labels)
+
     from importlib import import_module
     net_mod = import_module("symbols.resnet")
     sym = net_mod.get_symbol(num_classes=10, num_layers=20,
                              image_shape="3,32,32")
 
     # cache keyed on the dataset sizes, and only valid when complete
-    tmp = "/tmp/converge_cifar_%d_%d" % (args.num_train, args.num_val)
+    # v3: hardened dataset recipe (key must change when the recipe does)
+    tmp = "/tmp/converge_cifar_v3_%d_%d" % (args.num_train, args.num_val)
     os.makedirs(tmp, exist_ok=True)
-    Xtr, ytr = synthetic_cifar(args.num_train, seed=0)
-    Xv, yv = synthetic_cifar(args.num_val, seed=1)
     t_pack = time.time()
     done_mark = os.path.join(tmp, "PACKED")
     if not os.path.exists(done_mark):
+        Xtr, ytr = synthetic_cifar(args.num_train, seed=0)
+        Xv, yv = synthetic_cifar(args.num_val, seed=1)
         pack_rec(Xtr, ytr, os.path.join(tmp, "train"))
         pack_rec(Xv, yv, os.path.join(tmp, "val"))
         open(done_mark, "w").write("ok")
@@ -138,7 +155,8 @@ def main():
                     "(no egress), full RecordIO->native-decode->bf16 "
                     "fused-step path on the real chip" % (args.lr,
                                                           args.batch_size),
-        "platform": "axon TPU v5e (1 chip), tunneled link",
+        "platform": "%s (%s)" % (jax.default_backend(),
+                                 jax.devices()[0].device_kind),
         "compute_dtype": "bfloat16",
         "num_train": args.num_train,
         "num_val": args.num_val,
