@@ -1,0 +1,61 @@
+"""MLP with an SVM (hinge) loss head instead of softmax (reference
+example/svm_mnist/svm_mnist.py).  Exercises SVMOutput's margin/
+regularization semantics end-to-end; data is the synthetic MNIST-like
+fallback (no egress)."""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_digits(n, seed=0):
+    """Linear-ish 10-class toy digits: class template + noise, 28x28."""
+    rs0 = np.random.RandomState(99)
+    templates = rs0.rand(10, 784).astype("f")
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    X = templates[y] * 0.8 + rs.rand(n, 784).astype("f") * 0.6
+    return X.astype("f"), y.astype("f")
+
+
+def get_symbol(use_linear=False):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    # use_linear=True is L1-SVM (hinge), else L2-SVM (squared hinge) —
+    # the reference flags it the same way
+    return mx.sym.SVMOutput(net, name="svm", use_linear=use_linear)
+
+
+def train(num_epoch=6, batch_size=128, lr=0.01, use_linear=False, seed=7):
+    mx.random.seed(seed)
+    X, y = make_digits(6000, seed=0)
+    Xv, yv = make_digits(1000, seed=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True,
+                           label_name="svm_label")
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size,
+                            label_name="svm_label")
+    mod = mx.mod.Module(get_symbol(use_linear), label_names=("svm_label",))
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_data=val, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    metric.reset()
+    mod.score(val, metric)
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    for use_linear in (False, True):
+        acc = train(use_linear=use_linear)
+        print("SVM (%s) val accuracy: %.4f"
+              % ("L1/hinge" if use_linear else "L2/squared-hinge", acc))

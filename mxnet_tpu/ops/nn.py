@@ -748,17 +748,21 @@ def _svm_fwd(data, label, margin, regularization_coefficient, use_linear):
 
 
 def _svm_bwd(margin, reg, use_linear, res, g):
+    """One-vs-all hinge exactly as the reference kernels (svm_output.cc
+    L1_SVM/L2_SVM): the true class's score is pushed above +margin, every
+    other class's score below -margin; incoming head gradient is ignored
+    (loss-layer convention)."""
     data, label = res
     idx = label.astype(jnp.int32)
     onehot = jax.nn.one_hot(idx, data.shape[1], dtype=data.dtype)
-    dist = data - jnp.take_along_axis(data, idx[:, None], axis=1) + margin
     if use_linear:
-        grad = jnp.where(dist > 0, jnp.ones_like(data), 0.0) * reg
+        g_true = jnp.where(data < margin, -reg, 0.0)
+        g_other = jnp.where(data > -margin, reg, 0.0)
     else:
-        grad = jnp.where(dist > 0, 2.0 * dist, 0.0) * reg
-    grad = grad * (1 - onehot) - onehot * jnp.sum(grad * (1 - onehot), axis=1,
-                                                  keepdims=True)
-    return grad, jnp.zeros_like(label)
+        g_true = jnp.where(data < margin, -2.0 * reg * (margin - data), 0.0)
+        g_other = jnp.where(data > -margin, 2.0 * reg * (margin + data), 0.0)
+    grad = onehot * g_true + (1 - onehot) * g_other
+    return grad.astype(data.dtype), jnp.zeros_like(label)
 
 
 _svm_output.defvjp(_svm_fwd, _svm_bwd)

@@ -1,0 +1,17 @@
+"""bi-lstm-sort smoke test: a BidirectionalCell learns to sort token
+sequences (needs context from both directions)."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bilstm_sorts():
+    path = os.path.join(REPO, "example", "bi-lstm-sort", "lstm_sort.py")
+    spec = importlib.util.spec_from_file_location("sort_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["sort_t"] = mod
+    spec.loader.exec_module(mod)
+    acc = mod.train(num_epoch=10)
+    assert acc > 0.8, acc   # chance is ~1/19 per token

@@ -489,7 +489,9 @@ def test_softmax_cross_entropy():
 def test_svm_output_grad():
     data = mx.sym.Variable("data")
     label = mx.sym.Variable("label")
-    a = _u((2, 3), seed=40)
+    # span both sides of the +-1 margin so the zero-gradient clamp
+    # branches are exercised, not just the linear region
+    a = _u((2, 3), seed=40) * 2.5
     lab = np.asarray([0, 2], "f")
     sym = mx.sym.SVMOutput(data, label, margin=1.0,
                            regularization_coefficient=1.0)
@@ -497,6 +499,28 @@ def test_svm_output_grad():
     grads = check_symbolic_backward(sym, {"data": a, "label": lab},
                                     [np.ones_like(a)], {})
     assert np.isfinite(grads["data"]).all()
+    # exact one-vs-all L2 hinge values (reference svm_output.cc L2_SVM):
+    # true class k: -2*reg*(margin - s_k) while s_k < margin;
+    # others:       +2*reg*(margin + s_x) while s_x > -margin
+    margin, reg = 1.0, 1.0
+    want = np.empty_like(a)
+    for y in range(a.shape[0]):
+        k = int(lab[y])
+        for x in range(a.shape[1]):
+            if x == k:
+                want[y, x] = -2 * reg * (margin - a[y, x])                     if a[y, x] < margin else 0.0
+            else:
+                want[y, x] = 2 * reg * (margin + a[y, x])                     if a[y, x] > -margin else 0.0
+    np.testing.assert_allclose(grads["data"], want, rtol=1e-5)
+    # L1 variant: constant +-reg inside the margin
+    sym = mx.sym.SVMOutput(data, label, margin=1.0,
+                           regularization_coefficient=0.5, use_linear=True)
+    grads = check_symbolic_backward(sym, {"data": a, "label": lab},
+                                    [np.ones_like(a)], {})
+    want = np.where(np.arange(3)[None, :] == lab[:, None],
+                    np.where(a < 1.0, -0.5, 0.0),
+                    np.where(a > -1.0, 0.5, 0.0))
+    np.testing.assert_allclose(grads["data"], want, rtol=1e-5)
 
 
 # ---------------------------------------------------------------------------

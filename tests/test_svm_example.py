@@ -1,0 +1,23 @@
+"""svm_mnist smoke test: both SVMOutput variants train to high accuracy."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    path = os.path.join(REPO, "example", "svm_mnist", "svm_mnist.py")
+    spec = importlib.util.spec_from_file_location("svm_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["svm_t"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_svm_l2_trains():
+    assert _load().train(use_linear=False) > 0.9
+
+
+def test_svm_l1_trains():
+    assert _load().train(use_linear=True) > 0.9

@@ -3,9 +3,11 @@
 The reference's convergence tier trains cifar10 to a fixed accuracy
 (tests/python/train/test_dtype.py; example train_cifar10.py recipe:
 resnet-20, batch 128, sgd momentum 0.9, wd 1e-4, lr 0.05).  This harness
-has no network egress, so the dataset is the example's deterministic
-synthetic CIFAR stand-in (template classes + heavy noise,
-example/image-classification/train_cifar10.py:synthetic_cifar), packed
+has no network egress, so the dataset is a deterministic synthetic
+CIFAR stand-in: class templates + heavy noise + translation jitter (a
+hardened variant of example/image-classification/train_cifar10.py's
+synthetic_cifar — weaker signal so resnet-20 needs several epochs,
+giving a convergence CURVE; the generator is local, below), packed
 into RecordIO so the full production feed path runs: native libjpeg
 decode -> uint8 NHWC batches -> on-device normalize folded into the
 fused bf16 train step.
