@@ -1,0 +1,71 @@
+"""Stochastic Gradient Langevin Dynamics posterior sampling (reference
+example/bayesian-methods: SGLD from Welling & Teh 2011, using the mx
+SGLD optimizer).  A Bayesian linear regression y = w.x + b + noise whose
+posterior is Gaussian with known mean — SGLD's iterate distribution
+after burn-in must center on it, which the smoke test checks.
+
+Exercises: the SGLD optimizer end-to-end (injected Gaussian noise scaled
+by the learning rate), MakeLoss-free regression training, and manual
+parameter-sample collection from a Module.
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_data(n=512, seed=0, noise=0.3):
+    rs = np.random.RandomState(seed)
+    w_true = np.array([1.5, -2.0, 0.7], "f")
+    b_true = 0.5
+    X = rs.randn(n, 3).astype("f")
+    y = X @ w_true + b_true + rs.randn(n).astype("f") * noise
+    return X, y.astype("f"), w_true, b_true
+
+
+def run(num_epoch=60, batch_size=64, lr=1e-3, burn_in=30, seed=0):
+    mx.random.seed(seed)
+    X, y, w_true, b_true = make_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True,
+                           label_name="lro_label")
+    data = mx.sym.Variable("data")
+    pred = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    net = mx.sym.LinearRegressionOutput(pred, name="lro")
+    mod = mx.mod.Module(net, label_names=("lro_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Normal(0.1))
+    # rescale_grad sums the minibatch gradient up to the full-data scale
+    # (SGLD needs the unbiased N-scaled gradient) and the noise term comes
+    # from the optimizer itself
+    mod.init_optimizer(optimizer="sgld",
+                       optimizer_params={"learning_rate": lr,
+                                         "rescale_grad": len(X) / batch_size,
+                                         "wd": 1e-3})
+    samples = []
+    for epoch in range(num_epoch):
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+        if epoch >= burn_in:
+            args, _ = mod.get_params()
+            samples.append(np.concatenate(
+                [args["fc_weight"].asnumpy().ravel(),
+                 args["fc_bias"].asnumpy().ravel()]))
+    samples = np.stack(samples)
+    mean = samples.mean(0)
+    std = samples.std(0)
+    return mean, std, np.concatenate([w_true, [b_true]])
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    mean, std, truth = run()
+    for name, m, s, t in zip(["w0", "w1", "w2", "b"], mean, std, truth):
+        print("%s: posterior %.3f +- %.3f (truth %.3f)" % (name, m, s, t))

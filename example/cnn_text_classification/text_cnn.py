@@ -1,0 +1,81 @@
+"""CNN for sentence classification (reference
+example/cnn_text_classification/text_cnn.py, Kim 2014): Embedding ->
+parallel Convolutions with several filter widths over the token axis ->
+max-pool-over-time -> Concat -> Dropout -> FC -> softmax.
+
+Exercises: Embedding feeding 4-D conv via expand_dims, multi-branch
+Concat, full-height kernels, Pooling over variable extent.  Data is a
+synthetic keyword-vs-context task (no dataset downloads here): a
+sentence is positive iff it contains one of the "positive" tokens
+anywhere — exactly the pattern max-pool-over-time detects.
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def make_text_cnn(sentence_size, num_embed, vocab_size, num_label=2,
+                  filter_list=(3, 4, 5), num_filter=32, dropout=0.3):
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="vocab_embed")
+    # (batch, 1, sentence, embed) — conv input layout
+    conv_input = mx.sym.Reshape(
+        embed, shape=(-1, 1, sentence_size, num_embed))
+    pooled = []
+    for width in filter_list:
+        convi = mx.sym.Convolution(conv_input, kernel=(width, num_embed),
+                                   num_filter=num_filter,
+                                   name="conv%d" % width)
+        acti = mx.sym.Activation(convi, act_type="relu")
+        pooled.append(mx.sym.Pooling(
+            acti, pool_type="max",
+            kernel=(sentence_size - width + 1, 1), stride=(1, 1)))
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Reshape(concat,
+                       shape=(-1, num_filter * len(filter_list)))
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_label, name="cls")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def make_sentences(n, sentence_size=24, vocab_size=200, seed=0):
+    rs = np.random.RandomState(seed)
+    pos_tokens = np.arange(5, 15)        # the "sentiment" keywords
+    X = rs.randint(20, vocab_size, (n, sentence_size))
+    y = rs.randint(0, 2, n)
+    for i in np.flatnonzero(y):
+        k = rs.randint(1, 3)
+        slots = rs.choice(sentence_size, k, replace=False)
+        X[i, slots] = rs.choice(pos_tokens, k)
+    return X.astype("f"), y.astype("f")
+
+
+def train(num_epoch=6, batch_size=64, lr=0.005, seed=0):
+    mx.random.seed(seed)
+    X, y = make_sentences(4000, seed=0)
+    Xv, yv = make_sentences(800, seed=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size)
+    net = make_text_cnn(24, 32, 200)
+    mod = mx.mod.Module(net)
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_data=val, num_epoch=num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    metric.reset()
+    mod.score(val, metric)
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    print("val accuracy: %.4f" % train())

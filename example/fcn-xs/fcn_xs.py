@@ -1,0 +1,93 @@
+"""Fully-convolutional segmentation (reference example/fcn-xs: the
+FCN-16s recipe).  Encoder convs downsample 4x, a 1x1 score layer
+predicts class maps, a stride-2 Deconvolution upsamples them to fuse
+with a skip score from the higher-resolution feature map (Crop aligns
+the maps), a second stride-2 Deconvolution reaches input resolution,
+and SoftmaxOutput(multi_output=True) trains per-pixel.
+
+Exercises: Deconvolution forward/backward, Crop with a reference input,
+multi_output softmax over spatial maps.  Data: synthetic scenes of
+bright rectangles on textured background; labels are per-pixel masks.
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def fcn_sym(num_classes=2):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    pool1 = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+    net = mx.sym.Convolution(pool1, num_filter=32, kernel=(3, 3),
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    score = mx.sym.Convolution(net, num_filter=num_classes, kernel=(1, 1),
+                               name="score")
+    # FCN-16s-style skip: upsample the deep score 2x, fuse with a score
+    # from the higher-resolution feature map, then upsample the fused map
+    up2 = mx.sym.Deconvolution(score, kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=num_classes,
+                               name="score2x")
+    skip = mx.sym.Convolution(pool1, num_filter=num_classes,
+                              kernel=(1, 1), name="score_pool1")
+    fused = mx.sym.Crop(up2, skip, num_args=2, name="crop_fuse") + skip
+    up = mx.sym.Deconvolution(fused, kernel=(4, 4), stride=(2, 2),
+                              pad=(1, 1), num_filter=num_classes,
+                              name="bigscore")
+    crop = mx.sym.Crop(up, data, num_args=2, name="crop")
+    return mx.sym.SoftmaxOutput(crop, multi_output=True, use_ignore=True,
+                                ignore_label=-1, name="softmax")
+
+
+def make_scenes(n, side=32, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 3, side, side).astype("f") * 0.4
+    Y = np.zeros((n, side, side), "f")
+    for i in range(n):
+        for _ in range(rs.randint(1, 3)):
+            h, w = rs.randint(6, 14, 2)
+            y0 = rs.randint(0, side - h)
+            x0 = rs.randint(0, side - w)
+            X[i, :, y0:y0 + h, x0:x0 + w] += 0.5
+            Y[i, y0:y0 + h, x0:x0 + w] = 1
+    return np.clip(X, 0, 1), Y
+
+
+def train(num_epoch=8, batch_size=16, lr=1e-3, seed=0):
+    mx.random.seed(seed)
+    X, Y = make_scenes(512, seed=0)
+    Xv, Yv = make_scenes(128, seed=1)
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, Yv, batch_size=batch_size)
+    mod = mx.mod.Module(fcn_sym())
+    mod.fit(it, num_epoch=num_epoch, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            initializer=mx.initializer.Xavier())
+    # pixel accuracy on validation
+    val.reset()
+    correct = total = 0
+    for b in val:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = b.label[0].asnumpy()
+        k = batch_size - b.pad
+        correct += (pred[:k] == lab[:k]).sum()
+        total += lab[:k].size
+    return correct / total
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    print("pixel accuracy: %.4f" % train())

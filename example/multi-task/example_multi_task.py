@@ -1,0 +1,93 @@
+"""Multi-task learning: one trunk, two softmax heads trained jointly
+(reference example/multi-task/example_multi_task.py — digit class AND
+even/odd trained together on MNIST-like data).  Exercises Group outputs
+with multiple labels, a Module with two label_names, and a per-task
+composite metric."""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+def build_network(num_classes=10):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    cls = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_cls")
+    sm1 = mx.sym.SoftmaxOutput(cls, mx.sym.Variable("softmax1_label"),
+                               name="softmax1")
+    par = mx.sym.FullyConnected(net, num_hidden=2, name="fc_parity")
+    sm2 = mx.sym.SoftmaxOutput(par, mx.sym.Variable("softmax2_label"),
+                               name="softmax2")
+    return mx.sym.Group([sm1, sm2])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-task accuracy over a Group of softmax heads (reference
+    example's Multi_Accuracy)."""
+
+    def __init__(self, num=2):
+        super(MultiAccuracy, self).__init__("multi-accuracy", num=num)
+
+    def reset(self):
+        self.num_inst = [0] * self.num
+        self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(1)
+            label = labels[i].asnumpy().astype(int).reshape(-1)
+            self.sum_metric[i] += (pred == label).sum()
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        return (["task%d-accuracy" % i for i in range(self.num)],
+                [s / max(1, n) for s, n in
+                 zip(self.sum_metric, self.num_inst)])
+
+
+def make_digits(n, seed=0):
+    rs0 = np.random.RandomState(99)
+    templates = rs0.rand(10, 256).astype("f")
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    X = templates[y] + rs.rand(n, 256).astype("f") * 0.7
+    return X.astype("f"), y.astype("f")
+
+
+def train(num_epoch=6, batch_size=128, lr=0.05, seed=3):
+    mx.random.seed(seed)
+    X, y = make_digits(6000, seed=0)
+    Xv, yv = make_digits(1000, seed=1)
+
+    def make(Xa, ya):
+        return mx.io.NDArrayIter(
+            {"data": Xa},
+            {"softmax1_label": ya, "softmax2_label": (ya % 2).astype("f")},
+            batch_size=batch_size, shuffle=True)
+
+    it, val = make(X, y), make(Xv, yv)
+    mod = mx.mod.Module(build_network(),
+                        label_names=("softmax1_label", "softmax2_label"))
+    metric = MultiAccuracy()
+    mod.fit(it, eval_data=val, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    metric.reset()
+    mod.score(val, metric)
+    names, vals = metric.get()
+    return dict(zip(names, vals))
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    accs = train()
+    print(" ".join("%s=%.4f" % kv for kv in sorted(accs.items())))

@@ -51,7 +51,7 @@ def synthetic_ratings(n_users=200, n_items=120, k_true=6, n_obs=20000,
 
 
 def train(num_epoch=8, k=8, lr=0.05, batch_size=256, seed=0):
-    mx.random.seed(123)
+    mx.random.seed(seed)
     users, items, scores = synthetic_ratings(seed=seed)
     n = int(len(users) * 0.9)
     def make(it_users, it_items, it_scores):

@@ -1,0 +1,18 @@
+"""fcn-xs smoke test: Deconvolution upsampling + Crop + multi_output
+softmax segment synthetic scenes well above the background-majority
+baseline."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fcn_segments():
+    path = os.path.join(REPO, "example", "fcn-xs", "fcn_xs.py")
+    spec = importlib.util.spec_from_file_location("fcn_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["fcn_t"] = mod
+    spec.loader.exec_module(mod)
+    acc = mod.train(num_epoch=8)
+    assert acc > 0.9, acc

@@ -1,0 +1,18 @@
+"""Multi-task example smoke test: joint training of two softmax heads."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multi_task_trains_both_heads():
+    path = os.path.join(REPO, "example", "multi-task",
+                        "example_multi_task.py")
+    spec = importlib.util.spec_from_file_location("mt_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["mt_t"] = mod
+    spec.loader.exec_module(mod)
+    accs = mod.train(num_epoch=6)
+    assert accs["task0-accuracy"] > 0.9, accs
+    assert accs["task1-accuracy"] > 0.9, accs

@@ -1,0 +1,18 @@
+"""text-cnn smoke test: multi-width conv + max-over-time detects keyword
+presence (reference cnn_text_classification)."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_text_cnn_learns_keywords():
+    path = os.path.join(REPO, "example", "cnn_text_classification",
+                        "text_cnn.py")
+    spec = importlib.util.spec_from_file_location("tc_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tc_t"] = mod
+    spec.loader.exec_module(mod)
+    acc = mod.train(num_epoch=6)
+    assert acc > 0.9, acc
