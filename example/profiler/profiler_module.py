@@ -1,0 +1,73 @@
+"""Per-op device profiling of a fused training step (reference
+example/profiler/*: profiler_executor.py / profiler_matmul.py).
+
+Trains a small CNN for a few steps under mx.profiler mode='all_xla',
+then prints mx.profiler.dumps(): per-graph-node device times recovered
+from XLA HLO metadata — forward rows under the layer name, backward
+rows as _backward_<name>, exactly the reference's per-op profile table
+(src/engine/profiler.cc) but over a FUSED XLA program.
+
+Device-op events need a real accelerator backend; on cpu the script
+still writes the host-engine Chrome trace (profile.json).
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def main(steps=3, out_dir="/tmp/mxtpu_profile"):
+    import jax
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(np.random.rand(64, 3, 24, 24).astype("f"),
+                           np.random.randint(0, 10, 64).astype("f"),
+                           batch_size=32)
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    b = next(iter(it))
+    mod.forward_backward(b)
+    mod.update()                      # compile outside the trace
+
+    profiler.profiler_set_config(
+        mode="all_xla", filename=os.path.join(out_dir, "profile.json"),
+        trace_dir=os.path.join(out_dir, "xla"))
+    profiler.profiler_set_state("run")
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    for v in mod.get_outputs():
+        v.wait_to_read()
+    profiler.profiler_set_state("stop")
+
+    os.makedirs(out_dir, exist_ok=True)
+    profiler.dump_profile()           # host-engine Chrome trace
+    if jax.default_backend() == "cpu":
+        print("cpu backend: no device-op events; host trace written to",
+              os.path.join(out_dir, "profile.json"))
+        return None
+    table = profiler.dumps(trace_dir=os.path.join(out_dir, "xla"))
+    print(table)
+    return table
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    main()

@@ -78,7 +78,11 @@ class DataIter(object):
                              pad=self.getpad(), index=self.getindex())
         raise StopIteration
 
-    __next__ = next
+    def __next__(self):
+        # dynamic dispatch, NOT `__next__ = next`: subclasses override
+        # next() (the reference's own custom-iterator recipe) and the
+        # for-loop protocol must reach the override
+        return self.next()
 
     def iter_next(self):
         raise NotImplementedError

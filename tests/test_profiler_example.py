@@ -1,0 +1,20 @@
+"""Profiler example smoke test: runs the fused-step profiling flow; on a
+device backend the per-op table must name the layers."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profiler_example(tmp_path):
+    import jax
+    path = os.path.join(REPO, "example", "profiler", "profiler_module.py")
+    spec = importlib.util.spec_from_file_location("prof_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["prof_t"] = mod
+    spec.loader.exec_module(mod)
+    table = mod.main(out_dir=str(tmp_path))
+    assert os.path.exists(str(tmp_path / "profile.json"))
+    if jax.default_backend() != "cpu":
+        assert table and "conv1" in table and "_backward_conv1" in table

@@ -1,0 +1,18 @@
+"""rnn-time-major example smoke test: TNC-layout LSTM learns the
+shift-by-one language."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_time_major_lstm_learns():
+    path = os.path.join(REPO, "example", "rnn-time-major",
+                        "rnn_cell_demo.py")
+    spec = importlib.util.spec_from_file_location("tm_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tm_t"] = mod
+    spec.loader.exec_module(mod)
+    acc = mod.train()
+    assert acc > 0.9, acc
