@@ -102,15 +102,15 @@ class AnchorTargetOp(mx.operator.CustomOp):
         # anchor order too (the reference's rpn.py does the same transpose)
         labels = labels.reshape(n, h, w, a).transpose(0, 3, 1, 2) \
             .reshape(n, a * h * w)
-        self.assign(out_data[0], req[0], mx.nd.array(labels))
-        self.assign(out_data[1], req[1], mx.nd.array(
-            btargets.reshape(n, h, w, a * 4).transpose(0, 3, 1, 2)))
-        self.assign(out_data[2], req[2], mx.nd.array(
-            bweights.reshape(n, h, w, a * 4).transpose(0, 3, 1, 2)))
+        self.assign(out_data[0], req[0], labels.astype(np.float32))
+        self.assign(out_data[1], req[1], btargets.reshape(
+            n, h, w, a * 4).transpose(0, 3, 1, 2).astype(np.float32))
+        self.assign(out_data[2], req[2], bweights.reshape(
+            n, h, w, a * 4).transpose(0, 3, 1, 2).astype(np.float32))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
         for g in in_grad:
-            self.assign(g, "write", mx.nd.zeros(g.shape))
+            self.assign(g, "write", np.zeros(g.shape, np.float32))
 
 
 @mx.operator.register("anchor_target")
@@ -196,14 +196,14 @@ class ProposalTargetOp(mx.operator.CustomOp):
                     c4 = int(c) * 4
                     btargets[base + j, c4:c4 + 4] = t[0]
                     bweights[base + j, c4:c4 + 4] = 1.0
-        self.assign(out_data[0], req[0], mx.nd.array(out_rois))
-        self.assign(out_data[1], req[1], mx.nd.array(labels))
-        self.assign(out_data[2], req[2], mx.nd.array(btargets))
-        self.assign(out_data[3], req[3], mx.nd.array(bweights))
+        self.assign(out_data[0], req[0], out_rois.astype(np.float32))
+        self.assign(out_data[1], req[1], labels.astype(np.float32))
+        self.assign(out_data[2], req[2], btargets.astype(np.float32))
+        self.assign(out_data[3], req[3], bweights.astype(np.float32))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
         for g in in_grad:
-            self.assign(g, "write", mx.nd.zeros(g.shape))
+            self.assign(g, "write", np.zeros(g.shape, np.float32))
 
 
 @mx.operator.register("proposal_target")

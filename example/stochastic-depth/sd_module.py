@@ -1,0 +1,118 @@
+"""Stochastic depth (reference example/stochastic-depth/sd_module.py,
+Huang et al. 2016): residual blocks are randomly dropped during training
+(identity passthrough) with linearly-decaying survival probabilities and
+rescaled at inference.
+
+Exercises: a Python CustomOp carrying train/test mode and its own RNG
+inside the graph (the reference uses a DeathRate-aware module list; here
+the drop gate is a CustomOp so it runs under the fused executor), plus
+residual topology.
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import mxnet_tpu as mx
+
+
+class StochasticGate(mx.operator.CustomOp):
+    """Multiplies the residual branch by 0/1 (train, Bernoulli(p_survive))
+    or by p_survive (inference expectation)."""
+
+    def __init__(self, p_survive, seed):
+        super(StochasticGate, self).__init__()
+        self.p = float(p_survive)
+        self._rs = np.random.RandomState(seed)
+        self._last = 1.0
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        if is_train:
+            self._last = 1.0 if self._rs.rand() < self.p else 0.0
+            y = x * self._last
+        else:
+            y = x * self.p
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * self._last)
+
+
+@mx.operator.register("stochastic_gate")
+class StochasticGateProp(mx.operator.CustomOpProp):
+    def __init__(self, p_survive="1.0", seed="0"):
+        super(StochasticGateProp, self).__init__(need_top_grad=True)
+        self.p_survive = float(p_survive)
+        self.seed = int(seed)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return StochasticGate(self.p_survive, self.seed)
+
+
+def residual_block(net, num_filter, p_survive, idx):
+    branch = mx.sym.Convolution(net, num_filter=num_filter, kernel=(3, 3),
+                                pad=(1, 1), name="blk%d_conv1" % idx)
+    branch = mx.sym.Activation(branch, act_type="relu")
+    branch = mx.sym.Convolution(branch, num_filter=num_filter,
+                                kernel=(3, 3), pad=(1, 1),
+                                name="blk%d_conv2" % idx)
+    branch = mx.sym.Custom(branch, op_type="stochastic_gate",
+                           p_survive=p_survive, seed=100 + idx)
+    return mx.sym.Activation(net + branch, act_type="relu")
+
+
+def build_net(num_blocks=4, num_filter=16, p_final=0.5, num_classes=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=num_filter, kernel=(3, 3),
+                             pad=(1, 1), name="conv0")
+    net = mx.sym.Activation(net, act_type="relu")
+    for i in range(num_blocks):
+        # linear decay: survival 1 -> p_final over depth (the paper's rule)
+        p = 1.0 - (i + 1) / num_blocks * (1.0 - p_final)
+        net = residual_block(net, num_filter, p, i)
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net),
+                                num_hidden=num_classes, name="cls")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_data(n, seed=0, num_classes=4):
+    rs0 = np.random.RandomState(7)
+    templates = rs0.rand(num_classes, 3, 16, 16).astype("f")
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, n)
+    X = templates[y] * 0.9 + rs.rand(n, 3, 16, 16).astype("f") * 0.5
+    return X.astype("f"), y.astype("f")
+
+
+def train(num_epoch=6, batch_size=64, lr=0.05, seed=0):
+    mx.random.seed(seed)
+    X, y = make_data(2000, seed=0)
+    Xv, yv = make_data(400, seed=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size)
+    mod = mx.mod.Module(build_net())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_data=val, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": lr, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), eval_metric=metric)
+    metric.reset()
+    mod.score(val, metric)
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    print("val accuracy: %.4f" % train())

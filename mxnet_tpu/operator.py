@@ -44,6 +44,10 @@ def _assign(dst, req, src):
     CustomOp.assign semantics, shared by all op base classes)."""
     if req == "null":
         return
+    if not isinstance(src, np.ndarray) and hasattr(src, "asnumpy"):
+        # an NDArray built inside the callback: pull it host-side once
+        # here rather than letting numpy's setitem trigger __array__
+        src = src.asnumpy()
     if req in ("write", "inplace"):
         dst[:] = src
     elif req == "add":
@@ -154,9 +158,48 @@ def _create_operator(op_type, attr_items, shapes, dtypes):
                                 [np.dtype(d).name for d in dtypes])
 
 
+class _HostArray(np.ndarray):
+    """What custom-op callbacks receive: a numpy view with the NDArray
+    conveniences (.asnumpy/.wait_to_read/.copyto/.context).
+
+    Callbacks run on a runtime callback thread while the compiled
+    program that invoked them is still executing — creating device
+    arrays there (the old path device_put every input) can deadlock
+    against the main thread's device_get (observed with a CustomOp
+    inside a fit loop).  The reference hands CPU NDArrays; a numpy view
+    is the TPU-native equivalent: zero-copy, full numpy operator
+    surface, and no device traffic from inside a callback."""
+
+    def asnumpy(self):
+        return np.asarray(self)
+
+    def wait_to_read(self):
+        pass
+
+    wait_to_write = wait_to_read
+
+    def copyto(self, other):
+        other[:] = self
+        return other
+
+    @property
+    def context(self):
+        from .context import cpu
+        return cpu()
+
+
 def _wrap_nd(arrays):
-    from .ndarray import NDArray
-    return [NDArray(np.ascontiguousarray(a)) for a in arrays]
+    out = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        if not a.flags.writeable:
+            # np.asarray(jax.Array) aliases jax's read-only host cache;
+            # callbacks write in-place (out/aux/in-grad buffers, and some
+            # user ops scribble on inputs) — give them their own copy,
+            # which is what the old device-NDArray path did implicitly
+            a = a.copy()
+        out.append(a.view(_HostArray))
+    return out
 
 
 def _custom_input_names(attrs):

@@ -225,11 +225,8 @@ def _jitted(op_name, attr_items, dyn_names, is_train, with_rng):
 
 
 @functools.lru_cache(maxsize=1)
-def callbacks_under_jit_supported():
-    """Whether the active backend can run host callbacks inside compiled
-    programs (axon/TPU PJRT may not support host send/recv — then graphs
-    containing Custom ops execute eagerly, mirroring the reference where
-    CustomOp is always a host-side engine callback)."""
+def _callback_probe():
+    """One-time backend probe: can a pure_callback run under jit here?"""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -240,6 +237,26 @@ def callbacks_under_jit_supported():
         return True
     except Exception:
         return False
+
+
+def callbacks_under_jit_supported():
+    """Whether graphs containing host-callback ops (Custom) may be
+    whole-graph jitted.  Default: NO — callbacks then run inside the
+    compiled program on a runtime callback thread, and a concurrent
+    device_get on the main thread (metric pulls, async dispatch) can
+    deadlock against the callback's own host transfers (observed:
+    CustomOp inside Module.fit hangs intermittently).  Eager per-op
+    execution mirrors the reference, where CustomOp is always a
+    host-side engine callback between kernel launches
+    (src/operator/custom/custom-inl.h), and makes stateful callback RNG
+    deterministic (pure_callback gives no execution-count guarantee).
+    Set MXNET_CUSTOM_UNDER_JIT=1 to opt into fused custom-op graphs.
+    The env var is read per call (only the backend probe is cached), so
+    toggling it mid-process takes effect at the next bind."""
+    from ..base import get_env
+    if str(get_env("MXNET_CUSTOM_UNDER_JIT", "0")) != "1":
+        return False
+    return _callback_probe()
 
 
 def _hashable(v):

@@ -145,3 +145,57 @@ def test_custom_op_module_training():
     score = mod.score(it, mx.metric.Accuracy())
     acc = dict(score)["accuracy"] if isinstance(score, list) else score
     assert acc > 0.85, acc
+
+
+def test_custom_op_sequential_fits_no_deadlock():
+    """Two Module.fit runs with a CustomOp in ONE process must not hang:
+    under-jit host callbacks raced the main thread's device_get
+    (intermittent deadlock); custom-op graphs therefore execute eagerly
+    by default (MXNET_CUSTOM_UNDER_JIT=1 opts back in).  Run in a
+    subprocess so a regression fails the test instead of hanging the
+    suite."""
+    import os
+    import subprocess
+    import sys as _sys
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+class Scale(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 0.5)
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * 0.5)
+
+@mx.operator.register("seq_scale")
+class ScaleProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+    def create_operator(self, ctx, shapes, dtypes):
+        return Scale()
+
+X = np.random.RandomState(0).randn(128, 8).astype("f")
+y = (X.sum(1) > 0).astype("f")
+for round_ in range(2):
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    val = mx.io.NDArrayIter(X[:64], y[:64], batch_size=32)
+    net = mx.sym.Custom(mx.sym.Variable("data"), op_type="seq_scale")
+    net = mx.sym.FullyConnected(net, num_hidden=2)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, eval_data=val, num_epoch=2, optimizer="sgd",
+            initializer=mx.initializer.Xavier())
+print("SEQ_OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_CUSTOM_UNDER_JIT"] = "0"   # pin the default path under test
+    res = subprocess.run([_sys.executable, "-c", code], timeout=300,
+                         capture_output=True, text=True, env=env)
+    assert res.returncode == 0, res.stderr[-800:]
+    assert "SEQ_OK" in res.stdout

@@ -1,0 +1,19 @@
+"""Stochastic-depth smoke test: random block dropping (CustomOp with its
+own train-time RNG) still trains to high accuracy, and inference uses
+the survival expectation."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_stochastic_depth_trains():
+    path = os.path.join(REPO, "example", "stochastic-depth",
+                        "sd_module.py")
+    spec = importlib.util.spec_from_file_location("sd_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["sd_t"] = mod
+    spec.loader.exec_module(mod)
+    acc = mod.train(num_epoch=6)
+    assert acc > 0.9, acc
