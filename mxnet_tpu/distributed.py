@@ -234,21 +234,41 @@ def start_heartbeat(interval=None):
 
 
 # Observer-side liveness cache: rank -> (last stamp value seen, local
-# monotonic time it changed).  Ages are measured with the *observer's*
-# clock from the moment the stamp last changed — never by differencing a
-# remote wall clock against ours, so NTP steps / cross-host skew cannot
-# fake a dead (or alive) worker.  Same discipline as ps-lite, which uses
-# the receiver's own timestamps for heartbeat staleness.
+# monotonic time it changed, provisional).  Ages are measured with the
+# *observer's* clock from the moment the stamp last changed — never by
+# differencing a remote wall clock against ours, so NTP steps /
+# cross-host skew cannot fake a dead (or alive) worker.  Same discipline
+# as ps-lite, which uses the receiver's own timestamps for heartbeat
+# staleness.  ``provisional`` marks stamps we have only seen once: the
+# observer cannot tell a fresh stamp from a dead worker's last words, so
+# such entries report age None (unknown) rather than 0 (alive) until the
+# stamp is seen to change.
 _HB_OBSERVED = {}
+_HB_CLIENT = None  # client identity the cache was built against
+
+
+def _hb_observed(client):
+    """The liveness cache, cleared whenever the coordination client is a
+    different object than last time (re-initialised KV client means every
+    cached observation time is meaningless)."""
+    global _HB_CLIENT
+    if client is not _HB_CLIENT:
+        _HB_OBSERVED.clear()
+        _HB_CLIENT = client
+    return _HB_OBSERVED
 
 
 def heartbeat_ages():
-    """rank -> seconds since its heartbeat value last changed, measured on
-    the local monotonic clock (None = never seen)."""
+    """rank -> seconds since its heartbeat value was last seen to change,
+    measured on the local monotonic clock.  None = unknown: either never
+    written, or written but not yet observed to change (a stamp seen only
+    once could equally be a live worker's latest beat or a dead worker's
+    last — see num_dead_nodes for how frozen stamps age out)."""
     import time as _time
     client = _kv_client()
     if client is None:
         return {}
+    obs = _hb_observed(client)
     now = _time.monotonic()
     ages = {}
     for r in range(num_workers()):
@@ -257,10 +277,13 @@ def heartbeat_ages():
         except Exception:  # noqa: BLE001 — not yet written
             ages[r] = None
             continue
-        prev = _HB_OBSERVED.get(r)
-        if prev is None or prev[0] != stamp:
-            _HB_OBSERVED[r] = (stamp, now)
-        ages[r] = now - _HB_OBSERVED[r][1]
+        prev = obs.get(r)
+        if prev is None:
+            obs[r] = (stamp, now, True)
+        elif prev[0] != stamp:
+            obs[r] = (stamp, now, False)
+        rec = obs[r]
+        ages[r] = None if rec[2] else now - rec[1]
     return ages
 
 
@@ -268,9 +291,19 @@ def num_dead_nodes(node_id=-1, timeout=60):
     """Count workers whose heartbeat is older than ``timeout`` seconds
     (reference get_num_dead_node semantics; node_id filtering reduces to
     "any worker" here — there are no separate server/scheduler roles).
-    Workers that never heartbeat (pre-start) are not counted dead."""
+    Workers that never heartbeat (pre-start) are not counted dead; a
+    worker whose stamp has stayed frozen for the whole of a > timeout
+    observation window is (its beat thread would have re-stamped)."""
+    import time as _time
+    ages = heartbeat_ages()
+    now = _time.monotonic()
     dead = 0
-    for r, age in heartbeat_ages().items():
+    for r, age in ages.items():
         if age is not None and age > timeout:
+            dead += 1
+            continue
+        rec = _HB_OBSERVED.get(r)
+        if (age is None and rec is not None and rec[2]
+                and now - rec[1] > timeout):
             dead += 1
     return dead

@@ -613,9 +613,18 @@ def _executor_close(self):
     executors (shared_exec bucketing) or still be the caller's parameter
     NDArrays — so close() must not delete them, only unpin them.  The
     executor is unusable afterwards; safe to call twice."""
+    # On the eager (non-jit) path a passthrough graph output can BE one of
+    # the caller's bound arrays (identity, not a copy) — deleting it would
+    # invalidate a caller-owned buffer, so collect bound identities first.
+    bound = set()
+    for d in (self.arg_dict, self.aux_dict, self.grad_dict):
+        for arr in (d or {}).values():
+            data = getattr(arr, "_data", None)
+            if isinstance(data, jax.Array):
+                bound.add(id(data))
     for o in (self._outputs or []):
         data = getattr(o, "_data", None)
-        if isinstance(data, jax.Array):
+        if isinstance(data, jax.Array) and id(data) not in bound:
             try:
                 data.delete()
             except Exception:  # noqa: BLE001

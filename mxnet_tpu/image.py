@@ -1118,6 +1118,12 @@ def _translate_cxx_aug_params(kwargs):
     mn_scale = kw.pop("min_random_scale", 1.0)
     if (mx_scale != 1.0 or mn_scale != 1.0) and out.get("rand_crop"):
         out["rand_resize"] = True
+    if "pad" in kw:
+        out["pad"] = kw.pop("pad")
+        # the reference C++ augmenter pads with 255 unless told otherwise
+        # (image_aug_default.cc:109 fill_value default) — scripts passing
+        # pad= alone must get white padding, not black
+        out["fill_value"] = kw.pop("fill_value", 255)
     dropped = {}
     for name in ("max_rotate_angle", "max_random_rotate_angle",
                  "max_aspect_ratio", "max_random_aspect_ratio",
@@ -1160,6 +1166,14 @@ class ImageRecordIter(mxio.DataIter):
         self._layout = layout
         if layout not in ("NCHW", "NHWC"):
             raise MXNetError("layout must be NCHW or NHWC")
+        # Incompatible-flag checks depend only on constructor args and must
+        # precede any resource acquisition (ImageIter's record/index file
+        # handles, and below it _NativePipeline's reader thread, uploader
+        # pool and C++ pipe), so the error path leaks nothing.
+        if host_batches and device_transform is not None:
+            raise MXNetError(
+                "host_batches yields raw numpy batches — a device_transform "
+                "would be silently skipped; pass one or the other")
         self._it = ImageIter(
             batch_size, data_shape, label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx,
@@ -1190,10 +1204,6 @@ class ImageRecordIter(mxio.DataIter):
         if device_transform is not None and self._pipeline is None:
             raise MXNetError(
                 "device_transform needs the native image pipeline")
-        if host_batches and device_transform is not None:
-            raise MXNetError(
-                "host_batches yields raw numpy batches — a device_transform "
-                "would be silently skipped; pass one or the other")
         if host_batches and not isinstance(self._pipeline, _NativePipeline):
             raise MXNetError(
                 "host_batches needs the native image pipeline (libjpeg)")

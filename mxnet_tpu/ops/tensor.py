@@ -89,7 +89,7 @@ def _minimum(lhs, rhs):
     return jnp.minimum(lhs, rhs)
 
 
-@register("_hypot", input_names=("lhs", "rhs"))
+@register("_hypot", input_names=("lhs", "rhs"), aliases=("_Hypot",))
 def _hypot(lhs, rhs):
     return jnp.hypot(lhs, rhs)
 
@@ -133,9 +133,11 @@ def _logic(name, jfn, aliases=()):
 _logic("broadcast_equal", jnp.equal, aliases=("_equal", "_Equal"))
 _logic("broadcast_not_equal", jnp.not_equal, aliases=("_not_equal", "_Not_Equal"))
 _logic("broadcast_greater", jnp.greater, aliases=("_greater", "_Greater"))
-_logic("broadcast_greater_equal", jnp.greater_equal, aliases=("_greater_equal",))
+_logic("broadcast_greater_equal", jnp.greater_equal,
+       aliases=("_greater_equal", "_Greater_Equal"))
 _logic("broadcast_lesser", jnp.less, aliases=("_lesser", "_Lesser"))
-_logic("broadcast_lesser_equal", jnp.less_equal, aliases=("_lesser_equal",))
+_logic("broadcast_lesser_equal", jnp.less_equal,
+       aliases=("_lesser_equal", "_Lesser_Equal"))
 _logic("broadcast_logical_and", jnp.logical_and)
 _logic("broadcast_logical_or", jnp.logical_or)
 _logic("broadcast_logical_xor", jnp.logical_xor)
@@ -164,13 +166,19 @@ _scalar_op("_power_scalar", jnp.power, aliases=("_PowerScalar",))
 _scalar_op("_rpower_scalar", lambda a, s: jnp.power(s, a), aliases=("_RPowerScalar",))
 _scalar_op("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
 _scalar_op("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
-_scalar_op("_hypot_scalar", jnp.hypot)
-_scalar_op("_equal_scalar", lambda a, s: (a == s).astype(a.dtype))
-_scalar_op("_not_equal_scalar", lambda a, s: (a != s).astype(a.dtype))
-_scalar_op("_greater_scalar", lambda a, s: (a > s).astype(a.dtype))
-_scalar_op("_greater_equal_scalar", lambda a, s: (a >= s).astype(a.dtype))
-_scalar_op("_lesser_scalar", lambda a, s: (a < s).astype(a.dtype))
-_scalar_op("_lesser_equal_scalar", lambda a, s: (a <= s).astype(a.dtype))
+_scalar_op("_hypot_scalar", jnp.hypot, aliases=("_HypotScalar",))
+_scalar_op("_equal_scalar", lambda a, s: (a == s).astype(a.dtype),
+           aliases=("_EqualScalar",))
+_scalar_op("_not_equal_scalar", lambda a, s: (a != s).astype(a.dtype),
+           aliases=("_NotEqualScalar",))
+_scalar_op("_greater_scalar", lambda a, s: (a > s).astype(a.dtype),
+           aliases=("_GreaterScalar",))
+_scalar_op("_greater_equal_scalar", lambda a, s: (a >= s).astype(a.dtype),
+           aliases=("_GreaterEqualScalar",))
+_scalar_op("_lesser_scalar", lambda a, s: (a < s).astype(a.dtype),
+           aliases=("_LesserScalar",))
+_scalar_op("_lesser_equal_scalar", lambda a, s: (a <= s).astype(a.dtype),
+           aliases=("_LesserEqualScalar",))
 
 
 @register("smooth_l1")
@@ -566,6 +574,16 @@ def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
     return oh * on_value + (1.0 - oh) * off_value
 
 
+@register("_onehot_encode", input_names=("index", "out"),
+          aliases=("onehot_encode",))
+def _onehot_encode(index, out):
+    """One-hot encode ``index`` into the shape/dtype of ``out`` (the
+    reference's in-place _onehot_encode, src/ndarray/ndarray.cc:751,
+    ndarray_function-inl.h:64)."""
+    return jax.nn.one_hot(index.astype(jnp.int32), out.shape[1],
+                          dtype=out.dtype)
+
+
 @register("pick", input_names=("data", "index"))
 def pick(data, index, axis=1, keepdims=False):
     axis = _norm_axis(axis, data.ndim)
@@ -574,6 +592,23 @@ def pick(data, index, axis=1, keepdims=False):
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
     return out
+
+
+@register("choose_element_0index", input_names=("lhs", "rhs"))
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] — the reference's MatChooseRowElem
+    (src/ndarray/ndarray.cc:755, ndarray_function-inl.h:84)."""
+    idx = rhs.astype(jnp.int32)[:, None]
+    return jnp.take_along_axis(lhs, idx, axis=1)[:, 0]
+
+
+@register("fill_element_0index", input_names=("lhs", "mhs", "rhs"))
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] — the reference's
+    MatFillRowElem (src/ndarray/ndarray.cc:761, ndarray_function-inl.h:101)."""
+    idx = rhs.astype(jnp.int32)[:, None]
+    return jnp.put_along_axis(lhs, idx, mhs[:, None], axis=1,
+                              inplace=False)
 
 
 # ---------------------------------------------------------------------------

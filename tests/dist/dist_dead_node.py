@@ -48,7 +48,11 @@ def main():
             time.sleep(0.5)
         assert dead == 1, "dead=%d" % dead
         ages = distributed.heartbeat_ages()
-        assert ages[2] is not None and ages[2] > 2, ages
+        # rank 2's stamp either froze after we saw it change (real age) or
+        # never changed under observation (None = unknown-but-frozen; the
+        # dead==1 above came from the frozen-window rule).  It must never
+        # read as fresh.
+        assert ages[2] is None or ages[2] > 2, ages
         assert ages[0] is not None and ages[0] < 2, ages
     time.sleep(1.0)
     print("dist_dead_node rank %d/3: OK" % rank)

@@ -96,23 +96,37 @@ def test_heartbeat_ages_observer_side(monkeypatch):
                 raise KeyError(key)
             return stamps[r]
 
-    monkeypatch.setattr(dist, "_kv_client", lambda: FakeClient())
+    client = FakeClient()
+    monkeypatch.setattr(dist, "_kv_client", lambda: client)
     monkeypatch.setattr(dist, "num_workers", lambda: 2)
     monkeypatch.setattr(dist, "_HB_OBSERVED", {})
+    monkeypatch.setattr(dist, "_HB_CLIENT", None)
 
     ages = dist.heartbeat_ages()
-    # a stale-looking *value* just observed for the first time is age ~0,
-    # not (now - 1.0) ~ decades
-    assert ages[0] is not None and ages[0] < 5.0
+    # a stale-looking *value* just observed for the first time is UNKNOWN
+    # (could be a live worker's latest beat or a dead worker's last) —
+    # neither age ~0 (alive) nor (now - 1.0) ~ decades (dead)
+    assert ages[0] is None
     assert ages[1] is None      # never written
     assert dist.num_dead_nodes(timeout=60) == 0
 
-    # value unchanged -> age measured locally since first observation
+    # value unchanged -> still unknown, but the frozen observation window
+    # ages it out for dead-node purposes
     import time
+    time.sleep(0.05)
+    assert dist.heartbeat_ages()[0] is None
+    assert dist.num_dead_nodes(timeout=0.04) == 1   # frozen > timeout
+    assert dist.num_dead_nodes(timeout=60) == 0     # within window
+
+    # value changes -> worker is definitely alive, age measured locally
+    stamps[0] = "2.0"
+    assert dist.heartbeat_ages()[0] < 0.05
     time.sleep(0.05)
     a2 = dist.heartbeat_ages()[0]
     assert 0.05 <= a2 < 5.0
-
-    # value changes -> age resets (worker is alive)
-    stamps[0] = "2.0"
-    assert dist.heartbeat_ages()[0] < 0.05
+    assert dist.num_dead_nodes(timeout=0.04) == 1   # froze again
+    # a re-initialised KV client invalidates every cached observation
+    client2 = FakeClient()
+    monkeypatch.setattr(dist, "_kv_client", lambda: client2)
+    assert dist.heartbeat_ages()[0] is None
+    assert dist.num_dead_nodes(timeout=60) == 0

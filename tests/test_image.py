@@ -558,3 +558,31 @@ def test_pad_crop_augmentation(rec_dataset):
     assert out.shape == (72, 92, 3)
     assert (out[0] == 7).all() and (out[-1] == 7).all()
     assert (out[:, 0] == 7).all() and (out[:, -1] == 7).all()
+
+
+def test_pad_default_fill_is_white():
+    """The ImageRecordIter parity path defaults fill_value to 255 like the
+    reference C++ augmenter (image_aug_default.cc:109) — scripts passing
+    pad= alone must get white padding, not black."""
+    kw = image._translate_cxx_aug_params({"pad": 4})
+    assert kw["fill_value"] == 255
+    kw = image._translate_cxx_aug_params({"pad": 4, "fill_value": 9})
+    assert kw["fill_value"] == 9
+
+
+def test_host_batches_device_transform_rejected_before_pipeline(rec_dataset):
+    """Incompatible host_batches+device_transform raises BEFORE any
+    pipeline (reader thread / uploader pool / C++ pipe) is constructed, so
+    nothing leaks on the error path."""
+    import pytest
+
+    path, idx = rec_dataset
+    with pytest.raises(image.MXNetError):
+        image.ImageRecordIter(
+            path_imgrec=path, path_imgidx=idx, data_shape=(3, 60, 80),
+            batch_size=4, host_batches=True,
+            device_transform=lambda x: x)
+    # no stray mxtpu pipeline threads left behind
+    import threading
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(("mxtpu-upload", "mxtpu-rec-read"))]
