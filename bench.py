@@ -33,6 +33,23 @@ TPU host: the same pipeline sustains >3,000 img/s of decode (single
 core), and the same train step sustains >12,000 img/s when batches are
 staged — the fed number reflects the link, not the framework.  Each
 metric runs in its own subprocess (see _collect).
+
+Roofline accounting (round-5 correction): on this tunneled backend
+``jax.block_until_ready`` returns on dispatch acknowledgement, NOT on
+device completion — a dependent 64-matmul chain "timed" at 185 PFLOP/s
+(940x the chip's peak) under that sync, which is how earlier rounds
+recorded a ResNet-152 rate above 100% MFU.  The only true completion
+barrier here is a device->host fetch of a value that data-depends on the
+result.  Every on-chip metric therefore times S1 and S2 steps each ended
+by a scalar fetch of the updated parameters and takes the slope
+(work-scaling), which also cancels the ~60 ms fixed tunnel round-trip.
+Calibration under this method: sustained large-matmul bf16 rate is
+~172 TFLOP/s = 87% of the v5e's 197 TFLOP/s nominal peak (sane).  Each
+model metric carries {flops_per_img, tflops, mfu} from analytic model
+FLOPs (contrib/flops.py, 1 MAC = 2 FLOPs, training = 3x forward;
+cross-checked against XLA cost_analysis: 69.1 vs 67.2 GFLOP/img for the
+ResNet-152 train step) against the chip's nominal peak, and the run
+fails loudly if any MFU exceeds 1.0.
 """
 import json
 import os
@@ -87,23 +104,89 @@ def _best_of(fn, trials):
     return best
 
 
+#: nominal dense bf16 peak by device_kind, TFLOP/s.  Values are the
+#: published per-chip numbers; 'cpu' has no meaningful MXU peak.
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def _device_peak():
+    import jax
+    d = jax.devices()[0]
+    return d.device_kind, PEAK_TFLOPS.get(d.device_kind)
+
+
+def _fetch_sync(trainer):
+    """TRUE completion barrier: fetch a scalar that data-depends on the
+    freshest parameters.  jax.block_until_ready returns on dispatch ack
+    on this tunneled backend (see module docstring), so only a
+    device->host read of post-update state proves the steps ran."""
+    import jax.numpy as jnp
+    name = min(trainer.params, key=lambda k: trainer.params[k].size)
+    return float(jnp.sum(trainer.params[name].astype(jnp.float32)))
+
+
+def _slope_rate(run_steps, sync, s1, s2, trials):
+    """Work-scaling rate for an arbitrary step driver: time s1 and s2
+    steps, each ended by ``sync`` (a dependent-scalar fetch); the slope
+    cancels the fixed tunnel RTT (~60 ms/fetch) that would otherwise be
+    billed to the device.  Raises instead of returning a bogus 0 when no
+    trial yields a positive slope (clock anomaly): the metric then comes
+    back missing from the artifact, not silently zero."""
+    def timed(nsteps):
+        tic = time.perf_counter()
+        run_steps(nsteps)
+        sync()
+        return time.perf_counter() - tic
+
+    best = 0.0
+    for _ in range(max(1, trials)):
+        t1 = timed(s1)
+        t2 = timed(s2)
+        if t2 > t1:
+            best = max(best, (s2 - s1) / (t2 - t1))
+    if best <= 0.0:
+        raise RuntimeError(
+            "work-scaling slope non-positive across %d trials "
+            "(s1=%d, s2=%d) — timing anomaly, refusing to report" %
+            (trials, s1, s2))
+    return best
+
+
+def _steps_per_sec(trainer, staged, s1, s2, trials):
+    return _slope_rate(
+        lambda n: [trainer.step(*staged[i % len(staged)])
+                   for i in range(n)],
+        lambda: _fetch_sync(trainer), s1, s2, trials)
+
+
+def _roofline(per_item_rate, flops_per_item):
+    """{tflops, mfu, ...} block for one model metric."""
+    kind, peak = _device_peak()
+    tflops = per_item_rate * flops_per_item / 1e12
+    out = {"flops_per_item": int(flops_per_item),
+           "tflops": round(tflops, 2)}
+    if peak:
+        out["mfu"] = round(tflops / peak, 4)
+    return out
+
+
 def _compute_bench(trainer, batch, steps, warmup, trials,
                    staged=None):
-    """Steady-state fused-step throughput on pre-staged device batches."""
-    import jax
+    """Steady-state fused-step throughput on pre-staged device batches,
+    measured by fetch-synced work-scaling (never block_until_ready)."""
     staged = staged or _staged_batches(batch, 8)
     for i in range(warmup):
         trainer.step(*staged[i % len(staged)])
-    jax.block_until_ready(trainer.params)
-
-    def trial():
-        tic = time.time()
-        for i in range(steps):
-            trainer.step(*staged[i % len(staged)])
-        jax.block_until_ready(trainer.params)
-        return batch * steps / (time.time() - tic)
-
-    return _best_of(trial, trials)
+    _fetch_sync(trainer)
+    s1 = max(4, steps // 4)
+    return batch * _steps_per_sec(trainer, staged, s1, s1 + steps, trials)
 
 
 def _make_dataset(n_img, side=256):
@@ -177,20 +260,17 @@ def _fed_bench(batch, steps, warmup, trials):
                 yield b
 
     gen = batches()
-    for _ in range(warmup + 8):
-        b = next(gen)
-        trainer.step(b.data[0], b.label[0])
-    jax.block_until_ready(trainer.params)
 
-    def trial():
-        tic = time.time()
-        for _ in range(steps):
+    def run_steps(n):
+        for _ in range(n):
             b = next(gen)
             trainer.step(b.data[0], b.label[0])
-        jax.block_until_ready(trainer.params)
-        return batch * steps / (time.time() - tic)
 
-    fed = _best_of(trial, trials)
+    run_steps(warmup + 8)
+    _fetch_sync(trainer)
+    s1 = max(4, steps // 4)
+    fed = batch * _slope_rate(run_steps, lambda: _fetch_sync(trainer),
+                              s1, s1 + steps, trials)
     it.close()
     trainer.close()  # release HBM (params/momentum/exe) before the next bench
     return fed
@@ -390,16 +470,18 @@ def _lstm_bench(batch, seq_len, steps, warmup, trials):
         staged.append((d, l))
     for i in range(warmup):
         trainer.step(*staged[i % 8])
-    jax.block_until_ready(trainer.params)
+    _fetch_sync(trainer)
+    s1 = max(4, steps // 4)
+    return batch * seq_len * _steps_per_sec(trainer, staged, s1,
+                                            s1 + steps, trials)
 
-    def trial():
-        tic = time.time()
-        for i in range(steps):
-            trainer.step(*staged[i % 8])
-        jax.block_until_ready(trainer.params)
-        return batch * seq_len * steps / (time.time() - tic)
 
-    return _best_of(trial, trials)
+def _train_flops(sym_name):
+    """Analytic training FLOPs per image (3x forward; contrib/flops.py)."""
+    from mxnet_tpu import models
+    from mxnet_tpu.contrib.flops import model_flops
+    sym = models.get_symbol(sym_name, num_classes=1000)
+    return 3 * model_flops(sym, data=(1, 3, 224, 224))
 
 
 def _run_mode(mode):
@@ -422,17 +504,31 @@ def _run_mode(mode):
         out.update(_fed_cpu_bench())
     elif mode == "fed":
         out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
+        out["fed_roofline"] = _roofline(out["fed"],
+                                        _train_flops("resnet-50"))
+        out["device_kind"] = _device_peak()[0]
     elif mode == "compute":
         tr = _make_trainer("resnet-50", batch)
         out["compute"] = round(
             _compute_bench(tr, batch, steps, warmup, trials), 2)
+        out["compute_roofline"] = _roofline(out["compute"],
+                                            _train_flops("resnet-50"))
+        out["device_kind"] = _device_peak()[0]
     elif mode in ("inception-bn", "resnet-152"):
         tr = _make_trainer(mode, batch)
         out[mode] = round(
             _compute_bench(tr, batch, sweep_steps, warmup, 1), 2)
+        out[mode + "_roofline"] = _roofline(out[mode], _train_flops(mode))
     elif mode == "lstm":
         out["lstm"] = round(
             _lstm_bench(batch, 32, sweep_steps, warmup, 1), 2)
+        from mxnet_tpu.contrib.flops import model_flops
+        from mxnet_tpu.models import lstm_lm
+        sym, _, _ = lstm_lm.lstm_lm_sym(32, 10000, num_embed=200,
+                                        num_hidden=200, num_layers=2)
+        # per-token training flops at the bench seq_len
+        out["lstm_roofline"] = _roofline(
+            out["lstm"], 3 * model_flops(sym, data=(1, 32)) / 32.0)
     print("BENCH_PART " + json.dumps(out))
 
 
@@ -530,6 +626,27 @@ def main():
             parts["resnet-152"] / 57.0, 3)
     if "lstm" in parts:
         result["lstm_tok_s"] = parts["lstm"]
+
+    # roofline accounting: every on-chip rate carries analytic FLOPs and
+    # MFU against the chip's nominal peak; >100% is physically impossible
+    # and fails the run loudly instead of shipping a bogus artifact
+    if "device_kind" in parts:
+        result["device_kind"] = parts["device_kind"]
+        result["device_peak_tflops"] = PEAK_TFLOPS.get(parts["device_kind"])
+    violations = []
+    for key in ("fed", "compute", "inception-bn", "resnet-152", "lstm"):
+        roof = parts.get(key + "_roofline")
+        if roof:
+            result[key.replace("-", "") + "_roofline"] = roof
+            if roof.get("mfu", 0) > 1.0:
+                violations.append("%s: mfu=%.2f" % (key, roof["mfu"]))
+    result["sync_method"] = (
+        "dependent-scalar fetch + work-scaling slope (block_until_ready "
+        "returns on dispatch ack on this backend; see bench.py docstring)")
+    if violations:
+        result["mfu_implausible"] = violations
+        sys.stderr.write("ROOFLINE VIOLATION (>100%% MFU — measurement "
+                         "invalid): %s\n" % "; ".join(violations))
 
     print(json.dumps(result))
 

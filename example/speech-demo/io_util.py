@@ -1,0 +1,272 @@
+"""Utterance feature IO for the acoustic-model demo.
+
+Capability port of the reference example/speech-demo/io_util.py:1
+(BucketSentenceIter / TruncatedSentenceIter over Kaldi feature streams).
+This environment has no network egress and no Kaldi, so the feature
+source is a synthetic corpus with real acoustic-model structure —
+variable-length utterances of continuous frame vectors whose per-frame
+labels depend on a short feature context, which is exactly what an LSTM
+can learn and a linear frame classifier cannot learn fully.
+
+Two iterators, matching the reference's two training regimes:
+
+- ``BucketSpeechIter``: whole utterances, bucketed by length, zero-padded
+  to the bucket size; each batch carries zeroed init states.  Label 0 is
+  the pad id (real labels are 1..num_label-1), so SoftmaxOutput's
+  ignore_label drops the padding.
+- ``TruncatedSpeechIter``: truncated BPTT — utterances are packed into
+  ``batch_size`` parallel streams and served in fixed ``truncate_len``
+  windows; the model's final states are copied back into
+  ``init_state_arrays`` between batches, and states are zeroed per-stream
+  whenever a new utterance starts there.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class SpeechBatch(object):
+    """DataBatch with bucket metadata (the bucketing DataIter contract:
+    provide_data/provide_label specific to the batch's bucket)."""
+
+    def __init__(self, data_names, data, label_names, label, bucket_key,
+                 effective_sample_count=None):
+        self.data = data
+        self.label = label
+        self.data_names = data_names
+        self.label_names = label_names
+        self.bucket_key = bucket_key
+        self.effective_sample_count = effective_sample_count
+        self.pad = 0
+        self.index = None
+
+    @property
+    def provide_data(self):
+        return [(n, x.shape) for n, x in zip(self.data_names, self.data)]
+
+    @property
+    def provide_label(self):
+        return [(n, x.shape) for n, x in zip(self.label_names, self.label)]
+
+
+def synthetic_corpus(num_utts, feat_dim=40, num_label=32, min_len=20,
+                     max_len=160, seed=7):
+    """Variable-length utterances with context-dependent frame labels.
+
+    Each utterance walks through a latent phone sequence; the frame
+    feature is the phone's template plus noise plus a bleed-over of the
+    PREVIOUS phone's template (coarticulation), and the label is the
+    current phone.  The bleed-over means frames are ambiguous in
+    isolation but decodable with temporal context.  Labels are 1-based
+    (0 = padding).
+    """
+    rs = np.random.RandomState(seed)
+    templates = rs.randn(num_label, feat_dim).astype(np.float32) * 2.0
+    utts = []
+    for _ in range(num_utts):
+        length = int(rs.randint(min_len, max_len + 1))
+        phones = np.zeros(length, np.int32)
+        feats = np.zeros((length, feat_dim), np.float32)
+        cur = int(rs.randint(1, num_label))
+        prev = 0
+        for t in range(length):
+            if rs.rand() < 0.2:     # phone transition every ~5 frames
+                prev, cur = cur, int(rs.randint(1, num_label))
+            phones[t] = cur
+            feats[t] = (templates[cur] * 0.6
+                        + templates[prev] * 0.7
+                        + rs.randn(feat_dim) * 0.8)
+        utts.append((feats, phones))
+    return utts
+
+
+class BucketSpeechIter(mx.io.DataIter):
+    """Bucket whole utterances by length (reference BucketSentenceIter
+    semantics, io_util.py:148): each utterance goes to the smallest
+    bucket that fits, frames beyond the utterance are zero-padded with
+    label 0, and batches are drawn bucket-by-bucket in shuffled order."""
+
+    def __init__(self, utts, buckets, batch_size, init_states, feat_dim,
+                 data_name="data", label_name="softmax_label", seed=0,
+                 shuffle=True):
+        super(BucketSpeechIter, self).__init__(batch_size)
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.feat_dim = feat_dim
+        self.data_name = data_name
+        self.label_name = label_name
+        self.init_states = list(init_states)
+        self._rs = np.random.RandomState(seed)
+        self._shuffle = shuffle
+
+        self._by_bucket = [[] for _ in self.buckets]
+        ndiscard = 0
+        for feats, phones in utts:
+            for bi, blen in enumerate(self.buckets):
+                if len(feats) <= blen:
+                    self._by_bucket[bi].append((feats, phones))
+                    break
+            else:
+                ndiscard += 1
+        if ndiscard:
+            import logging
+            logging.info("BucketSpeechIter: discarded %d utterances longer "
+                         "than the largest bucket", ndiscard)
+        self.default_bucket_key = max(self.buckets)
+        self._plan = []
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [(self.data_name,
+                 (self.batch_size, self.default_bucket_key, self.feat_dim))
+                ] + [(n, s) for n, s in self.init_states]
+
+    @property
+    def provide_label(self):
+        return [(self.label_name,
+                 (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._plan = []
+        for bi, pool in enumerate(self._by_bucket):
+            idx = np.arange(len(pool))
+            if self._shuffle:
+                self._rs.shuffle(idx)
+            for s in range(0, len(idx) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((bi, idx[s:s + self.batch_size]))
+        if self._shuffle:
+            self._rs.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        bi, rows = self._plan[self._cursor]
+        self._cursor += 1
+        blen = self.buckets[bi]
+        pool = self._by_bucket[bi]
+        data = np.zeros((self.batch_size, blen, self.feat_dim), np.float32)
+        label = np.zeros((self.batch_size, blen), np.float32)
+        nframes = 0
+        for k, r in enumerate(rows):
+            feats, phones = pool[r]
+            data[k, :len(feats)] = feats
+            label[k, :len(phones)] = phones
+            nframes += len(feats)
+        states = [mx.nd.zeros(s) for _, s in self.init_states]
+        return SpeechBatch(
+            [self.data_name] + [n for n, _ in self.init_states],
+            [mx.nd.array(data)] + states,
+            [self.label_name], [mx.nd.array(label)],
+            bucket_key=blen, effective_sample_count=nframes)
+
+
+
+class TruncatedSpeechIter(mx.io.DataIter):
+    """Truncated-BPTT iterator (reference TruncatedSentenceIter,
+    io_util.py:341): ``batch_size`` parallel streams, each consuming ONE
+    utterance at a time in fixed ``truncate_len`` windows — a new
+    utterance always begins at a window boundary, with that stream's
+    state rows zeroed before its first window.  Partial tail windows are
+    zero-padded (label 0) and excluded from effective_sample_count.
+
+    When the dataset runs dry a stream replays its last utterance marked
+    as padding (``is_pad``); with ``pad_zeros`` those rows are served as
+    zeros instead, the eval-friendly mode.  The caller copies the
+    model's output states into ``init_state_arrays`` after every batch.
+    """
+
+    def __init__(self, utts, batch_size, init_states, truncate_len,
+                 feat_dim, data_name="data", label_name="softmax_label",
+                 shuffle=True, seed=0, pad_zeros=False):
+        super(TruncatedSpeechIter, self).__init__(batch_size)
+        self.batch_size = batch_size
+        self.truncate_len = truncate_len
+        self.feat_dim = feat_dim
+        self.data_name = data_name
+        self.label_name = label_name
+        self.init_states = list(init_states)
+        self.init_state_arrays = [mx.nd.zeros(s) for _, s in
+                                  self.init_states]
+        self._utts = list(utts)
+        if len(self._utts) < batch_size:
+            raise ValueError("need at least batch_size utterances")
+        self._shuffle = shuffle
+        self._pad_zeros = pad_zeros
+        self._rs = np.random.RandomState(seed)
+        self.default_bucket_key = truncate_len
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [(self.data_name,
+                 (self.batch_size, self.truncate_len, self.feat_dim))
+                ] + [(n, s) for n, s in self.init_states]
+
+    @property
+    def provide_label(self):
+        return [(self.label_name, (self.batch_size, self.truncate_len))]
+
+    def reset(self):
+        order = np.arange(len(self._utts))
+        if self._shuffle:
+            self._rs.shuffle(order)
+        self._order = order
+        self._next_utt = self.batch_size
+        # per-stream: current utterance index, frame cursor, pad flag
+        self._cur = [int(order[i]) for i in range(self.batch_size)]
+        self._inside = [0] * self.batch_size
+        self._is_pad = [False] * self.batch_size
+        for arr in self.init_state_arrays:
+            arr[:] = 0
+
+    def _zero_state_rows(self, rows):
+        for arr in self.init_state_arrays:
+            host = arr.asnumpy().copy()
+            host[rows] = 0
+            arr[:] = host
+
+    def next(self):
+        T = self.truncate_len
+        reset_rows = []
+        for k in range(self.batch_size):
+            feats, _ = self._utts[self._cur[k]]
+            if self._inside[k] < len(feats):
+                continue
+            # stream k finished its utterance: fresh state, next utterance
+            # (or replay-as-pad once the dataset is exhausted)
+            reset_rows.append(k)
+            self._inside[k] = 0
+            if not self._is_pad[k] and self._next_utt < len(self._order):
+                self._cur[k] = int(self._order[self._next_utt])
+                self._next_utt += 1
+            else:
+                self._is_pad[k] = True
+        if all(self._is_pad):
+            raise StopIteration
+        if reset_rows:
+            self._zero_state_rows(reset_rows)
+
+        data = np.zeros((self.batch_size, T, self.feat_dim), np.float32)
+        label = np.zeros((self.batch_size, T), np.float32)
+        nframes = 0
+        for k in range(self.batch_size):
+            if self._is_pad[k] and self._pad_zeros:
+                continue
+            feats, phones = self._utts[self._cur[k]]
+            lo = self._inside[k]
+            hi = min(lo + T, len(feats))
+            data[k, :hi - lo] = feats[lo:hi]
+            label[k, :hi - lo] = phones[lo:hi]
+            if not self._is_pad[k]:
+                nframes += hi - lo
+            self._inside[k] = hi
+        batch = SpeechBatch(
+            [self.data_name] + [n for n, _ in self.init_states],
+            [mx.nd.array(data)] + list(self.init_state_arrays),
+            [self.label_name], [mx.nd.array(label)],
+            bucket_key=T, effective_sample_count=nframes)
+        batch.is_pad = list(self._is_pad)
+        return batch

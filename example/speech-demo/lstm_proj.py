@@ -1,0 +1,132 @@
+"""Projection LSTM (LSTMP) acoustic-model graphs.
+
+Capability port of the reference example/speech-demo/lstm_proj.py:1 — the
+Sak et al. (2014) LSTMP architecture used for large-vocabulary acoustic
+modeling: peephole connections (diagonal cell-to-gate weights) plus a
+linear recurrent projection that shrinks the recurrent state from
+``num_hidden`` to ``num_proj``.
+
+Variable names follow the reference's checkpoint layout
+(``l%d_i2h_weight``, ``l%d_ph2h_weight``, ``cls_weight``, ...) so
+`.params` files round-trip between the two frameworks.  The graph itself
+is built per bucket and the whole unrolled sequence compiles into ONE XLA
+program per bucket (BucketingModule caches executors per seq_len), so the
+time loop costs no Python dispatch at run time.
+"""
+import sys
+from collections import namedtuple
+
+import mxnet_tpu as mx
+
+ProjLSTMState = namedtuple("ProjLSTMState", ["c", "h"])
+
+
+class _LayerParams(object):
+    """Weight variables for one LSTMP layer, created once and shared by
+    every timestep of the unrolled graph."""
+
+    def __init__(self, layeridx, num_hidden):
+        n = "l%d_" % layeridx
+        self.i2h_weight = mx.sym.Variable(n + "i2h_weight")
+        self.i2h_bias = mx.sym.Variable(n + "i2h_bias")
+        self.h2h_weight = mx.sym.Variable(n + "h2h_weight")
+        self.ph2h_weight = mx.sym.Variable(n + "ph2h_weight")
+        # peepholes: diagonal cell->gate connections, stored (1, H) and
+        # broadcast over the batch
+        self.c2i = mx.sym.Variable(n + "c2i_bias", shape=(1, num_hidden))
+        self.c2f = mx.sym.Variable(n + "c2f_bias", shape=(1, num_hidden))
+        self.c2o = mx.sym.Variable(n + "c2o_bias", shape=(1, num_hidden))
+
+
+def _step(x, state, p, num_hidden, num_proj, prefix, dropout=0.0):
+    """One LSTMP timestep: 4-way gate projection, peepholes on i/f from
+    c_{t-1} and on o from c_t, then the recurrent projection."""
+    if dropout > 0.0:
+        x = mx.sym.Dropout(x, p=dropout)
+    gates = mx.sym.FullyConnected(
+        x, weight=p.i2h_weight, bias=p.i2h_bias, num_hidden=num_hidden * 4,
+        name=prefix + "_i2h")
+    gates = gates + mx.sym.FullyConnected(
+        state.h, weight=p.h2h_weight, no_bias=True,
+        num_hidden=num_hidden * 4, name=prefix + "_h2h")
+    gi, gt, gf, go = mx.sym.SliceChannel(
+        gates, num_outputs=4, name=prefix + "_slice")
+
+    i = mx.sym.Activation(gi + mx.sym.broadcast_mul(p.c2i, state.c),
+                          act_type="sigmoid")
+    f = mx.sym.Activation(gf + mx.sym.broadcast_mul(p.c2f, state.c),
+                          act_type="sigmoid")
+    c = f * state.c + i * mx.sym.Activation(gt, act_type="tanh")
+    o = mx.sym.Activation(go + mx.sym.broadcast_mul(p.c2o, c),
+                          act_type="sigmoid")
+    h = o * mx.sym.Activation(c, act_type="tanh")
+    if num_proj > 0:
+        h = mx.sym.FullyConnected(h, weight=p.ph2h_weight, no_bias=True,
+                                  num_hidden=num_proj,
+                                  name=prefix + "_ph2h")
+    return ProjLSTMState(c=c, h=h)
+
+
+def proj_lstm_unroll(num_layers, seq_len, feat_dim, num_hidden, num_label,
+                     num_proj=0, dropout=0.0, output_states=False,
+                     take_softmax=True):
+    """Unrolled stacked-LSTMP graph over ``seq_len`` frames.
+
+    Frame labels use 0 as the padding id; SoftmaxOutput runs with
+    ignore_label=0 so padded frames contribute no gradient (reference
+    lstm_proj.py:121).  With ``output_states`` the final (c, h) of every
+    layer is emitted behind BlockGrad for truncated-BPTT state carry.
+    """
+    params = [_LayerParams(i, num_hidden) for i in range(num_layers)]
+    states = [ProjLSTMState(c=mx.sym.Variable("l%d_init_c" % i),
+                            h=mx.sym.Variable("l%d_init_h" % i))
+              for i in range(num_layers)]
+
+    frames = mx.sym.SliceChannel(mx.sym.Variable("data"),
+                                 num_outputs=seq_len, squeeze_axis=1)
+    outputs = []
+    for t in range(seq_len):
+        h = frames[t]
+        for i in range(num_layers):
+            states[i] = _step(h, states[i], params[i], num_hidden, num_proj,
+                              "t%d_l%d" % (t, i),
+                              dropout=dropout if i > 0 else 0.0)
+            h = states[i].h
+        if dropout > 0.0:
+            h = mx.sym.Dropout(h, p=dropout)
+        outputs.append(h)
+
+    feat = mx.sym.Reshape(mx.sym.Concat(*outputs, dim=1),
+                          target_shape=(0, num_proj or num_hidden))
+    pred = mx.sym.FullyConnected(
+        feat, weight=mx.sym.Variable("cls_weight"),
+        bias=mx.sym.Variable("cls_bias"), num_hidden=num_label, name="pred")
+    if take_softmax:
+        label = mx.sym.Reshape(mx.sym.Variable("softmax_label"), shape=(-1,))
+        out = mx.sym.SoftmaxOutput(pred, label=label, ignore_label=0,
+                                   use_ignore=True, name="softmax")
+    else:
+        out = pred
+
+    if output_states:
+        # all c's then all h's — the same ordering init_state_shapes uses
+        # for the iterator's state arrays, so outputs[1+i] pairs with
+        # init_state_arrays[i] in the state-forwarding copy loop
+        tails = [mx.sym.BlockGrad(s.c, name="l%d_last_c" % i)
+                 for i, s in enumerate(states)]
+        tails += [mx.sym.BlockGrad(s.h, name="l%d_last_h" % i)
+                  for i, s in enumerate(states)]
+        out = mx.sym.Group([out] + tails)
+    return out
+
+
+def init_state_shapes(num_layers, batch_size, num_hidden, num_proj=0):
+    """(name, shape) pairs for the carried states — c is always H wide,
+    h is the projection width when projecting."""
+    shapes = []
+    for i in range(num_layers):
+        shapes.append(("l%d_init_c" % i, (batch_size, num_hidden)))
+    for i in range(num_layers):
+        shapes.append(("l%d_init_h" % i,
+                       (batch_size, num_proj or num_hidden)))
+    return shapes

@@ -1,0 +1,54 @@
+"""speechSGD — momentum SGD whose LR schedule also drives the momentum.
+
+Capability port of the reference example/speech-demo/speechSGD.py:1: the
+acoustic-model recipe anneals (learning_rate, momentum) together through
+a scheduler that returns a tuple, and the update uses the momentum-corrected form
+``mom = m*prev - lr*(1-m)*grad``, which keeps the effective step size
+stable as momentum changes mid-training.
+"""
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+@mx.optimizer.register
+class speechSGD(mx.optimizer.Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super(speechSGD, self).__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def _get_lr_momentum(self, index):
+        if self.lr_scheduler is not None:
+            sched = self.lr_scheduler(self.num_update)
+            lr, momentum = sched if isinstance(sched, tuple) \
+                else (sched, self.momentum)
+        else:
+            lr, momentum = self.lr, self.momentum
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr, momentum
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, momentum = self._get_lr_momentum(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            # momentum-corrected form: the fresh-gradient term is scaled
+            # by (1 - momentum) so the steady-state step size stays
+            # lr*grad as momentum anneals (reference speechSGD.py:100)
+            state[:] = momentum * state \
+                - lr * (1.0 - momentum) * (grad + wd * weight)
+            weight[:] = weight + state
+        else:
+            weight[:] = weight - lr * (grad + wd * weight)

@@ -1,0 +1,279 @@
+"""Train a projection-LSTM acoustic model on utterance feature streams.
+
+Capability port of the reference example/speech-demo/train_lstm_proj.py:1
+— both of its training regimes:
+
+- ``method = bucketing``: whole utterances bucketed by length through a
+  BucketingModule (one cached executor per bucket).
+- ``method = truncated-bptt``: fixed-length windows over packed utterance
+  streams with cross-batch state forwarding (the model emits its final
+  c/h behind BlockGrad; the loop copies them into the iterator's init
+  state arrays).
+
+Training control matches the reference recipe: frame cross-entropy and
+accuracy excluding padding (label 0), a dev-set-driven LR schedule that
+halves the rate AND reverts the epoch when dev cross-entropy worsens,
+and the speechSGD optimizer whose scheduler anneals (lr, momentum)
+together.
+
+Config-file driven like the reference (``--config default.cfg``,
+overridable per-key with ``--section.key value``).  The feature source is
+a synthetic coarticulated corpus (io_util.synthetic_corpus) — this
+environment has no Kaldi and no egress; plug a real reader in by
+replacing ``load_data``.
+"""
+import argparse
+import configparser
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+import speechSGD  # noqa: F401 — registers the optimizer
+from io_util import (BucketSpeechIter, TruncatedSpeechIter,
+                     synthetic_corpus)
+from lstm_proj import init_state_shapes, proj_lstm_unroll
+
+DEFAULT_CFG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "default.cfg")
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(
+        description="LSTMP acoustic model trainer",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    ap.add_argument("--config", default=DEFAULT_CFG,
+                    help="config file (reference default.cfg layout)")
+    args, overrides = ap.parse_known_args()
+    config = configparser.ConfigParser()
+    config.read(args.config)
+    # --train.num_epoch 2 style per-key overrides
+    it = iter(overrides)
+    for key in it:
+        val = next(it, None)
+        if not key.startswith("--") or "." not in key or val is None:
+            raise SystemExit("override must be --section.key value: %r" % key)
+        sec, opt = key[2:].split(".", 1)
+        config.set(sec, opt, val)
+    args.config = config
+    return args
+
+
+def frame_cross_entropy(labels, preds):
+    """Summed CE over non-padding frames; label 0 is padding
+    (reference train_lstm_proj.py CrossEntropy)."""
+    labels = labels.reshape(-1).astype(np.int64)
+    preds = preds.reshape(-1, preds.shape[-1])
+    keep = labels > 0
+    if not keep.any():
+        return 0.0, 0
+    p = preds[keep, labels[keep]]
+    return float(-np.log(np.maximum(p, 1e-10)).sum()), int(keep.sum())
+
+
+def frame_accuracy(labels, preds):
+    """Frame accuracy excluding padding (Acc_exclude_padding)."""
+    labels = labels.reshape(-1).astype(np.int64)
+    preds = preds.reshape(-1, preds.shape[-1])
+    keep = labels > 0
+    if not keep.any():
+        return 0.0, 0
+    return float((preds[keep].argmax(1) == labels[keep]).sum()), \
+        int(keep.sum())
+
+
+class AnnealingScheduler(mx.lr_scheduler.LRScheduler):
+    """Returns the externally-set (dynamic_lr / effective_sample_count)
+    — and for speechSGD a (lr, momentum) tuple (reference
+    SimpleLRScheduler)."""
+
+    def __init__(self, dynamic_lr, momentum=0.9, tuple_mode=False):
+        super(AnnealingScheduler, self).__init__()
+        self.dynamic_lr = dynamic_lr
+        self.momentum = momentum
+        self.effective_sample_count = 1
+        self.tuple_mode = tuple_mode
+
+    def __call__(self, num_update):
+        lr = self.dynamic_lr / self.effective_sample_count
+        return (lr, self.momentum) if self.tuple_mode else lr
+
+
+def load_data(cfg):
+    feat_dim = cfg.getint("data", "xdim")
+    num_label = cfg.getint("data", "ydim")
+    n_train = cfg.getint("data", "num_train_utts", fallback=400)
+    n_dev = cfg.getint("data", "num_dev_utts", fallback=80)
+    utts = synthetic_corpus(n_train + n_dev, feat_dim=feat_dim,
+                            num_label=num_label,
+                            max_len=cfg.getint("data", "max_len",
+                                               fallback=160))
+    return utts[:n_train], utts[n_train:], feat_dim, num_label
+
+
+def score(module, data_val, tbptt=False):
+    """Dev pass; with tbptt also forwards states across batches."""
+    data_val.reset()
+    totals = np.zeros(4)  # ce_sum, ce_n, acc_sum, acc_n
+    for batch in data_val:
+        module.forward(batch, is_train=False)
+        outputs = module.get_outputs()
+        preds = outputs[0].asnumpy()
+        labels = batch.label[0].asnumpy()
+        ce, n1 = frame_cross_entropy(labels, preds)
+        acc, n2 = frame_accuracy(labels, preds)
+        totals += [ce, n1, acc, n2]
+        if tbptt:
+            for i in range(1, len(outputs)):
+                outputs[i].copyto(data_val.init_state_arrays[i - 1])
+    return totals[0] / max(totals[1], 1), totals[2] / max(totals[3], 1)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    cfg = parse_args().config
+
+    method = cfg.get("train", "method")
+    batch_size = cfg.getint("train", "batch_size")
+    num_hidden = cfg.getint("arch", "num_hidden")
+    num_proj = cfg.getint("arch", "num_hidden_proj")
+    num_layers = cfg.getint("arch", "num_lstm_layer")
+
+    train_utts, dev_utts, feat_dim, num_label = load_data(cfg)
+    init_states = init_state_shapes(num_layers, batch_size, num_hidden,
+                                    num_proj)
+    state_names = [n for n, _ in init_states]
+
+    optimizer = cfg.get("train", "optimizer")
+    momentum = cfg.getfloat("train", "momentum")
+    scheduler = AnnealingScheduler(
+        cfg.getfloat("train", "learning_rate"), momentum=momentum,
+        tuple_mode=(optimizer == "speechSGD"))
+
+    tbptt = method == "truncated-bptt"
+    if tbptt:
+        truncate_len = cfg.getint("train", "truncate_len")
+        data_train = TruncatedSpeechIter(
+            train_utts, batch_size, init_states, truncate_len, feat_dim)
+        data_val = TruncatedSpeechIter(
+            dev_utts, batch_size, init_states, truncate_len, feat_dim,
+            shuffle=False, pad_zeros=True)
+        sym = proj_lstm_unroll(num_layers, truncate_len, feat_dim,
+                               num_hidden, num_label, num_proj=num_proj,
+                               output_states=True)
+        module = mx.mod.Module(sym, data_names=["data"] + state_names,
+                               label_names=["softmax_label"])
+    elif method == "bucketing":
+        buckets = [int(b) for b in
+                   cfg.get("train", "buckets").replace(",", " ").split()]
+        data_train = BucketSpeechIter(train_utts, buckets, batch_size,
+                                      init_states, feat_dim)
+        data_val = BucketSpeechIter(dev_utts, buckets, batch_size,
+                                    init_states, feat_dim, shuffle=False)
+
+        def sym_gen(seq_len):
+            sym = proj_lstm_unroll(num_layers, seq_len, feat_dim,
+                                   num_hidden, num_label,
+                                   num_proj=num_proj)
+            return sym, ["data"] + state_names, ["softmax_label"]
+
+        module = mx.mod.BucketingModule(
+            sym_gen, default_bucket_key=data_train.default_bucket_key)
+    else:
+        raise SystemExit("unknown train.method %r" % method)
+
+    module.bind(data_shapes=data_train.provide_data,
+                label_shapes=data_train.provide_label, for_training=True)
+    module.init_params(mx.initializer.Uniform(
+        cfg.getfloat("train", "init_scale")))
+
+    clip = cfg.getfloat("train", "clip_gradient") or None
+
+    def reset_optimizer():
+        module.init_optimizer(
+            kvstore="device", optimizer=optimizer,
+            optimizer_params={"lr_scheduler": scheduler,
+                              "momentum": momentum,
+                              "rescale_grad": 1.0,
+                              "clip_gradient": clip,
+                              "wd": cfg.getfloat("train", "weight_decay")},
+            force_init=True)
+
+    reset_optimizer()
+    num_epoch = cfg.getint("train", "num_epoch")
+    decay_factor = cfg.getfloat("train", "decay_factor")
+    decay_bound = cfg.getfloat("train", "decay_lower_bound")
+    show_every = cfg.getint("train", "show_every")
+
+    ckpt_prefix = cfg.get("train", "checkpoint_prefix",
+                          fallback=os.path.join(
+                              os.path.dirname(DEFAULT_CFG), "checkpoints",
+                              "lstm_proj"))
+    os.makedirs(os.path.dirname(ckpt_prefix), exist_ok=True)
+
+    best_ce = float("inf")
+    best_params = None
+    epoch = 0
+    while epoch < num_epoch:
+        tic = time.time()
+        totals = np.zeros(4)
+        data_train.reset()
+        for nbatch, batch in enumerate(data_train):
+            # SoftmaxOutput sums the frame gradients; normalize the step by
+            # the frames that actually contributed (reference
+            # train_lstm_proj.py:191 — tbptt uses batch*truncate_len; we
+            # use the batch's true non-pad count for both regimes, which
+            # is the same quantity minus padding)
+            scheduler.effective_sample_count = max(
+                batch.effective_sample_count or 1, 1)
+            module.forward_backward(batch)
+            module.update()
+            preds = module.get_outputs()[0].asnumpy()
+            labels = batch.label[0].asnumpy()
+            ce, n1 = frame_cross_entropy(labels, preds)
+            acc, n2 = frame_accuracy(labels, preds)
+            totals += [ce, n1, acc, n2]
+            if tbptt:
+                outputs = module.get_outputs()
+                for i in range(1, len(outputs)):
+                    outputs[i].copyto(data_train.init_state_arrays[i - 1])
+            if show_every and nbatch % show_every == 0:
+                logging.info("Epoch[%d] Batch[%d] CE=%.4f Acc=%.4f",
+                             epoch, nbatch, totals[0] / max(totals[1], 1),
+                             totals[2] / max(totals[3], 1))
+        logging.info("Epoch[%d] Train-CE=%.4f Train-Acc=%.4f Time=%.1fs",
+                     epoch, totals[0] / max(totals[1], 1),
+                     totals[2] / max(totals[3], 1), time.time() - tic)
+
+        dev_ce, dev_acc = score(module, data_val, tbptt=tbptt)
+        logging.info("Epoch[%d] Dev-CE=%.4f Dev-Acc=%.4f",
+                     epoch, dev_ce, dev_acc)
+
+        if epoch > 0 and dev_ce > best_ce and \
+                scheduler.dynamic_lr > decay_bound:
+            logging.info("Epoch[%d] dev CE worsened — reverting epoch, "
+                         "LR %g -> %g", epoch, scheduler.dynamic_lr,
+                         scheduler.dynamic_lr / decay_factor)
+            scheduler.dynamic_lr /= decay_factor
+            reset_optimizer()   # momentum may have exploded; start fresh
+            module.set_params(*best_params)
+        else:
+            best_ce, best_params = dev_ce, module.get_params()
+            epoch += 1
+            mx.model.save_checkpoint(ckpt_prefix, epoch, module.symbol,
+                                     *best_params)
+
+    logging.info("Finished: best Dev-CE=%.4f", best_ce)
+    return best_ce
+
+
+if __name__ == "__main__":
+    main()

@@ -435,10 +435,17 @@ def infer_reshape(in_shape, target, reverse=False):
 
 @register("Reshape", aliases=("reshape",))
 def reshape(data, shape=(), reverse=False, target_shape=None, keep_highest=False):
-    if target_shape:  # legacy attr (matrix_op-inl.h legacy target_shape)
+    if target_shape:  # legacy attr (matrix_op-inl.h:144-161): exactly one
+        # 0 entry is INFERRED from the rest (unlike new-style shape,
+        # where 0 copies the input dim); keep_highest pins dim0
         tgt = list(_tuple(target_shape))
+        start = 0
         if keep_highest:
             tgt[0] = data.shape[0]
+            start = 1
+        zeros = [i for i in range(start, len(tgt)) if tgt[i] == 0]
+        if len(zeros) == 1:
+            tgt[zeros[0]] = -1
         return jnp.reshape(data, tuple(tgt))
     return jnp.reshape(data, infer_reshape(data.shape, _tuple(shape), reverse))
 
