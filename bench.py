@@ -30,7 +30,7 @@ reaches its single TPU chip through a tunneled remote-device link with
 Interleaving per-batch host->device uploads with train-step launches is
 therefore latency-bound HERE in a way it is not on a directly-attached
 TPU host: the same pipeline sustains >3,000 img/s of decode (single
-core), and the same train step sustains >12,000 img/s when batches are
+core), and the same train step sustains ~2,300 img/s when batches are
 staged — the fed number reflects the link, not the framework.  Each
 metric runs in its own subprocess (see _collect).
 

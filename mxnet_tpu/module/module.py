@@ -422,9 +422,33 @@ class Module(BaseModule):
                 return None
             mesh = Mesh(_np.asarray(devs), ("dp",))
         elif len(self._context) > 1:
-            # single-process multi-device stays on the executor-group path
-            # (it already data-parallelizes across the contexts)
-            return None
+            # single-process multi-device: kvstore='tpu' + a context list
+            # runs ONE fused step dp-sharded over exactly those devices
+            # (the SPMD analog of the reference's executor-group fan-out
+            # over context=[gpu(0..k)]); indivisible batches fall back to
+            # the executor-group path
+            if self._exec_group.batch_size % len(self._context) != 0:
+                self.logger.info(
+                    "kvstore '%s': batch %d not divisible by %d contexts; "
+                    "falling back to the executor-group path",
+                    kvstore.type, self._exec_group.batch_size,
+                    len(self._context))
+                return None
+            try:
+                devs = [c.jax_device for c in self._context]
+            except Exception:
+                self.logger.info(
+                    "kvstore '%s': context list not mappable to devices; "
+                    "falling back to the executor-group path", kvstore.type)
+                return None
+            if len(set(devs)) != len(devs):
+                # duplicated contexts (the reference idiom for
+                # oversubscribing one device) cannot form a Mesh
+                self.logger.info(
+                    "kvstore '%s': duplicate devices in context list; "
+                    "falling back to the executor-group path", kvstore.type)
+                return None
+            mesh = Mesh(_np.asarray(devs), ("dp",))
         else:
             mesh = None
 

@@ -235,6 +235,50 @@ def test_module_fused_tpu_kvstore():
     assert acc["accuracy"] > 0.9, acc
 
 
+def test_module_fused_tpu_kvstore_multi_context():
+    """kvstore='tpu' + a context LIST engages the fused step dp-sharded
+    over exactly those devices (the SPMD analog of the reference's
+    executor-group fan-out over context=[gpu(0..k)]), and matches the
+    single-device fused numerics."""
+    X, y = make_blobs(256, 10, 3, seed=5)
+
+    def run(ctxs):
+        it = mx.io.NDArrayIter(X, y, batch_size=64)
+        mod = mx.mod.Module(mlp_sym(), context=ctxs)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mx.random.seed(11)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+        return mod, {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    mod4, fused4 = run([mx.cpu(i) for i in range(4)])
+    assert mod4._fused is not None and mod4._fused.mesh is not None
+    assert mod4._fused.mesh.devices.size == 4
+    _, fused1 = run(mx.cpu(0))
+    for name in fused1:
+        np.testing.assert_allclose(fused4[name], fused1[name], rtol=2e-4,
+                                   atol=2e-5, err_msg=name)
+    # indivisible batch falls back to the executor-group path, still works
+    it = mx.io.NDArrayIter(X[:99], y[:99], batch_size=33)
+    mod3 = mx.mod.Module(mlp_sym(), context=[mx.cpu(i) for i in range(2)])
+    mod3.fit(it, num_epoch=1, kvstore="tpu", optimizer="sgd",
+             optimizer_params={"learning_rate": 0.1})
+    assert mod3._fused is None
+    # duplicated contexts (reference oversubscription idiom) also fall
+    # back instead of crashing in Mesh/device_put
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod_dup = mx.mod.Module(mlp_sym(), context=[mx.cpu(0), mx.cpu(0)])
+    mod_dup.fit(it, num_epoch=1, kvstore="tpu", optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+    assert mod_dup._fused is None
+
+
 def test_module_fused_matches_local_path():
     """Fused (kvstore='tpu') and executor (kvstore=None) training runs from
     identical inits produce near-identical weights: the TPU-native fast

@@ -1,0 +1,242 @@
+"""Collective-overlap evidence for the grad_sync='zero' fused dist step.
+
+Compiles the ResNet-50 weight-sharded-DP training step with the REAL
+TPU compilation pipeline via AOT topology compilation
+(jax.experimental.topologies, v5e:2x4 — no chips needed) and analyzes
+the post-scheduling HLO:
+
+- gradient sync is emitted as **bucketed all-reduce-scatter** fusions
+  (XLA's combiner groups several layer grads per bucket, bf16);
+- parameter gathers are bf16 all-gathers (the FSDP mixed-precision comm
+  discipline — the f32 master is cast before gathering);
+- the latency-hiding scheduler splits collectives into async
+  start/done pairs with independent compute fusions SCHEDULED BETWEEN
+  them — counted per pair below.  This is the on-silicon schedule the
+  TPU runtime executes, not a dependence-order argument.
+
+Falls back to the 8-device virtual CPU mesh (correctness-only pipeline:
+sync collectives, no scheduler) when topology AOT is unavailable.
+
+Writes docs/profiles/dist_step_zero_hlo_r05.txt and prints a JSON
+summary line.
+"""
+import json
+import os
+import re
+import statistics
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _build_trainer(mesh, batch, side):
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    sym = models.get_symbol("resnet-50", num_classes=100)
+    trainer = SPMDTrainer(
+        sym, "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+        mesh=mesh, compute_dtype="bfloat16", grad_sync="zero")
+    trainer.bind([("data", (batch, 3, side, side))],
+                 [("softmax_label", (batch,))])
+    return trainer
+
+
+def lower_tpu(batch=64, side=224):
+    """AOT-compile for a v5e 2x4 slice: the actual TPU pass pipeline
+    (ReduceScatter creation, collective combiner, latency-hiding
+    scheduler) with no chips attached."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x4")
+    mesh = Mesh(np.array(topo.devices), ("dp",))
+    tr = _build_trainer(mesh, batch, side)
+
+    def sds(shape, dtype, spec):
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    params = {k: sds(tr.arg_shapes[k], np.float32,
+                     tr._param_spec(k, tr.arg_shapes[k]))
+              for k in tr.param_names}
+    aux = {k: sds(tr.aux_shapes[k], np.float32, P())
+           for k in tr.aux_names}
+    opt_state = {k: (sds(tr.arg_shapes[k], np.float32,
+                         tr._param_spec(k, tr.arg_shapes[k])),)
+                 for k in tr.param_names}
+    data = {"data": sds((batch, 3, side, side), jnp.bfloat16, P("dp")),
+            "softmax_label": sds((batch,), jnp.bfloat16, P("dp"))}
+    rng = jax.ShapeDtypeStruct((2,), np.uint32)
+    scalar = jax.ShapeDtypeStruct((), np.float32)
+    lowered = tr._step_fn.lower(params, aux, opt_state, data, rng,
+                                scalar, scalar, 1)
+    return lowered.compile().as_text(), "tpu-aot v5e:2x4"
+
+
+def lower_cpu(batch=8, side=64):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import random as _random
+    from mxnet_tpu.parallel import local_mesh
+
+    tr = _build_trainer(local_mesh("dp"), batch, side)
+    mx.random.seed(7)
+    tr.init_params(mx.initializer.Xavier())
+    X = np.random.RandomState(0).rand(batch, 3, side, side).astype("f")
+    y = np.random.RandomState(1).randint(0, 100, batch).astype("f")
+    data = tr._shard_batch((X, y))
+    lowered = tr._step_fn.lower(
+        tr.params, tr.aux, tr.opt_state, data, _random.peek_key(),
+        jnp.asarray(0.1, jnp.float32), jnp.asarray(0.0, jnp.float32), 1)
+    return lowered.compile().as_text(), "cpu virtual 8-mesh"
+
+
+def _op_of(line):
+    if "=" not in line:
+        return None, None
+    name = re.match(r"\s*%?([\w.\-]+)\s*=", line)
+    body = line.split("=", 1)[1]
+    op = re.search(r"([a-z][a-z0-9\-]*)\(", body)
+    return (name.group(1) if name else None), (op.group(1) if op else None)
+
+
+def analyze(hlo):
+    m = re.search(r"ENTRY [^{]+\{(.*)\n\}", hlo, re.S)
+    lines = (m.group(1) if m else hlo).splitlines()
+
+    counts = {}
+    for ln in lines:
+        _, op = _op_of(ln)
+        if op:
+            counts[op] = counts.get(op, 0) + 1
+
+    # async pairs: compute fusions scheduled between start and done.
+    # Collectives (the overlap claim) are counted separately from async
+    # host/device DMAs (copy-start/slice-start — a different mechanism).
+    COLLECTIVE_STARTS = ("collective-permute-start", "all-gather-start",
+                         "all-reduce-start", "reduce-scatter-start")
+    pairs = {}
+    spans = []
+    dma_pairs = 0
+    for i, ln in enumerate(lines):
+        name, op = _op_of(ln)
+        if op and op.endswith("-start"):
+            pairs[name] = (i, op)
+        elif op and op.endswith("-done"):
+            ref = re.search(r"-done\(\s*%?([\w.\-]+)", ln)
+            if ref and ref.group(1) in pairs:
+                s, sop = pairs.pop(ref.group(1))
+                if sop not in COLLECTIVE_STARTS:
+                    dma_pairs += 1
+                    continue
+                between = lines[s + 1:i]
+                nfus = sum(1 for b in between
+                           if _op_of(b)[1] in ("fusion", "convolution"))
+                spans.append({"op": sop, "span": i - s,
+                              "compute_between": nfus})
+
+    # bucketed reduce-scatter: kCustom fusions calling all-reduce-scatter
+    buckets = []
+    for ln in lines:
+        if "calls=%all-reduce-scatter" in ln:
+            shapes = re.findall(r"(?:bf16|f32)\[[^\]]*\]", ln.split("=")[1]
+                                .split("fusion(")[0])
+            buckets.append(shapes)
+    rs_plain = counts.get("reduce-scatter", 0)
+
+    ag_dtypes = {}
+    for ln in lines:
+        _, op = _op_of(ln)
+        if op in ("all-gather", "all-gather-start"):
+            dm = re.search(r"=\s*\(?\s*([a-z0-9]+)\[", ln)
+            if dm:
+                ag_dtypes[dm.group(1)] = ag_dtypes.get(dm.group(1), 0) + 1
+
+    overlapped = [s for s in spans if s["compute_between"] > 0]
+    return {
+        "n_async_dma_pairs": dma_pairs,
+        "entry_instructions": len(lines),
+        "op_counts": {k: v for k, v in sorted(counts.items())
+                      if "all-" in k or "collective" in k
+                      or "reduce-scatter" in k or k in ("fusion",
+                                                        "convolution")},
+        "n_async_pairs": len(spans),
+        "n_async_pairs_with_compute_between": len(overlapped),
+        "compute_ops_inside_collective_windows": sum(
+            s["compute_between"] for s in spans),
+        "median_compute_between": (statistics.median(
+            [s["compute_between"] for s in spans]) if spans else 0),
+        "n_bucketed_reduce_scatter_fusions": len(buckets),
+        "bucket_tensor_counts": [len(b) for b in buckets],
+        "bucket_example_shapes": buckets[0] if buckets else [],
+        "n_plain_reduce_scatter": rs_plain,
+        "all_gather_dtypes": ag_dtypes,
+        "async_spans": spans,
+    }
+
+
+def main():
+    try:
+        hlo, pipeline = lower_tpu()
+    except Exception as e:  # noqa: BLE001 — no topology support
+        sys.stderr.write("TPU AOT unavailable (%s); falling back to the "
+                         "CPU virtual mesh\n" % e)
+        hlo, pipeline = lower_cpu()
+    a = analyze(hlo)
+    a["pipeline"] = pipeline
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "..", "docs", "profiles",
+                            "dist_step_zero_hlo_r05.txt")
+    with open(out_path, "w") as f:
+        f.write(
+            "Collective scheduling in the compiled grad_sync='zero' "
+            "ResNet-50 dist step\n"
+            "Pipeline: %s (tools/dist_schedule_evidence.py)\n\n"
+            "What this shows: the post-scheduling HLO the TPU runtime "
+            "executes.  Async\ncollective start/done pairs with compute "
+            "fusions scheduled between them ARE\nthe latency-hiding "
+            "scheduler overlapping comm with compute; "
+            "all-reduce-scatter\nkCustom fusions with several gradient "
+            "tensors are XLA's bucketed gradient\nreduce-scatter; bf16 "
+            "all-gathers show the mixed-precision gather of the f32\n"
+            "master params.\n\nSummary:\n" % pipeline)
+        for k in ("entry_instructions", "n_async_pairs",
+                  "n_async_pairs_with_compute_between",
+                  "compute_ops_inside_collective_windows",
+                  "median_compute_between",
+                  "n_bucketed_reduce_scatter_fusions",
+                  "bucket_tensor_counts", "bucket_example_shapes",
+                  "n_plain_reduce_scatter", "all_gather_dtypes",
+                  "op_counts"):
+            f.write("  %s: %s\n" % (k, a[k]))
+        f.write("\nAsync spans (op, schedule distance, compute between):\n")
+        for s in a["async_spans"]:
+            f.write("  %-28s span %5d  compute_between %4d\n"
+                    % (s["op"], s["span"], s["compute_between"]))
+    summary = {k: a[k] for k in
+               ("pipeline", "n_async_pairs",
+                "n_async_pairs_with_compute_between",
+                "compute_ops_inside_collective_windows",
+                "n_bucketed_reduce_scatter_fusions",
+                "n_plain_reduce_scatter")}
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

@@ -108,22 +108,25 @@ def collective_bandwidth():
 
 
 def measured_overlap_model():
-    """tools/overlap_model.py at the four (wall-clock x ICI-credit)
-    corners: allreduce laid onto the MEASURED per-layer backward timeline
-    from the committed on-chip ResNet-50 profile (round 3's assumed
-    1.6 ms window replaced; see docs/scaling_model.md for what is
-    measured vs structural vs calibrated)."""
+    """tools/overlap_model.py at the (wall-clock x ICI-credit) corners,
+    allreduce AND grad_sync='zero' timelines laid onto the MEASURED
+    per-layer fwd/bwd windows from the committed on-chip ResNet-50
+    profile.  Round-5 corners: 13.9 ms is the true fetch-synced step
+    (matches the profiled span — the round-2..4 "2.4-2.9 ms wall" was
+    the broken dispatch-rate sync); 4.0 ms is a peak-MFU STRESS step
+    (what b32 would take at 100% MFU — comm windows shrink 3.5x), kept
+    so the claim is not carried by a low-MFU denominator alone."""
     corners = {}
-    for wall in ("2.4", "2.9"):
+    for wall, tag in (("13.9", "measured"), ("4.0", "stress_peak_mfu")):
         for bw in ("45", "90"):
             res = _run([PY, os.path.join("tools", "overlap_model.py")],
                        env_extra={"OVERLAP_WALL_STEP_MS": wall,
                                   "OVERLAP_ICI_GBPS": bw})
             try:
                 # overlap_model prints ONE pretty-printed JSON object
-                corners["wall%s_bw%s" % (wall, bw)] = json.loads(res.stdout)
+                corners["%s_bw%s" % (tag, bw)] = json.loads(res.stdout)
             except ValueError:
-                corners["wall%s_bw%s" % (wall, bw)] = {
+                corners["%s_bw%s" % (tag, bw)] = {
                     "error": (res.stderr or res.stdout)[-400:]}
     return corners
 
@@ -144,7 +147,7 @@ def allreduce_ablation(nproc=8):
     return {"error": (res.stderr or res.stdout)[-400:]}
 
 
-def analytic_model(measured_step_ms=2.4):
+def analytic_model(measured_step_ms=13.9):
     params_m = 25.56e6
     v_bf16 = params_m * 2
     ici_axis_bw = 2 * 45e9  # one torus axis, bidirectional
@@ -177,19 +180,90 @@ def analytic_model(measured_step_ms=2.4):
     return out
 
 
+def schedule_evidence():
+    """tools/dist_schedule_evidence.py summary: the real-TPU-pipeline
+    (AOT v5e:2x4) compiled zero step with async collectives overlapping
+    compute and bucketed all-reduce-scatter gradient fusions."""
+    res = _run([PY, os.path.join("tools", "dist_schedule_evidence.py")],
+               timeout=1200)
+    got = _json_lines(res.stdout)
+    if got:
+        out = got[-1]
+        out["artifact"] = "docs/profiles/dist_step_zero_hlo_r05.txt"
+        return out
+    return {"error": (res.stderr or res.stdout)[-400:]}
+
+
+def _headline(art):
+    """The numbers this artifact actually claims, stated first so the
+    harness-bound rows below cannot be misread as framework properties
+    (round-4 verdict: re-headline)."""
+    h = {
+        "claim_1_modeled_n64_efficiency": {},
+        "claim_2_partitioning_overhead": None,
+        "claim_3_schedule_overlap": None,
+        "host_artifact_rows": [
+            "virtual_mesh_weak_scaling.rows[*].images_per_sec_per_device "
+            "(falls ~1/N on a 1-core host BY CONSTRUCTION; the invariant "
+            "is total_vs_1dev ~= 1.0)",
+            "multiproc_weak_scaling.rows[*].step_time_vs_1proc (grows ~N "
+            "on one core BY CONSTRUCTION; records the 8-process cluster "
+            "executing the fused dist step CORRECTLY)",
+        ],
+    }
+    corners = art.get("measured_overlap_model", {})
+    for corner_key, step_label in (("measured_bw90", "13.9ms measured"),
+                                   ("stress_peak_mfu_bw90",
+                                    "4.0ms peak-MFU stress")):
+        corner = corners.get(corner_key)
+        if not isinstance(corner, dict):
+            continue
+        for key, label in (("n64_zero_conservative",
+                            "zero @45GBps one-way"),
+                           ("n64_conservative",
+                            "allreduce @45GBps one-way"),
+                           ("n64_zero", "zero @90GBps bidir"),
+                           ("n64", "allreduce @90GBps bidir")):
+            row = corner.get(key)
+            if row:
+                h["claim_1_modeled_n64_efficiency"][
+                    "%s, %s" % (step_label, label)] = \
+                    row.get("weak_scaling_efficiency")
+    vm = art.get("virtual_mesh_weak_scaling", {}).get("rows") or []
+    if vm:
+        h["claim_2_partitioning_overhead"] = (
+            "total throughput flat across 1..8 virtual devices: "
+            "total_vs_1dev = %s"
+            % [r.get("total_vs_1dev") for r in vm])
+    se = art.get("schedule_evidence", {})
+    if "n_async_pairs_with_compute_between" in se:
+        h["claim_3_schedule_overlap"] = (
+            "%d/%d async collective pairs in the TPU-pipeline-compiled "
+            "zero step have compute scheduled inside their windows "
+            "(%d fused ops total); gradient sync emitted as %d bucketed "
+            "all-reduce-scatter fusions"
+            % (se["n_async_pairs_with_compute_between"],
+               se["n_async_pairs"],
+               se["compute_ops_inside_collective_windows"],
+               se["n_bucketed_reduce_scatter_fusions"]))
+    return h
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("-o", "--output", default="SCALING_r04.json")
+    ap.add_argument("-o", "--output", default="SCALING_r05.json")
     ap.add_argument("--skip-virtual", action="store_true")
     args = ap.parse_args()
     art = {"doc": "see docs/scaling_model.md",
            "measured_overlap_model": measured_overlap_model(),
+           "schedule_evidence": schedule_evidence(),
            "allreduce_ablation_cpu8": allreduce_ablation(),
            "legacy_analytic_model": analytic_model()}
     if not args.skip_virtual:
         art["virtual_mesh_weak_scaling"] = virtual_mesh_weak_scaling()
     art["multiproc_weak_scaling"] = multiproc_weak_scaling()
     art["collective_bandwidth"] = collective_bandwidth()
+    art = {"headline": _headline(art), **art}
     with open(os.path.join(REPO, args.output), "w") as f:
         json.dump(art, f, indent=1)
     print("wrote", args.output)
