@@ -1,0 +1,227 @@
+"""Deep Embedded Clustering (DEC, Xie et al. 2016).
+
+Capability port of the reference example/dec/dec.py:1: a stacked
+autoencoder learns an embedding; cluster centers initialize from
+k-means on the embedded data; then a CUSTOM training loop alternates
+between (a) recomputing the soft assignment q (Student's-t kernel
+between embeddings and centers) and the sharpened target distribution
+p over the WHOLE dataset every ``update_interval`` batches, and (b)
+minimizing KL(p || q) by gradient steps that move both the encoder
+weights and the centers — the loss is the reference's ``DECLoss``
+NumpyOp with need_top_grad=False and hand-written backward for both
+the embedding and the centers (dec.py:29-64).
+
+MNIST (egress-unavailable) is replaced by synthetic gaussian clusters
+pushed through a fixed random nonlinearity, so the raw space is
+non-trivially entangled but the embedding is separable; clustering
+accuracy is measured with the Hungarian matching of the reference's
+``cluster_acc`` (scipy linear_sum_assignment).
+
+    python dec.py --updates 300
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "autoencoder")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+from autoencoder import AutoEncoderModel
+
+
+def cluster_acc(y_pred, y):
+    """Best-bipartite-match accuracy (reference dec.py:18)."""
+    from scipy.optimize import linear_sum_assignment
+    D = int(max(y_pred.max(), y.max())) + 1
+    w = np.zeros((D, D), np.int64)
+    for yp, yt in zip(y_pred.astype(int), y.astype(int)):
+        w[yp, yt] += 1
+    rows, cols = linear_sum_assignment(w.max() - w)
+    return w[rows, cols].sum() / float(len(y_pred))
+
+
+class DECLoss(mx.operator.NumpyOp):
+    """Soft-assignment op: forward emits q (normalized Student's-t
+    affinities to the centers); backward turns (p - q) into gradients
+    for BOTH the embedding z and the centers mu (reference
+    dec.py DECLoss)."""
+
+    def __init__(self, num_centers, alpha=1.0):
+        super(DECLoss, self).__init__(need_top_grad=False)
+        self.num_centers = num_centers
+        self.alpha = alpha
+
+    def _dist2(self, z, mu):
+        return ((z[:, None, :] - mu[None, :, :]) ** 2).sum(-1)
+
+    def forward(self, in_data, out_data):
+        z, mu = in_data[0], in_data[1]
+        self.mask = 1.0 / (1.0 + self._dist2(z, mu) / self.alpha)
+        q = self.mask ** ((self.alpha + 1.0) / 2.0)
+        out_data[0][:] = (q.T / q.sum(axis=1)).T
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        q = out_data[0]
+        z, mu, p = in_data[0], in_data[1], in_data[2]
+        m = self.mask * ((self.alpha + 1.0) / self.alpha) * (p - q)
+        in_grad[0][:] = (z.T * m.sum(axis=1)).T - m.dot(mu)
+        in_grad[1][:] = (mu.T * m.sum(axis=0)).T - m.T.dot(z)
+
+    def infer_shape(self, in_shape):
+        batch, dim = in_shape[0]
+        return ([in_shape[0], (self.num_centers, dim),
+                 (batch, self.num_centers)],
+                [(batch, self.num_centers)])
+
+    def list_arguments(self):
+        return ["data", "mu", "label"]
+
+
+def kmeans(z, k, iters=50, seed=0):
+    """Plain Lloyd's k-means (the sklearn dependency of the reference,
+    inlined)."""
+    rs = np.random.RandomState(seed)
+    centers = z[rs.choice(len(z), k, replace=False)].copy()
+    for _ in range(iters):
+        d = ((z[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for j in range(k):
+            pts = z[assign == j]
+            if len(pts):
+                centers[j] = pts.mean(0)
+    return centers, assign
+
+
+def target_distribution(q):
+    """Sharpened, frequency-normalized targets (reference refresh())."""
+    weight = 1.0 / q.sum(axis=0)
+    weight *= q.shape[1] / weight.sum()
+    p = (q ** 2) * weight
+    return (p.T / p.sum(axis=1)).T
+
+
+def synthetic_clusters(n=1024, dim=16, k=4, seed=5):
+    """Gaussian clusters pushed through a fixed random tanh layer —
+    entangled in input space, separable in a learned embedding."""
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(k, dim) * 2.2
+    X = np.concatenate([centers[i] + rs.randn(n // k, dim) * 0.7
+                        for i in range(k)]).astype(np.float32)
+    y = np.repeat(np.arange(k), n // k)
+    W = rs.randn(dim, dim) / np.sqrt(dim)
+    X = np.tanh(X @ W) + 0.05 * rs.randn(n, dim).astype(np.float32)
+    perm = rs.permutation(n)
+    return X[perm].astype(np.float32), y[perm]
+
+
+class DECModel(object):
+    def __init__(self, X, num_centers, alpha=1.0, embed_dim=8,
+                 pretrain_epochs=10, seed=0):
+        dims = [X.shape[1], 32, embed_dim]
+        self.ae = AutoEncoderModel(dims, pt_dropout=0.2, seed=seed)
+        self.ae.layerwise_pretrain(X, epochs=pretrain_epochs, lr=3e-3)
+        self.ae.finetune(X, epochs=pretrain_epochs, lr=3e-3)
+        self.num_centers = num_centers
+        self.embed_dim = embed_dim
+        self.dec_op = DECLoss(num_centers, alpha)
+
+        # the DEC training graph: encoder -> DECLoss(z, mu, p)
+        from autoencoder import _encoder_sym
+        self.feature_sym = _encoder_sym(dims)
+        self.loss_sym = self.dec_op(data=self.feature_sym,
+                                    name="dec")
+
+    def extract(self, X, batch_size=256):
+        it = mx.io.NDArrayIter(X, batch_size=batch_size)
+        mod = mx.mod.Module(self.feature_sym, label_names=())
+        mod.bind(data_shapes=it.provide_data, for_training=False)
+        mod.init_params()
+        cur, _ = mod.get_params()
+        cur.update({k: v for k, v in self.ae.arg_params.items()
+                    if k in cur})
+        mod.set_params(cur, {})
+        return mod.predict(it).asnumpy()[:len(X)]
+
+    def cluster(self, X, y=None, update_interval=64, updates=300,
+                batch_size=256, lr=0.01, tol=0.001, seed=0):
+        z = self.extract(X)
+        mu, _ = kmeans(z, self.num_centers, seed=seed)
+
+        # bind the DEC graph: encoder weights + mu trainable, p fed as
+        # a label each batch
+        args = {"data": mx.nd.zeros((batch_size, X.shape[1])),
+                "dec_mu": mx.nd.array(mu),
+                "dec_label": mx.nd.zeros((batch_size, self.num_centers))}
+        for name in self.loss_sym.list_arguments():
+            if name not in args:
+                args[name] = mx.nd.array(self.ae.arg_params[name])
+        grad_req = {n: "null" if n in ("data", "dec_label") else "write"
+                    for n in self.loss_sym.list_arguments()}
+        exe = self.loss_sym.bind(
+            mx.current_context(), args,
+            args_grad={n: mx.nd.zeros(args[n].shape)
+                       for n, r in grad_req.items() if r == "write"},
+            grad_req=grad_req)
+        opt = mx.optimizer.create("sgd", learning_rate=lr, momentum=0.9,
+                                  rescale_grad=1.0 / batch_size)
+        updater = mx.optimizer.get_updater(opt)
+        trainable = [n for n, r in grad_req.items() if r == "write"]
+
+        self.y_pred = np.zeros(len(X))
+        p_all = None
+        i = 0
+        while i < updates:
+            if i % update_interval == 0:
+                # refresh q/p over the whole dataset with CURRENT params
+                for n in trainable:
+                    if n != "dec_mu":
+                        self.ae.arg_params[n] = args[n].copy()
+                z = self.extract(X)
+                q = np.zeros((len(X), self.num_centers), np.float32)
+                self.dec_op.forward([z, args["dec_mu"].asnumpy()], [q])
+                y_pred = q.argmax(1)
+                if y is not None:
+                    logging.info("update %d  cluster acc %.4f", i,
+                                 cluster_acc(y_pred, y))
+                p_all = target_distribution(q)
+                delta = np.mean(y_pred != self.y_pred)
+                self.y_pred = y_pred
+                if i > 0 and delta < tol:
+                    break   # assignments converged (reference refresh())
+            lo = (i * batch_size) % (len(X) - batch_size + 1)
+            args["data"][:] = X[lo:lo + batch_size]
+            args["dec_label"][:] = p_all[lo:lo + batch_size]
+            exe.forward(is_train=True)
+            exe.backward()
+            for j, n in enumerate(trainable):
+                updater(j, exe.grad_dict[n], args[n])
+            i += 1
+        for n in trainable:
+            if n != "dec_mu":
+                self.ae.arg_params[n] = args[n].copy()
+        return cluster_acc(self.y_pred, y) if y is not None else -1.0
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates", type=int, default=300)
+    ap.add_argument("--update-interval", type=int, default=64)
+    args = ap.parse_args(argv)
+    X, y = synthetic_clusters()
+    model = DECModel(X, num_centers=4)
+    acc = model.cluster(X, y, update_interval=args.update_interval,
+                        updates=args.updates)
+    print("final clustering accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

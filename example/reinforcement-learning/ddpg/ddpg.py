@@ -1,0 +1,288 @@
+"""Deep Deterministic Policy Gradient on a continuous-control task.
+
+Capability port of the reference example/reinforcement-learning/ddpg/
+(ddpg.py:1, policies.py, qfuncs.py, strategies.py, replay_mem.py):
+
+- deterministic policy MLP (tanh head) and Q-function MLP trained from
+  a replay buffer;
+- TARGET copies of both nets, soft-updated every step
+  (``w_tgt = tau*w + (1-tau)*w_tgt``);
+- critic loss = mean squared TD error against
+  ``y = r + gamma*(1-done)*Q_tgt(s', P_tgt(s'))``;
+- actor loss = ``-mean(Q(s, P(s)))``, with the gradient flowing
+  THROUGH the critic into the policy weights only: the combined graph
+  binds critic weights with grad_req='null' and policy weights with
+  'write' (the grad_req-dict form of the reference's shared-buffer
+  executor wiring, ddpg.py:133-152);
+- Ornstein-Uhlenbeck exploration noise (strategies.py:18).
+
+The rllab environment is replaced by an egress-free 2-D "reach" task:
+state = [pos, goal], action = velocity in [-1,1]^2, reward =
+-(distance to goal); solvable by a linear-ish policy in a few hundred
+updates.
+
+    python ddpg.py --updates 600
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class ReachEnv(object):
+    """2-D point mass: move pos toward goal; dense negative-distance
+    reward; episode ends after ``horizon`` steps."""
+
+    def __init__(self, horizon=20, seed=0):
+        self.horizon = horizon
+        self._rs = np.random.RandomState(seed)
+        self.obs_dim, self.act_dim = 4, 2
+        self.reset()
+
+    def reset(self):
+        self.pos = self._rs.uniform(-1, 1, 2)
+        self.goal = self._rs.uniform(-1, 1, 2)
+        self.t = 0
+        return self._obs()
+
+    def _obs(self):
+        return np.concatenate([self.pos, self.goal]).astype(np.float32)
+
+    def step(self, action):
+        a = np.clip(np.asarray(action).reshape(-1), -1, 1)
+        self.pos = np.clip(self.pos + 0.2 * a, -1.5, 1.5)
+        self.t += 1
+        reward = -float(np.linalg.norm(self.pos - self.goal))
+        done = self.t >= self.horizon
+        return self._obs(), reward, done
+
+
+class OUStrategy(object):
+    """Ornstein-Uhlenbeck noise (reference strategies.py:18)."""
+
+    def __init__(self, act_dim, mu=0.0, theta=0.15, sigma=0.3, seed=0):
+        self.mu, self.theta, self.sigma = mu, theta, sigma
+        self.act_dim = act_dim
+        self._rs = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.state = np.ones(self.act_dim) * self.mu
+
+    def sample(self):
+        dx = self.theta * (self.mu - self.state) \
+            + self.sigma * self._rs.randn(self.act_dim)
+        self.state = self.state + dx
+        return self.state
+
+
+class ReplayMem(object):
+    """(obs, act, reward, done, next_obs) ring buffer
+    (reference replay_mem.py:1)."""
+
+    def __init__(self, obs_dim, act_dim, memory_size=10000, seed=0):
+        self.obs = np.zeros((memory_size, obs_dim), np.float32)
+        self.act = np.zeros((memory_size, act_dim), np.float32)
+        self.rwd = np.zeros(memory_size, np.float32)
+        self.end = np.zeros(memory_size, np.float32)
+        self.nxt = np.zeros((memory_size, obs_dim), np.float32)
+        self.memory_size = memory_size
+        self.top, self.size = 0, 0
+        self._rs = np.random.RandomState(seed)
+
+    def add(self, obs, act, rwd, end, nxt):
+        i = self.top
+        self.obs[i], self.act[i] = obs, act
+        self.rwd[i], self.end[i], self.nxt[i] = rwd, float(end), nxt
+        self.top = (self.top + 1) % self.memory_size
+        self.size = min(self.size + 1, self.memory_size)
+
+    def sample(self, n):
+        idx = self._rs.randint(0, self.size, n)
+        return (self.obs[idx], self.act[idx], self.rwd[idx],
+                self.end[idx], self.nxt[idx])
+
+
+def policy_sym(obs, act_dim, prefix="p_", hidden=64):
+    net = mx.sym.FullyConnected(obs, num_hidden=hidden,
+                                name=prefix + "fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=act_dim,
+                                name=prefix + "out")
+    return mx.sym.Activation(net, act_type="tanh")
+
+
+def qfunc_sym(obs, act, prefix="q_", hidden=64):
+    net = mx.sym.Concat(obs, act, dim=1)
+    net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                name=prefix + "fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=1, name=prefix + "out")
+    return net
+
+
+class DDPG(object):
+    def __init__(self, env, batch_size=64, gamma=0.98, tau=1e-2,
+                 qfunc_lr=1e-2, policy_lr=1e-3, seed=0):
+        self.env = env
+        self.batch_size = batch_size
+        self.gamma, self.tau = gamma, tau
+        obs_dim, act_dim = env.obs_dim, env.act_dim
+        B = batch_size
+        obs = mx.sym.Variable("obs")
+        act = mx.sym.Variable("act")
+        yval = mx.sym.Variable("yval")
+
+        mx.random.seed(seed)
+        init = mx.initializer.Normal(0.1)
+
+        # ---- critic: grads w.r.t. its own weights
+        qloss = mx.sym.MakeLoss(
+            mx.sym.mean(mx.sym.square(qfunc_sym(obs, act) - yval)))
+        self.q_exe = qloss.simple_bind(
+            mx.current_context(), obs=(B, obs_dim), act=(B, act_dim),
+            yval=(B, 1), grad_req="write")
+        for name, arr in self.q_exe.arg_dict.items():
+            if name not in ("obs", "act", "yval"):
+                init(mx.initializer.InitDesc(name), arr)
+        self.q_updater = mx.optimizer.get_updater(
+            mx.optimizer.create("adam", learning_rate=qfunc_lr))
+
+        # ---- actor: -mean(Q(s, P(s))); the combined graph shares the
+        # critic's weight NAMES and binds them grad_req='null' so only
+        # the policy weights receive gradients
+        ploss = mx.sym.MakeLoss(
+            mx.sym.mean(-qfunc_sym(obs, policy_sym(obs, act_dim))))
+        grad_req = {n: ("write" if n.startswith("p_") else "null")
+                    for n in ploss.list_arguments()}
+        grad_req["obs"] = "null"
+        self.p_exe = ploss.simple_bind(
+            mx.current_context(), obs=(B, obs_dim), grad_req=grad_req)
+        for name, arr in self.p_exe.arg_dict.items():
+            if name.startswith("p_"):
+                init(mx.initializer.InitDesc(name), arr)
+        self.p_updater = mx.optimizer.get_updater(
+            mx.optimizer.create("adam", learning_rate=policy_lr))
+
+        # ---- act-time policy executor (batch 1), shares policy cells
+        self.act_exe = policy_sym(
+            mx.sym.Variable("obs"), act_dim).bind(
+                mx.current_context(),
+                {"obs": mx.nd.zeros((1, obs_dim)),
+                 **{n: a for n, a in self.p_exe.arg_dict.items()
+                    if n.startswith("p_")}})
+
+        # ---- targets: numpy copies, soft-updated
+        self.q_target = {n: a.asnumpy().copy()
+                         for n, a in self.q_exe.arg_dict.items()
+                         if n.startswith("q_")}
+        self.p_target = {n: a.asnumpy().copy()
+                         for n, a in self.p_exe.arg_dict.items()
+                         if n.startswith("p_")}
+        # target scorer: y = Q_tgt(s', P_tgt(s'))
+        tgt = qfunc_sym(obs, policy_sym(obs, act_dim))
+        self.tgt_exe = tgt.simple_bind(mx.current_context(),
+                                       obs=(B, obs_dim), grad_req="null")
+
+    def get_action(self, obs):
+        self.act_exe.arg_dict["obs"][:] = obs.reshape(1, -1)
+        self.act_exe.forward(is_train=False)
+        return self.act_exe.outputs[0].asnumpy()[0]
+
+    def _soft_update(self, target, source_dict):
+        for n, v in target.items():
+            v *= (1.0 - self.tau)
+            v += self.tau * source_dict[n].asnumpy()
+
+    def update(self, batch):
+        obs, act, rwd, end, nxt = batch
+        # target y from the frozen nets
+        for n, v in self.q_target.items():
+            self.tgt_exe.arg_dict[n][:] = v
+        for n, v in self.p_target.items():
+            self.tgt_exe.arg_dict[n][:] = v
+        self.tgt_exe.arg_dict["obs"][:] = nxt
+        self.tgt_exe.forward(is_train=False)
+        next_q = self.tgt_exe.outputs[0].asnumpy().ravel()
+        y = (rwd + self.gamma * (1.0 - end) * next_q).astype(np.float32)
+
+        # critic step
+        self.q_exe.arg_dict["obs"][:] = obs
+        self.q_exe.arg_dict["act"][:] = act
+        self.q_exe.arg_dict["yval"][:] = y[:, None]
+        self.q_exe.forward(is_train=True)
+        qloss = float(self.q_exe.outputs[0].asnumpy())
+        self.q_exe.backward()
+        for i, n in enumerate(self.q_exe._symbol.list_arguments()):
+            if n.startswith("q_"):
+                self.q_updater(i, self.q_exe.grad_dict[n],
+                               self.q_exe.arg_dict[n])
+
+        # actor step: critic weights copied in fresh, grads flow only to
+        # the policy
+        for n in self.q_target:
+            self.p_exe.arg_dict[n][:] = self.q_exe.arg_dict[n]
+        self.p_exe.arg_dict["obs"][:] = obs
+        self.p_exe.forward(is_train=True)
+        self.p_exe.backward()
+        for i, n in enumerate(self.p_exe._symbol.list_arguments()):
+            if n.startswith("p_"):
+                self.p_updater(i, self.p_exe.grad_dict[n],
+                               self.p_exe.arg_dict[n])
+
+        self._soft_update(self.q_target, self.q_exe.arg_dict)
+        self._soft_update(self.p_target, self.p_exe.arg_dict)
+        return qloss
+
+    def evaluate(self, episodes=10, seed=123):
+        env = ReachEnv(horizon=self.env.horizon, seed=seed)
+        total = 0.0
+        for _ in range(episodes):
+            obs = env.reset()
+            done = False
+            while not done:
+                obs, r, done = env.step(self.get_action(obs))
+                total += r
+        return total / episodes
+
+
+def train(updates=600, batch_size=64, memory_start=200, seed=0,
+          print_every=100):
+    env = ReachEnv(seed=seed)
+    agent = DDPG(env, batch_size=batch_size, seed=seed)
+    strategy = OUStrategy(env.act_dim, seed=seed)
+    memory = ReplayMem(env.obs_dim, env.act_dim, seed=seed)
+
+    obs = env.reset()
+    done = False
+    n_updates = 0
+    while n_updates < updates:
+        if done:
+            obs = env.reset()
+            strategy.reset()
+        a = np.clip(agent.get_action(obs) + strategy.sample(), -1, 1)
+        nxt, r, done = env.step(a)
+        memory.add(obs, a, r, done, nxt)
+        obs = nxt
+        if memory.size >= memory_start:
+            agent.update(memory.sample(batch_size))
+            n_updates += 1
+            if print_every and n_updates % print_every == 0:
+                print("update %5d  eval return %7.3f"
+                      % (n_updates, agent.evaluate(5)))
+    return agent
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--updates", type=int, default=600)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+    agent = train(updates=args.updates, batch_size=args.batch_size)
+    print("final eval return:", agent.evaluate(20))

@@ -1,0 +1,236 @@
+"""Deep Q-Network with target network and replay, on vectorized Catch.
+
+Capability port of the reference
+example/reinforcement-learning/dqn/dqn_demo.py:1 + operators.py:1:
+
+- ``DQNOutput`` CustomOp: identity forward; backward writes the CLIPPED
+  TD error (Q(s,a) - target) only at the taken action's slot
+  (need_top_grad=False, the reference's loss-as-operator idiom);
+- target network: a second parameter set refreshed from the online net
+  every ``freeze_interval`` updates (Nature DQN);
+- epsilon-greedy with linear decay; uniform replay sampling;
+- ``--double-q``: action argmax from the ONLINE net, value from the
+  target net — built from ``nd.choose_element_0index`` +
+  ``nd.argmax_channel`` exactly like the reference's update rule.
+
+The Atari feed is replaced by the repo's egress-free vectorized Catch
+environment (example/rl-a3c/catch_env.py); one env instance is stepped
+at a time to keep the reference's single-stream episode structure.
+
+    python dqn_demo.py --updates 800
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "rl-a3c")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+from catch_env import CatchDataIter
+from replay_memory import ReplayMemory
+
+
+class DQNOutput(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        qvals = out_data[0].asnumpy()
+        action = in_data[1].asnumpy().astype(np.int64)
+        target = in_data[2].asnumpy()
+        dx = np.zeros_like(qvals)
+        rows = np.arange(action.shape[0])
+        dx[rows, action] = np.clip(qvals[rows, action] - target, -1.0, 1.0)
+        self.assign(in_grad[0], req[0], mx.nd.array(dx))
+
+
+@mx.operator.register("DQNOutput")
+class DQNOutputProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(DQNOutputProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "dqn_action", "dqn_reward"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        batch = in_shape[0][0]
+        return [in_shape[0], (batch,), (batch,)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return DQNOutput()
+
+
+def q_sym(act_dim, with_loss):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    qvals = mx.sym.FullyConnected(net, num_hidden=act_dim, name="qvals")
+    if not with_loss:
+        return qvals
+    action = mx.sym.Variable("dqn_action")
+    reward = mx.sym.Variable("dqn_reward")
+    return mx.sym.Custom(qvals, action, reward, name="dqn",
+                         op_type="DQNOutput")
+
+
+class QNet(object):
+    """Online net (train graph with DQNOutput) + scoring graph sharing
+    the same parameter cells; the reference's Base wrapper reduced to
+    what the demo needs."""
+
+    def __init__(self, obs_dim, act_dim, batch_size, lr, seed):
+        self.batch_size = batch_size
+        self.mod = mx.mod.Module(
+            q_sym(act_dim, True),
+            data_names=("data", "dqn_action", "dqn_reward"),
+            label_names=None)
+        self.mod.bind(
+            data_shapes=[("data", (batch_size, obs_dim)),
+                         ("dqn_action", (batch_size,)),
+                         ("dqn_reward", (batch_size,))],
+            label_shapes=None, grad_req="write")
+        mx.random.seed(seed)
+        self.mod.init_params(mx.initializer.Xavier(factor_type="in"))
+        self.mod.init_optimizer(
+            kvstore="local", optimizer="adagrad",
+            optimizer_params={"learning_rate": lr, "eps": 0.01,
+                              "rescale_grad": 1.0 / batch_size})
+        self.score_mod = mx.mod.Module(q_sym(act_dim, False),
+                                       data_names=("data",),
+                                       label_names=None)
+        self.score_mod.bind(data_shapes=[("data", (1, obs_dim))],
+                            for_training=False)
+        self._sync_score()
+
+    def _sync_score(self):
+        arg, aux = self.mod.get_params()
+        self.score_mod.set_params(arg, aux)
+
+    def qvalues(self, obs):
+        """Q(s, .) for a (N, obs_dim) batch via the scoring graph."""
+        self.score_mod.reshape([("data", obs.shape)])
+        self.score_mod.forward(mx.io.DataBatch([mx.nd.array(obs)], None),
+                               is_train=False)
+        return self.score_mod.get_outputs()[0].asnumpy()
+
+    def train(self, states, actions, targets):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(states), mx.nd.array(actions),
+             mx.nd.array(targets)], None)
+        self.mod.forward_backward(batch)
+        self.mod.update()
+        self._sync_score()
+
+    def copy_params(self):
+        arg, aux = self.mod.get_params()
+        return ({k: v.copy() for k, v in arg.items()},
+                {k: v.copy() for k, v in aux.items()})
+
+
+def evaluate(qnet, episodes=50, seed=99):
+    """Greedy-policy evaluation on fresh environments (the reference's
+    dqn_run_test.py role): mean episode reward under eps=0."""
+    env = CatchDataIter(1, seed=seed)
+    total = 0.0
+    done_count = 0
+    while done_count < episodes:
+        obs = env.data().reshape(1, -1)
+        action = int(qnet.qvalues(obs)[0].argmax())
+        reward, done = env.act(np.array([action]))
+        total += float(reward[0])
+        done_count += int(done[0])
+    return total / episodes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--updates", type=int, default=800)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--freeze-interval", type=int, default=50)
+    ap.add_argument("--discount", type=float, default=0.95)
+    ap.add_argument("--start-eps", type=float, default=1.0)
+    ap.add_argument("--min-eps", type=float, default=0.05)
+    ap.add_argument("--replay-start", type=int, default=200)
+    ap.add_argument("--double-q", action="store_true")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--print-every", type=int, default=100)
+    args = ap.parse_args(argv)
+
+    env = CatchDataIter(1, seed=args.seed)
+    obs_dim = env.h * env.w
+    act_dim = env.act_dim
+    rs = np.random.RandomState(args.seed)
+
+    qnet = QNet(obs_dim, act_dim, args.batch_size, args.lr, args.seed)
+    target_params = qnet.copy_params()
+    target_mod = mx.mod.Module(q_sym(act_dim, False), data_names=("data",),
+                               label_names=None)
+    target_mod.bind(data_shapes=[("data", (args.batch_size, obs_dim))],
+                    for_training=False)
+    target_mod.set_params(*target_params)
+
+    memory = ReplayMemory((obs_dim,), memory_size=5000,
+                          replay_start_size=args.replay_start,
+                          seed=args.seed)
+    eps = args.start_eps
+    eps_decay = (args.start_eps - args.min_eps) / max(args.updates, 1)
+    updates = 0
+    episode_rewards = []
+    reward_acc = 0.0
+    while updates < args.updates:
+        obs = env.data().reshape(1, -1)[0]
+        if rs.rand() < eps or not memory.sample_enabled:
+            action = rs.randint(act_dim)
+        else:
+            action = int(qnet.qvalues(obs[None, :])[0].argmax())
+        reward, done = env.act(np.array([action + 0]))
+        reward_acc += float(reward[0])
+        if done[0]:
+            episode_rewards.append(reward_acc)
+            reward_acc = 0.0
+        memory.append(obs, action, float(reward[0]), bool(done[0]))
+
+        if memory.sample_enabled:
+            eps = max(eps - eps_decay, args.min_eps)
+            states, actions, rewards, nxt, term = memory.sample(
+                args.batch_size)
+            target_mod.forward(
+                mx.io.DataBatch([mx.nd.array(nxt)], None), is_train=False)
+            target_q = target_mod.get_outputs()[0]
+            if args.double_q:
+                # action chosen by the ONLINE net, valued by the target
+                # net — the double-DQN decomposition, written with the
+                # same nd ops as the reference (dqn_demo.py:180)
+                online_q = mx.nd.array(qnet.qvalues(nxt))
+                best = mx.nd.argmax_channel(online_q)
+                boot = mx.nd.choose_element_0index(target_q, best).asnumpy()
+            else:
+                boot = mx.nd.choose_element_0index(
+                    target_q, mx.nd.argmax_channel(target_q)).asnumpy()
+            targets = rewards + (1.0 - term) * args.discount * boot
+            qnet.train(states, actions, targets.astype(np.float32))
+            updates += 1
+            if updates % args.freeze_interval == 0:
+                target_mod.set_params(*qnet.copy_params())
+            if args.print_every and updates % args.print_every == 0:
+                recent = np.mean(episode_rewards[-50:]) \
+                    if episode_rewards else float("nan")
+                print("update %5d  eps %.2f  mean episode reward (last 50)"
+                      " %6.3f" % (updates, eps, recent))
+    return episode_rewards, qnet
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Uniform-sampling replay ring buffer.
+
+Capability port of the reference
+example/reinforcement-learning/dqn/replay_memory.py:1 — circular
+storage of (state, action, reward, terminal) transitions with uniform
+minibatch sampling of (s, a, r, s', terminal) tuples; ``sample_enabled``
+gates training until the warm-up fill (replay_start_size) is reached.
+"""
+import numpy as np
+
+
+class ReplayMemory(object):
+    def __init__(self, state_shape, memory_size=10000, replay_start_size=100,
+                 state_dtype=np.float32, seed=0):
+        self.memory_size = memory_size
+        self.replay_start_size = replay_start_size
+        self.states = np.zeros((memory_size,) + tuple(state_shape),
+                               state_dtype)
+        self.actions = np.zeros(memory_size, np.int64)
+        self.rewards = np.zeros(memory_size, np.float32)
+        self.terminals = np.zeros(memory_size, np.bool_)
+        self.top = 0
+        self.size = 0
+        self._rs = np.random.RandomState(seed)
+
+    @property
+    def sample_enabled(self):
+        return self.size >= max(self.replay_start_size, 2)
+
+    def append(self, state, action, reward, terminal):
+        self.states[self.top] = state
+        self.actions[self.top] = action
+        self.rewards[self.top] = reward
+        self.terminals[self.top] = terminal
+        self.top = (self.top + 1) % self.memory_size
+        self.size = min(self.size + 1, self.memory_size)
+
+    def sample(self, batch_size):
+        """(states, actions, rewards, next_states, terminal_flags).  The
+        successor of index i is i+1 in ring order; transitions whose
+        successor would cross the write head are excluded (their s' was
+        overwritten), like the reference's index arithmetic."""
+        assert self.sample_enabled
+        out = np.zeros(batch_size, np.int64)
+        n = 0
+        while n < batch_size:
+            i = self._rs.randint(0, self.size - 1)
+            # exclude the slot just before the write head: its successor
+            # is the oldest record, not its true s'
+            if self.size == self.memory_size and \
+                    (i + 1) % self.memory_size == self.top:
+                continue
+            out[n] = i
+            n += 1
+        nxt = (out + 1) % self.memory_size
+        return (self.states[out], self.actions[out], self.rewards[out],
+                self.states[nxt], self.terminals[out].astype(np.float32))

@@ -1,0 +1,182 @@
+"""Parallel advantage actor-critic over a batch of environments.
+
+Capability port of the reference
+example/reinforcement-learning/parallel_actor_critic/train.py:1 +
+model.py:1: ONE network forward serves every environment's action each
+step; trajectories from all environments are concatenated into a single
+training batch; advantages come from Generalized Advantage Estimation
+(Schulman 2016, eqn. 16); the policy gradient is injected through
+``Module.backward(out_grads=...)`` on the log-policy head (negative
+advantage at the taken action), the value head trains toward the
+return, and an entropy bonus (MakeLoss with grad_scale) keeps the
+policy exploring.  ``Module.reshape`` switches between the act-batch
+(num_envs rows) and the train-batch (all trajectory steps).
+
+The environment is the repo's vectorized Catch (egress-free stand-in
+for the reference's gym feed; example/rl-a3c/catch_env.py).
+
+    python train.py --num-envs 16 --t-max 32 --updates 300
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")))
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "rl-a3c")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+from catch_env import CatchDataIter
+
+
+def discount(x, gamma, done=None):
+    """Reverse-cumulative discounted sum (the scipy.signal.lfilter trick
+    of the reference, without scipy), with the accumulator reset at
+    episode boundaries when ``done`` is given — the vectorized envs
+    auto-reset, so credit must not flow across episodes."""
+    out = np.zeros_like(x, dtype=np.float64)
+    acc = 0.0
+    for i in range(len(x) - 1, -1, -1):
+        if done is not None and done[i]:
+            acc = 0.0
+        acc = x[i] + gamma * acc
+        out[i] = acc
+    return out
+
+
+class Agent(object):
+    """Shared torso, policy head (log-softmax), value head, entropy
+    bonus — reference parallel_actor_critic/model.py Agent."""
+
+    def __init__(self, input_size, act_space, num_envs, t_max,
+                 hidden=128, lr=0.01, entropy_wt=0.01, vf_wt=0.5,
+                 gamma=0.99, lambda_=1.0, clip=10.0, seed=0):
+        self.input_size = input_size
+        self.act_space = act_space
+        self.num_envs = num_envs
+        self.t_max = t_max
+        self.vf_wt = vf_wt
+        self.gamma, self.lambda_ = gamma, lambda_
+        self._rs = np.random.RandomState(seed)
+
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, name="fc1", num_hidden=hidden,
+                                    no_bias=True)
+        net = mx.sym.Activation(net, name="relu1", act_type="relu")
+        policy_fc = mx.sym.FullyConnected(net, name="policy_fc",
+                                          num_hidden=act_space,
+                                          no_bias=True)
+        policy = mx.sym.SoftmaxActivation(policy_fc, name="policy")
+        policy = mx.sym.clip(policy, a_min=1e-5, a_max=1 - 1e-5)
+        log_policy = mx.sym.log(policy, name="log_policy")
+        out_policy = mx.sym.BlockGrad(policy, name="out_policy")
+        neg_entropy = mx.sym.MakeLoss(policy * log_policy,
+                                      grad_scale=entropy_wt,
+                                      name="neg_entropy")
+        value = mx.sym.FullyConnected(net, name="value", num_hidden=1)
+        self.sym = mx.sym.Group([log_policy, value, neg_entropy,
+                                 out_policy])
+        self.model = mx.mod.Module(self.sym, data_names=("data",),
+                                   label_names=None)
+        self.model.bind(
+            data_shapes=[("data", (num_envs * t_max, input_size))],
+            label_shapes=None, grad_req="write")
+        self.model.init_params(mx.initializer.Xavier())
+        self.model.init_optimizer(
+            kvstore="local", optimizer="adam",
+            optimizer_params={"learning_rate": lr, "rescale_grad": 1.0,
+                              "clip_gradient": clip})
+
+    def act(self, ps):
+        """Sample one action per row from the policy distribution."""
+        us = self._rs.uniform(size=ps.shape[0])[:, np.newaxis]
+        return (np.cumsum(ps, axis=1) > us).argmax(axis=1)
+
+    def step_policy(self, xs):
+        """Policy+value for the current observations (act batch)."""
+        self.model.reshape([("data", (xs.shape[0], self.input_size))])
+        self.model.forward(mx.io.DataBatch([mx.nd.array(xs)], None),
+                           is_train=False)
+        _, vs, _, ps = self.model.get_outputs()
+        return ps.asnumpy(), vs.asnumpy().ravel()
+
+    def train_step(self, xs, acts, advs):
+        """One policy-gradient update from concatenated trajectories:
+        out_grad of log_policy = -advantage at the taken action,
+        out_grad of value = vf_wt * -advantage (d/dv of 0.5*(R-v)^2 up
+        to scale) — reference model.py train_step."""
+        n = len(xs)
+        self.model.reshape([("data", (n, self.input_size))])
+        neg_advs = np.zeros((n, self.act_space), np.float32)
+        neg_advs[np.arange(n), acts] = -advs
+        v_grads = (self.vf_wt * -advs[:, None]).astype(np.float32)
+        self.model.forward(mx.io.DataBatch([mx.nd.array(xs)], None),
+                           is_train=True)
+        self.model.backward(out_grads=[mx.nd.array(neg_advs),
+                                       mx.nd.array(v_grads)])
+        self.model.update()
+
+
+def train_round(agent, envs):
+    """Roll every env t_max steps, then one update over the batch.
+    Returns the summed reward across envs for the round."""
+    xs_buf, as_buf, rs_buf, vs_buf, ds_buf = [], [], [], [], []
+    total_reward = 0.0
+    for _ in range(agent.t_max):
+        obs = envs.data().reshape(envs.batch_size, -1)
+        ps, vs = agent.step_policy(obs)
+        acts = agent.act(ps)
+        reward, done = envs.act(acts)
+        total_reward += float(reward.sum())
+        xs_buf.append(obs)
+        as_buf.append(acts)
+        rs_buf.append(reward)
+        vs_buf.append(vs)
+        ds_buf.append(done)
+    # bootstrap values for the state after the last step
+    _, last_vs = agent.step_policy(envs.data().reshape(envs.batch_size, -1))
+    vs_buf.append(last_vs)
+
+    # GAE per environment column; terminal steps neither bootstrap the
+    # next state's value nor leak advantage across the auto-reset
+    rs = np.stack(rs_buf)               # (T, B)
+    vs = np.stack(vs_buf)               # (T+1, B)
+    ds = np.stack(ds_buf)               # (T, B), 1.0 at episode end
+    deltas = rs + agent.gamma * vs[1:] * (1.0 - ds) - vs[:-1]
+    advs = np.stack([discount(deltas[:, b], agent.gamma * agent.lambda_,
+                              done=ds[:, b])
+                     for b in range(rs.shape[1])], axis=1)   # (T, B)
+    xs = np.concatenate(xs_buf)                              # (T*B, D)
+    acts = np.concatenate(as_buf)
+    agent.train_step(xs, acts, advs.reshape(-1).astype(np.float32))
+    return total_reward
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-envs", type=int, default=16)
+    ap.add_argument("--t-max", type=int, default=32)
+    ap.add_argument("--updates", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--print-every", type=int, default=20)
+    args = ap.parse_args()
+
+    envs = CatchDataIter(args.num_envs, seed=1)
+    agent = Agent(envs.h * envs.w, envs.act_dim, args.num_envs,
+                  args.t_max, lr=args.lr)
+    running = None
+    for u in range(args.updates):
+        r = train_round(agent, envs)
+        running = r if running is None else 0.9 * running + 0.1 * r
+        if args.print_every and u % args.print_every == 0:
+            print("update %4d  round reward %7.2f  running %7.2f"
+                  % (u, r, running))
+    return running
+
+
+if __name__ == "__main__":
+    main()

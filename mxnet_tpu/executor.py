@@ -434,6 +434,62 @@ class Executor(object):
             if name in self.aux_dict:
                 self.aux_dict[name]._data = arr
 
+    #: ops whose backward supplies its OWN head gradient (their custom
+    #: vjp ignores the incoming cotangent) — the reference's loss layers,
+    #: which need no entry in a user-passed out_grads list
+    _SELF_GRAD_OPS = frozenset((
+        "MakeLoss", "make_loss", "SoftmaxOutput", "softmax_output",
+        "LinearRegressionOutput", "MAERegressionOutput",
+        "LogisticRegressionOutput", "SVMOutput", "BlockGrad", "stop_gradient",
+    ))
+
+    def _pad_out_grads(self, heads):
+        """Match user heads to outputs the way the reference does: loss
+        outputs (self-gradient ops, incl. need_top_grad=False Customs)
+        are skipped; the given heads fill the remaining outputs in
+        order; anything left unmatched gets zeros
+        (reference graph_executor head_grad binding for the
+        Module.backward(out_grads) contract, e.g. the
+        parallel_actor_critic example's [log_policy, value] heads next
+        to a MakeLoss entropy term and a BlockGrad output)."""
+        n_out = len(self._symbol._outputs)
+        if len(heads) == n_out:
+            return heads
+        # zero cotangents must match each output's exact aval: prefer the
+        # freshest forward outputs (shape AND dtype); fall back to
+        # inferred shapes at float32
+        if self._outputs is not None and len(self._outputs) == n_out:
+            out_avals = [(o._data.shape, o._data.dtype)
+                         for o in self._outputs]
+        else:
+            _, out_shapes, _ = self._symbol.infer_shape_partial(
+                **{k: v.shape for k, v in self.arg_dict.items()})
+            out_avals = [(s or (), jnp.float32) for s in out_shapes]
+        it = iter(heads)
+        full = []
+        for (node, _idx), (shape, dtype) in zip(self._symbol._outputs,
+                                                out_avals):
+            op_name = getattr(node.op, "name", None) if node.op else None
+            self_grad = op_name in self._SELF_GRAD_OPS
+            if op_name == "Custom":
+                from .operator import _prop_for
+                try:
+                    self_grad = not _prop_for(node.attrs).need_top_grad_
+                except Exception:  # noqa: BLE001 — unknown op_type
+                    self_grad = False
+            if self_grad:
+                full.append(jnp.zeros(shape, dtype))
+            else:
+                g = next(it, None)
+                full.append(jnp.zeros(shape, dtype) if g is None else g)
+        leftover = list(it)
+        if leftover:
+            raise MXNetError(
+                "backward: %d out_grads given but only %d outputs "
+                "accept head gradients" % (len(heads),
+                                           len(heads) - len(leftover)))
+        return full
+
     def backward(self, out_grads=None):
         """Write gradients into grad arrays.  Uses the cached fused-step
         gradients when called without explicit head gradients."""
@@ -444,6 +500,7 @@ class Executor(object):
                 out_grads = [out_grads]
             heads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                      for g in out_grads]
+            heads = self._pad_out_grads(heads)
             args, aux = self._raw(self.arg_dict), self._raw(self.aux_dict)
             # reuse the forward pass's RNG key so stochastic ops (Dropout,
             # rrelu) see the same masks the observed outputs were computed

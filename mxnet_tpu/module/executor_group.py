@@ -123,7 +123,12 @@ class DataParallelExecutorGroup(object):
             self.execs.append(
                 self._bind_ith_exec(i, data_shapes, label_shapes,
                                     shared_group))
+        self._wire_arrays()
 
+    def _wire_arrays(self):
+        """Rebuild the array-list views over self.execs (split out so
+        reshape's executor-cache swap can re-wire without rebinding)."""
+        data_shapes, label_shapes = self.data_shapes, self.label_shapes
         self.data_arrays = [
             [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
             for name, _ in [(d.name, d.shape) for d in data_shapes]]
@@ -155,6 +160,49 @@ class DataParallelExecutorGroup(object):
             self.input_grad_arrays = None
         self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
                            for name in self.aux_names]
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Rebind executors for new input shapes, sharing the existing
+        parameter/gradient/aux cells (reference executor_group.py
+        DataParallelExecutorGroup.reshape) — weights and optimizer
+        attachment survive; only input-shaped buffers are fresh.
+
+        Executor sets are CACHED per shape signature: every cached set
+        shares the same parameter NDArray cells, so updates made while
+        one shape is active are visible to all (alternating between an
+        act-batch and a train-batch shape, the RL pattern, costs one
+        bind each — and XLA caches compiled programs per shape, so no
+        recompiles either)."""
+        import copy
+        data_shapes = _as_data_desc(data_shapes)
+        label_shapes = _as_data_desc(label_shapes)
+        if not hasattr(self, "_reshape_cache"):
+            # seed the cache with the currently-bound shape
+            self._reshape_cache = {self._shape_sig(
+                self.data_shapes, self.label_shapes): self.execs}
+        sig = self._shape_sig(data_shapes, label_shapes)
+        cached = self._reshape_cache.get(sig)
+        if cached is not None and cached is not self.execs:
+            self.batch_size = None
+            self.data_major_axis = self.decide_slices(data_shapes)
+            if label_shapes:
+                self.label_major_axis = self.decide_slices(label_shapes)
+            self.data_shapes = data_shapes
+            self.label_shapes = label_shapes
+            self.execs = cached
+            self._wire_arrays()
+            return
+        if cached is None:
+            prev = copy.copy(self)   # shallow: exposes .execs for sharing
+            self.bind_exec(data_shapes, label_shapes, shared_group=prev,
+                           reshape=True)
+            self._reshape_cache[sig] = self.execs
+
+    @staticmethod
+    def _shape_sig(data_shapes, label_shapes):
+        return (tuple((d.name, tuple(d.shape)) for d in data_shapes),
+                tuple((l.name, tuple(l.shape))
+                      for l in (label_shapes or [])))
 
     def _sliced_shape(self, shapes, i, major_axis):
         """Shape of the i-th device slice (reference executor_group.py

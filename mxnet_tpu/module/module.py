@@ -13,6 +13,7 @@ from ..initializer import Uniform, InitDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
                      save_checkpoint)
+from ..io import DataDesc
 from ..ndarray import NDArray, zeros as nd_zeros
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
@@ -286,6 +287,23 @@ class Module(BaseModule):
         self._monitor_installed = False
 
     # -- optimizer ---------------------------------------------------------
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new input shapes keeping parameters and optimizer
+        state (reference module.py:405 Module.reshape).  The executor
+        group shares its parameter cells into the re-bound executors;
+        the fused trainer (kvstore='tpu') just re-binds its step — XLA
+        caches compiled programs per shape, so flipping between batch
+        sizes costs one compile each, once."""
+        assert self.binded
+        self._data_shapes = [d if isinstance(d, DataDesc)
+                             else DataDesc(d[0], d[1]) for d in data_shapes]
+        self._label_shapes = [l if isinstance(l, DataDesc)
+                              else DataDesc(l[0], l[1])
+                              for l in (label_shapes or [])] or None
+        self._exec_group.reshape(self._data_shapes, self._label_shapes)
+        if self._fused is not None:
+            self._fused.bind(self._data_shapes, self._label_shapes or [])
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):

@@ -1,0 +1,130 @@
+"""tools/accnn low-rank acceleration smoke tests.
+
+Reference parity: tools/accnn/{acc_conv,acc_fc,rank_selection,accnn}.py
+— spatial-SVD conv decomposition, FC SVD decomposition, energy-based
+rank selection, whole-net driver; surgery preserves the untouched
+layers and the trained weights.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "tools", "accnn"))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _small_convnet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+@pytest.fixture(scope="module")
+def trained():
+    sym = _small_convnet()
+    shapes = dict(data=(2, 3, 16, 16), softmax_label=(2,))
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    rs = np.random.RandomState(0)
+    args = {n: mx.nd.array(rs.randn(*s).astype("f") * 0.2)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+    return sym, args
+
+
+def _forward(sym, args, X):
+    full = dict(args)
+    full["data"] = mx.nd.array(X)
+    full["softmax_label"] = mx.nd.zeros((X.shape[0],))
+    exe = sym.bind(mx.current_context(), full, grad_req="null")
+    exe.forward(is_train=False)
+    return exe.outputs[0].asnumpy()
+
+
+def test_conv_vh_full_rank_is_exact(trained):
+    import acc_conv
+    sym, args = trained
+    X = np.random.RandomState(1).rand(2, 3, 16, 16).astype("f")
+    base = _forward(sym, args, X)
+    W = args["conv2_weight"].asnumpy()
+    full_rank = min(W.shape[1] * W.shape[2], W.shape[0] * W.shape[3])
+    new_sym, new_args = acc_conv.conv_vh_decomposition(
+        sym, args, "conv2", full_rank, (2, 3, 16, 16))
+    assert "conv2_weight" not in new_args
+    assert "conv2_v_weight" in new_args and "conv2_h_weight" in new_args
+    out = _forward(new_sym, new_args, X)
+    np.testing.assert_allclose(out, base, rtol=1e-3, atol=1e-4)
+
+
+def test_conv_vh_low_rank_approximates(trained):
+    import acc_conv
+    sym, args = trained
+    X = np.random.RandomState(1).rand(2, 3, 16, 16).astype("f")
+    base = _forward(sym, args, X)
+    errs = {}
+    for K in (8, 20):
+        new_sym, new_args = acc_conv.conv_vh_decomposition(
+            sym, args, "conv2", K, (2, 3, 16, 16))
+        out = _forward(new_sym, new_args, X)
+        np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+        errs[K] = np.abs(out - base).max()
+    # more rank -> better approximation (random weights have flat
+    # spectra, so absolute error is large; monotonicity is the invariant)
+    assert errs[20] < errs[8], errs
+
+
+def test_fc_svd_full_rank_is_exact(trained):
+    import acc_fc
+    sym, args = trained
+    X = np.random.RandomState(2).rand(2, 3, 16, 16).astype("f")
+    base = _forward(sym, args, X)
+    new_sym, new_args = acc_fc.fc_decomposition(
+        sym, args, "fc1", 32, (2, 3, 16, 16))
+    assert "fc1_red_weight" in new_args and "fc1_rec_weight" in new_args
+    out = _forward(new_sym, new_args, X)
+    np.testing.assert_allclose(out, base, rtol=1e-3, atol=1e-4)
+
+
+def test_rank_selection_budget(trained):
+    import rank_selection
+    sym, args = trained
+    ranks, stats = rank_selection.get_ranksel(
+        sym, args, (1, 3, 16, 16), speedup_ratio=2.0)
+    assert set(ranks) == {"conv1", "conv2"}
+    assert all(k >= 4 for k in ranks.values())
+    assert stats["new_flops"] <= stats["orig_flops"] / 2.0 * 1.001
+
+
+def test_accnn_driver_roundtrip(trained, tmp_path):
+    import accnn
+    import utils as accnn_utils
+    sym, args = trained
+    prefix = str(tmp_path / "m")
+    accnn_utils.save_checkpoint(prefix, 1, sym, args, {})
+    sym2, args2, aux2 = accnn_utils.load_checkpoint(prefix, 1)
+    new_sym, new_args, _, ranks, stats = accnn.accelerate(
+        sym2, args2, aux2, (2, 3, 16, 16), ratio=1.5)
+    X = np.random.RandomState(3).rand(2, 3, 16, 16).astype("f")
+    out = _forward(new_sym, new_args, X)
+    assert out.shape == (2, 5)
+    assert np.isfinite(out).all()
+    accnn_utils.save_checkpoint(str(tmp_path / "acc"), 1, new_sym,
+                                new_args, {})
+    # accelerated checkpoint loads and runs
+    sym3, args3, _ = accnn_utils.load_checkpoint(str(tmp_path / "acc"), 1)
+    out3 = _forward(sym3, args3, X)
+    np.testing.assert_allclose(out3, out, rtol=1e-5)

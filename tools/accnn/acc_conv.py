@@ -1,0 +1,85 @@
+"""Vertical-horizontal (spatial SVD) convolution decomposition.
+
+Capability port of the reference tools/accnn/acc_conv.py:1 (Jaderberg
+et al. 2014): a trained k_y x k_x convolution W (N, C, y, x) factors
+into a (K, C, y, 1) vertical convolution followed by an (N, K, 1, x)
+horizontal one, K chosen by rank selection.  The factors come from the
+SVD of W reshaped to (C*y, N*x), split as U*sqrt(D) / sqrt(D)*Q.
+"""
+import argparse
+
+import numpy as np
+
+import utils
+
+import mxnet_tpu as mx
+
+
+def vh_factors(W, K):
+    """(V, H) low-rank factors of a conv kernel (N, C, y, x)."""
+    N, C, y, x = W.shape
+    Wm = W.transpose(1, 2, 0, 3).reshape(C * y, N * x)
+    U, D, Q = np.linalg.svd(Wm, full_matrices=False)
+    sqrt_d = np.sqrt(D[:K])
+    V = (U[:, :K] * sqrt_d)          # (C*y, K)
+    H = (Q[:K, :].T * sqrt_d)        # (N*x, K)
+    V = V.T.reshape(K, C, y, 1)
+    H = H.reshape(N, x, 1, K).transpose(0, 3, 2, 1)  # (N, K, 1, x)
+    return V.astype(W.dtype), H.astype(W.dtype)
+
+
+def conv_vh_decomposition(sym, arg_params, layer, K, data_shape):
+    """Replace ``layer`` (a Convolution) with its VH pair; returns
+    (new_sym, new_arg_params)."""
+    W = np.asarray(arg_params[layer + "_weight"].asnumpy())
+    b = arg_params.get(layer + "_bias")
+    V, H = vh_factors(W, K)
+
+    def sym_handle(data, node):
+        attrs = utils.node_attrs(node)
+        kernel = tuple(attrs["kernel"])
+        pad = tuple(attrs.get("pad", (0, 0)))
+        stride = tuple(attrs.get("stride", (1, 1)))
+        s1 = mx.sym.Convolution(
+            data, kernel=(kernel[0], 1), pad=(pad[0], 0),
+            stride=(stride[0], 1), num_filter=K, no_bias=True,
+            name=node["name"] + "_v")
+        return mx.sym.Convolution(
+            s1, kernel=(1, kernel[1]), pad=(0, pad[1]),
+            stride=(1, stride[1]), num_filter=W.shape[0],
+            no_bias=b is None, name=node["name"] + "_h")
+
+    def arg_handle(arg_shape_dic, new_args):
+        new_args[layer + "_v_weight"] = mx.nd.array(V)
+        new_args[layer + "_h_weight"] = mx.nd.array(H)
+        assert tuple(V.shape) == arg_shape_dic[layer + "_v_weight"]
+        assert tuple(H.shape) == arg_shape_dic[layer + "_h_weight"]
+        if b is not None:
+            new_args[layer + "_h_bias"] = b.copy()
+
+    return utils.replace_layers(sym, arg_params,
+                                {layer: (sym_handle, arg_handle)},
+                                data_shape)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-m", "--model", required=True,
+                    help="checkpoint prefix to speed up")
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--layer", required=True)
+    ap.add_argument("--K", type=int, required=True)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--data-shape", default="1,3,224,224")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.data_shape.split(","))
+    sym, arg_params, aux_params = utils.load_checkpoint(
+        args.model, args.load_epoch)
+    new_sym, new_args = conv_vh_decomposition(
+        sym, arg_params, args.layer, args.K, shape)
+    utils.save_checkpoint(args.save_model, 1, new_sym, new_args,
+                          aux_params)
+
+
+if __name__ == "__main__":
+    main()

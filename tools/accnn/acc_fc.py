@@ -1,0 +1,67 @@
+"""SVD decomposition of a FullyConnected layer.
+
+Capability port of the reference tools/accnn/acc_fc.py:1: a trained FC
+weight W (N, M) factors into W2 @ W1 with W1 = S_k V_k (K, M, no bias)
+and W2 = U_k (N, K, carries the original bias).
+"""
+import argparse
+
+import numpy as np
+
+import utils
+
+import mxnet_tpu as mx
+
+
+def fc_factors(W, K):
+    u, s, v = np.linalg.svd(W, full_matrices=False)
+    W1 = (s[:K, None] * v[:K, :])       # (K, M)
+    W2 = u[:, :K]                       # (N, K)
+    return W1.astype(W.dtype), W2.astype(W.dtype)
+
+
+def fc_decomposition(sym, arg_params, layer, K, data_shape):
+    W = np.asarray(arg_params[layer + "_weight"].asnumpy())
+    b = arg_params.get(layer + "_bias")
+    W1, W2 = fc_factors(W.reshape(W.shape[0], -1), K)
+
+    def sym_handle(data, node):
+        s1 = mx.sym.FullyConnected(data, num_hidden=K, no_bias=True,
+                                   name=node["name"] + "_red")
+        return mx.sym.FullyConnected(s1, num_hidden=W.shape[0],
+                                     no_bias=b is None,
+                                     name=node["name"] + "_rec")
+
+    def arg_handle(arg_shape_dic, new_args):
+        new_args[layer + "_red_weight"] = mx.nd.array(
+            W1.reshape(arg_shape_dic[layer + "_red_weight"]))
+        new_args[layer + "_rec_weight"] = mx.nd.array(
+            W2.reshape(arg_shape_dic[layer + "_rec_weight"]))
+        if b is not None:
+            new_args[layer + "_rec_bias"] = b.copy()
+
+    return utils.replace_layers(sym, arg_params,
+                                {layer: (sym_handle, arg_handle)},
+                                data_shape)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-m", "--model", required=True)
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--layer", required=True)
+    ap.add_argument("--K", type=int, required=True)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--data-shape", default="1,3,224,224")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.data_shape.split(","))
+    sym, arg_params, aux_params = utils.load_checkpoint(
+        args.model, args.load_epoch)
+    new_sym, new_args = fc_decomposition(sym, arg_params, args.layer,
+                                         args.K, shape)
+    utils.save_checkpoint(args.save_model, 1, new_sym, new_args,
+                          aux_params)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Whole-network low-rank acceleration driver.
+
+Capability port of the reference tools/accnn/accnn.py:1: pick per-layer
+ranks for a target speedup (rank_selection), then apply the VH
+decomposition to every convolution (acc_conv) — one pass, emitting an
+accelerated checkpoint whose outputs approximate the original's.
+
+    python accnn.py -m model_prefix --load-epoch 1 --ratio 2 \
+        --save-model model_acc --data-shape 1,3,224,224
+"""
+import argparse
+
+import acc_conv
+import rank_selection
+import utils
+
+
+def accelerate(sym, arg_params, aux_params, data_shape, ratio=2.0,
+               min_rank=4):
+    ranks, stats = rank_selection.get_ranksel(
+        sym, arg_params, data_shape, speedup_ratio=ratio,
+        min_rank=min_rank)
+    cur_sym, cur_args = sym, arg_params
+    for layer, K in ranks.items():
+        cur_sym, cur_args = acc_conv.conv_vh_decomposition(
+            cur_sym, cur_args, layer, K, data_shape)
+    return cur_sym, cur_args, aux_params, ranks, stats
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-m", "--model", required=True)
+    ap.add_argument("--load-epoch", type=int, default=1)
+    ap.add_argument("--ratio", type=float, default=2.0,
+                    help="target conv-FLOP speedup")
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--data-shape", default="1,3,224,224")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.data_shape.split(","))
+    sym, arg_params, aux_params = utils.load_checkpoint(
+        args.model, args.load_epoch)
+    new_sym, new_args, aux, ranks, stats = accelerate(
+        sym, arg_params, aux_params, shape, args.ratio)
+    print("ranks:", ranks)
+    print("conv flops: %.3g -> %.3g (%.2fx)"
+          % (stats["orig_flops"], stats["new_flops"], stats["speedup"]))
+    utils.save_checkpoint(args.save_model, 1, new_sym, new_args, aux)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Graph-surgery utilities for the accnn low-rank toolkit.
+
+Capability port of the reference tools/accnn/utils.py:1 — rebuild a
+Symbol from its JSON while handing selected layers to a replacement
+callback, preserving every other op and the trained parameters.
+"""
+import ast
+import copy
+import json
+from collections import deque
+
+import mxnet_tpu as mx
+
+
+def load_checkpoint(prefix, epoch):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    return sym, arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, sym, arg_params, aux_params):
+    mx.model.save_checkpoint(prefix, epoch, sym, arg_params, aux_params)
+
+
+def topsort(nodes):
+    """Topological order of graph-json nodes, inputs re-indexed
+    (reference utils.py:topsort)."""
+    n = len(nodes)
+    deg = [0] * n
+    g = [[] for _ in range(n)]
+    for i, node in enumerate(nodes):
+        for j in node.get("inputs", []):
+            deg[i] += 1
+            g[j[0]].append(i)
+    q = deque(i for i in range(n) if deg[i] == 0)
+    res = []
+    while q:
+        i = q.popleft()
+        res.append(nodes[i])
+        for j in g[i]:
+            deg[j] -= 1
+            if deg[j] == 0:
+                q.append(j)
+    new_ids = {node["name"]: i for i, node in enumerate(res)}
+    for node in res:
+        for j in node.get("inputs", []):
+            j[0] = new_ids[nodes[j[0]]["name"]]
+    return res
+
+
+def node_attrs(node):
+    """Python-typed attr dict of a graph-json node."""
+    raw = node.get("attrs", node.get("param", {})) or {}
+    out = {}
+    for k, v in raw.items():
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v
+    return out
+
+
+def _is_param(node):
+    name = node["name"]
+    return node["op"] == "null" and (
+        name.endswith(("_weight", "_bias", "_gamma", "_beta"))
+        or "moving_" in name or "_mu" in name)
+
+
+def sym_factory(node, data_inputs):
+    op = getattr(mx.sym, node["op"])
+    if len(data_inputs) == 1:
+        return op(data_inputs[0], name=node["name"], **node_attrs(node))
+    return op(*data_inputs, name=node["name"], **node_attrs(node))
+
+
+def replace_layers(sym, arg_params, handlers, data_shape):
+    """Rebuild ``sym`` with each layer named in ``handlers`` replaced.
+
+    handlers: {layer_name: (sym_handle, arg_handle)} where
+    ``sym_handle(data_sym, node) -> new_sym`` builds the substitute
+    subgraph and ``arg_handle(arg_shape_dic, new_arg_params)`` installs
+    its weights.  Returns (new_sym, new_arg_params).
+    Reference utils.py:replace_conv_layer generalized to several layers
+    per pass and to multi-input ops.
+    """
+    nodes = topsort(json.loads(sym.tojson())["nodes"])
+    sym_of = {}
+    result = None
+    for node in nodes:
+        name = node["name"]
+        if node["op"] == "null":
+            if not _is_param(node):
+                sym_of[name] = mx.sym.Variable(name)
+            continue
+        data_inputs = []
+        for j in node.get("inputs", []):
+            src = nodes[j[0]]
+            if _is_param(src) or src["name"].startswith(name):
+                continue
+            if src["name"] in sym_of:
+                data_inputs.append(sym_of[src["name"]])
+        if name in handlers:
+            out = handlers[name][0](data_inputs[0], node)
+        else:
+            out = sym_factory(node, data_inputs)
+        sym_of[name] = out
+        result = out
+
+    new_args = copy.deepcopy(dict(arg_params))
+    # drop the replaced layers' original weights, add the factors
+    for name in handlers:
+        for suffix in ("_weight", "_bias"):
+            new_args.pop(name + suffix, None)
+    arg_shapes, _, _ = result.infer_shape(data=data_shape)
+    arg_shape_dic = dict(zip(result.list_arguments(), arg_shapes))
+    for name in handlers:
+        handlers[name][1](arg_shape_dic, new_args)
+    return result, new_args

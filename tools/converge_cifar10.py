@@ -1,4 +1,4 @@
-"""Convergence-at-accuracy on the real chip -> CONVERGE_r04.json.
+"""Convergence-at-accuracy on the real chip -> CONVERGE_r05.json.
 
 The reference's convergence tier trains cifar10 to a fixed accuracy
 (tests/python/train/test_dtype.py; example train_cifar10.py recipe:
@@ -12,7 +12,10 @@ into RecordIO so the full production feed path runs: native libjpeg
 decode -> uint8 NHWC batches -> on-device normalize folded into the
 fused bf16 train step.
 
-Records epochs-to-target, wall-clock, final val accuracy, dtype.
+Round 5: runs the SAME recipe in bfloat16 AND float32 from identical
+seeds and records both val-acc curves — the dtype-parity claim that
+protects the bf16-default training path (reference anchor:
+example/image-classification/README.md:311-315 trains across dtypes).
 """
 import json
 import os
@@ -52,7 +55,9 @@ def main():
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--target-acc", type=float, default=0.90)
     ap.add_argument("--max-epochs", type=int, default=30)
-    ap.add_argument("--out", type=str, default="CONVERGE_r04.json")
+    ap.add_argument("--out", type=str, default="CONVERGE_r05.json")
+    ap.add_argument("--dtypes", type=str,
+                    default="bfloat16,float32")
     args = ap.parse_args()
 
     def synthetic_cifar(num, num_classes=10, seed=0):
@@ -98,21 +103,6 @@ def main():
     mean = jnp.array([125.3, 122.9, 113.9], jnp.float32)
     std = jnp.array([51.6, 50.8, 51.7], jnp.float32)
 
-    def data_tf(x):
-        x = (x.astype(jnp.float32) - mean) / std
-        return jnp.transpose(x, (0, 3, 1, 2)).astype(jnp.bfloat16)
-
-    tr = SPMDTrainer(sym, "sgd",
-                     {"learning_rate": args.lr, "momentum": 0.9,
-                      "wd": 1e-4, "rescale_grad": 1.0 / args.batch_size},
-                     mesh=None, compute_dtype="bfloat16",
-                     input_transforms={"data": data_tf})
-    tr.bind([("data", (args.batch_size, 3, 32, 32))],
-            [("softmax_label", (args.batch_size,))])
-    mx.random.seed(7)
-    tr.init_params(mx.initializer.Xavier(rnd_type="gaussian",
-                                         factor_type="in", magnitude=2))
-
     def make_iter(split, train):
         return mx.io.ImageRecordIter(
             path_imgrec=os.path.join(tmp, split + ".rec"),
@@ -121,60 +111,94 @@ def main():
             shuffle=train, rand_mirror=train, preprocess_threads=4,
             prefetch_buffer=4, dtype="uint8", layout="NHWC", seed=5)
 
-    train_it = make_iter("train", True)
-    val_it = make_iter("val", False)
+    def run_dtype(dtype):
+        """One full convergence run at the given compute dtype, from
+        identical data, identical init seed, identical iterator seed."""
+        cdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
-    hist = []
-    tic = time.time()
-    reached = None
-    for epoch in range(args.max_epochs):
-        n = 0
-        for b in train_it:
-            tr.step(b.data[0], b.label[0])
-            n += args.batch_size
-        train_it.reset()
-        jax.block_until_ready(tr.params)
-        correct = total = 0
-        for b in val_it:
-            outs = tr.forward_only(b.data[0], b.label[0])
-            pred = np.asarray(outs[0]).argmax(-1)
-            lab = np.asarray(b.label[0].asnumpy())
-            k = args.batch_size - b.pad
-            correct += (pred[:k] == lab[:k]).sum()
-            total += k
-        val_it.reset()
-        acc = correct / total
-        hist.append(round(float(acc), 4))
-        print("epoch %d val-acc %.4f (%.1fs)" % (epoch, acc,
-                                                 time.time() - tic))
-        if acc >= args.target_acc and reached is None:
-            reached = epoch + 1
-            break
-    wall = time.time() - tic
+        def data_tf(x):
+            x = (x.astype(jnp.float32) - mean) / std
+            return jnp.transpose(x, (0, 3, 1, 2)).astype(cdt)
+
+        tr = SPMDTrainer(sym, "sgd",
+                         {"learning_rate": args.lr, "momentum": 0.9,
+                          "wd": 1e-4,
+                          "rescale_grad": 1.0 / args.batch_size},
+                         mesh=None, compute_dtype=dtype,
+                         input_transforms={"data": data_tf})
+        tr.bind([("data", (args.batch_size, 3, 32, 32))],
+                [("softmax_label", (args.batch_size,))])
+        mx.random.seed(7)
+        tr.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                             factor_type="in",
+                                             magnitude=2))
+        train_it = make_iter("train", True)
+        val_it = make_iter("val", False)
+        hist = []
+        tic = time.time()
+        reached = None
+        for epoch in range(args.max_epochs):
+            for b in train_it:
+                tr.step(b.data[0], b.label[0])
+            train_it.reset()
+            correct = total = 0
+            for b in val_it:
+                # the val fetch is the TRUE epoch sync point on this
+                # tunneled backend (block_until_ready acks dispatch only)
+                outs = tr.forward_only(b.data[0], b.label[0])
+                pred = np.asarray(outs[0]).argmax(-1)
+                lab = np.asarray(b.label[0].asnumpy())
+                k = args.batch_size - b.pad
+                correct += (pred[:k] == lab[:k]).sum()
+                total += k
+            val_it.reset()
+            acc = correct / total
+            hist.append(round(float(acc), 4))
+            print("[%s] epoch %d val-acc %.4f (%.1fs)"
+                  % (dtype, epoch, acc, time.time() - tic))
+            if acc >= args.target_acc and reached is None:
+                reached = epoch + 1
+                break
+        wall = time.time() - tic
+        train_it.close()
+        val_it.close()
+        tr.close()
+        return {
+            "compute_dtype": dtype,
+            "target_val_acc": args.target_acc,
+            "epochs_to_target": reached,
+            "final_val_acc": hist[-1] if hist else None,
+            "val_acc_per_epoch": hist,
+            "wall_clock_s": round(wall, 1),
+            "imgs_per_sec_end_to_end": round(
+                args.num_train * len(hist) / wall, 1),
+        }
+
+    curves = {}
+    for dtype in args.dtypes.split(","):
+        curves[dtype] = run_dtype(dtype.strip())
+
     out = {
         "workload": "train_cifar10 recipe (resnet-20, sgd m=0.9 wd=1e-4, "
                     "lr=%g, batch=%d) on synthetic CIFAR stand-in "
-                    "(no egress), full RecordIO->native-decode->bf16 "
-                    "fused-step path on the real chip" % (args.lr,
-                                                          args.batch_size),
+                    "(no egress), full RecordIO->native-decode->fused-"
+                    "step path on the real chip; identical seeds per "
+                    "dtype" % (args.lr, args.batch_size),
         "platform": "%s (%s)" % (jax.default_backend(),
                                  jax.devices()[0].device_kind),
-        "compute_dtype": "bfloat16",
         "num_train": args.num_train,
         "num_val": args.num_val,
-        "target_val_acc": args.target_acc,
-        "epochs_to_target": reached,
-        "final_val_acc": hist[-1] if hist else None,
-        "val_acc_per_epoch": hist,
-        "wall_clock_s": round(wall, 1),
-        "imgs_per_sec_end_to_end": round(
-            args.num_train * len(hist) / wall, 1),
+        "curves": curves,
     }
+    if "bfloat16" in curves and "float32" in curves:
+        b, f = curves["bfloat16"], curves["float32"]
+        out["bf16_final_minus_f32_final"] = round(
+            (b["final_val_acc"] or 0) - (f["final_val_acc"] or 0), 4)
+        out["bf16_within_noise_of_f32"] = bool(
+            abs(out["bf16_final_minus_f32_final"]) <= 0.02)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
-    train_it.close()
-    val_it.close()
 
 
 if __name__ == "__main__":
