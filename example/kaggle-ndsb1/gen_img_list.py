@@ -1,0 +1,92 @@
+"""Generate train/val/test image lists for the NDSB-1 layout.
+
+Capability port of the reference example/kaggle-ndsb1/gen_img_list.py:1:
+walks a train directory of one-subfolder-per-class images, writes a
+shuffled tab-separated ``.lst`` (index, label, path) usable by
+tools/im2rec.py, and optionally splits into tr/va with STRATIFIED
+sampling (the competition had 121 wildly imbalanced plankton classes —
+a uniform split starves the small ones).
+
+    python gen_img_list.py --image-folder data/train/ --train --stratified
+    python gen_img_list.py --image-folder data/test/ --out-file test.lst
+"""
+import argparse
+import csv
+import os
+import random
+
+
+def class_names(image_folder):
+    return sorted(d for d in os.listdir(image_folder)
+                  if os.path.isdir(os.path.join(image_folder, d)))
+
+
+def build_train_list(image_folder):
+    names = class_names(image_folder)
+    img_lst = []
+    cnt = 0
+    for label, cls in enumerate(names):
+        d = os.path.join(image_folder, cls)
+        for img in sorted(os.listdir(d)):
+            img_lst.append((cnt, label, os.path.join(d, img)))
+            cnt += 1
+    return img_lst, names
+
+
+def stratified_split(img_lst, percent_val):
+    """Per-class split so every class keeps ~percent_val in va."""
+    by_class = {}
+    for item in img_lst:
+        by_class.setdefault(item[1], []).append(item)
+    tr, va = [], []
+    for items in by_class.values():
+        random.shuffle(items)
+        k = max(1, int(len(items) * percent_val))
+        va.extend(items[:k])
+        tr.extend(items[k:])
+    random.shuffle(tr)
+    random.shuffle(va)
+    return tr, va
+
+
+def write_lst(path, items):
+    with open(path, "w") as f:
+        w = csv.writer(f, delimiter="\t", lineterminator="\n")
+        for item in items:
+            w.writerow(item)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-folder", default="data/train/")
+    ap.add_argument("--out-folder", default="data/")
+    ap.add_argument("--out-file", default="train.lst")
+    ap.add_argument("--train", action="store_true")
+    ap.add_argument("--percent-val", type=float, default=0.25)
+    ap.add_argument("--stratified", action="store_true")
+    args = ap.parse_args(argv)
+    random.seed(888)
+
+    if args.train:
+        img_lst, names = build_train_list(args.image_folder)
+        with open(os.path.join(args.out_folder, "classes.txt"), "w") as f:
+            f.write("\n".join(names))
+        if args.stratified:
+            tr, va = stratified_split(img_lst, args.percent_val)
+        else:
+            random.shuffle(img_lst)
+            k = int(len(img_lst) * args.percent_val)
+            tr, va = img_lst[k:], img_lst[:k]
+        write_lst(os.path.join(args.out_folder, "tr.lst"), tr)
+        write_lst(os.path.join(args.out_folder, "va.lst"), va)
+        random.shuffle(img_lst)
+        write_lst(os.path.join(args.out_folder, args.out_file), img_lst)
+        return len(tr), len(va)
+    imgs = [(i, 0, os.path.join(args.image_folder, f))
+            for i, f in enumerate(sorted(os.listdir(args.image_folder)))]
+    write_lst(os.path.join(args.out_folder, args.out_file), imgs)
+    return len(imgs), 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Write a Kaggle-format probability submission.
+
+Capability port of the reference example/kaggle-ndsb1/submission_dsb.py:1
+— per-class probabilities, one row per test image, class names as the
+header, `image` as the index column — generalized to take the class
+list from gen_img_list's classes.txt instead of a hardcoded 121-name
+string.
+"""
+import csv
+import gzip
+
+
+def gen_sub(predictions, image_names, class_names, submission_path,
+            compress=True):
+    if len(predictions) != len(image_names):
+        raise ValueError("predictions/rows mismatch: %d vs %d"
+                         % (len(predictions), len(image_names)))
+    if predictions.shape[1] != len(class_names):
+        raise ValueError("class-count mismatch: %d probs vs %d names"
+                         % (predictions.shape[1], len(class_names)))
+    with open(submission_path, "w") as f:
+        w = csv.writer(f, lineterminator="\n")
+        w.writerow(["image"] + list(class_names))
+        for name, row in zip(image_names, predictions):
+            w.writerow([name] + ["%.6f" % p for p in row])
+    if compress:
+        with open(submission_path, "rb") as f:
+            blob = f.read()
+        with gzip.open(submission_path + ".gz", "wb") as f:
+            f.write(blob)
+    return submission_path

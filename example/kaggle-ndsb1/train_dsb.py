@@ -1,0 +1,165 @@
+"""Train the NDSB-1 plankton classifier end to end.
+
+Capability port of the reference example/kaggle-ndsb1/train_dsb.py:1 +
+symbol_dsb.py: the full competition workflow — class-dir images →
+gen_img_list (stratified tr/va) → tools/im2rec packing → ImageRecordIter
+→ a small 48px conv net → fit with FactorScheduler LR decay and
+gradient clipping → predict_dsb-style probability CSV.
+
+With no dataset present (this environment has no egress) a synthetic
+plankton stand-in is generated into the same directory layout, so the
+IDENTICAL pipeline runs.
+
+    python train_dsb.py --num-epochs 4
+"""
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def get_symbol(num_classes):
+    """symbol_dsb: a compact 48px conv net (the reference's
+    conv-conv-pool x2 + fc shape, scaled to run anywhere)."""
+    data = mx.sym.Variable("data")
+    net = data
+    for i, nf in enumerate((32, 64)):
+        net = mx.sym.Convolution(net, num_filter=nf, kernel=(3, 3),
+                                 pad=(1, 1), name="conv%da" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Convolution(net, num_filter=nf, kernel=(3, 3),
+                                 pad=(1, 1), name="conv%db" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_synthetic_dataset(root, num_classes=8, per_class=40, side=56):
+    """Class-subfolder JPEG layout, like the unpacked Kaggle archive.
+    Templates are LOW-FREQUENCY blobs (blurred noise + a class tint), so
+    class evidence survives the random-crop translation — plankton-like,
+    not white noise."""
+    import cv2
+    rs = np.random.RandomState(3)
+    tints = rs.rand(num_classes, 3) * 120 + 40
+    templates = np.stack([
+        cv2.GaussianBlur(rs.rand(side, side).astype(np.float32) * 255,
+                         (15, 15), 6) for _ in range(num_classes)])
+    for c in range(num_classes):
+        d = os.path.join(root, "train", "plankton_%02d" % c)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            mono = templates[c] + rs.randn(side, side) * 20
+            img = mono[..., None] / 255.0 * tints[c] + 60
+            img = np.clip(img, 0, 255).astype(np.uint8)
+            cv2.imwrite(os.path.join(d, "%05d.jpg" % i), img)
+    return os.path.join(root, "train")
+
+
+def pack(prefix, root):
+    """tools/im2rec.py packs <prefix>.lst into <prefix>.rec/.idx (the
+    lst carries absolute paths, so root contributes nothing)."""
+    subprocess.run([sys.executable,
+                    os.path.join(REPO, "tools", "im2rec.py"),
+                    prefix, root], check=True)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None,
+                    help="train/ dir of class subfolders; default: "
+                         "synthesize one")
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--lr-factor", type=float, default=0.5)
+    ap.add_argument("--lr-factor-epoch", type=float, default=4)
+    ap.add_argument("--clip-gradient", type=float, default=5.0)
+    ap.add_argument("--num-epochs", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--data-shape", type=int, default=48)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--save-model-prefix", default=None)
+    args = ap.parse_args(argv)
+
+    work = tempfile.mkdtemp(prefix="ndsb1_")
+    train_dir = args.data_dir or make_synthetic_dataset(work)
+
+    import gen_img_list
+    gen_img_list.main(["--image-folder", train_dir,
+                       "--out-folder", work + "/", "--train",
+                       "--stratified"])
+    names = open(os.path.join(work, "classes.txt")).read().split()
+    num_classes = len(names)
+
+    for split in ("tr", "va"):
+        pack(os.path.join(work, split), "/")
+
+    shape = (3, args.data_shape, args.data_shape)
+
+    def make_iter(split, train):
+        return mx.io.ImageRecordIter(
+            path_imgrec=os.path.join(work, split + ".rec"),
+            path_imgidx=os.path.join(work, split + ".idx"),
+            data_shape=shape, batch_size=args.batch_size,
+            shuffle=train, rand_crop=train, rand_mirror=train,
+            mean_r=128, mean_g=128, mean_b=128,
+            std_r=60, std_g=60, std_b=60,
+            preprocess_threads=2, prefetch_buffer=4, seed=1)
+
+    train_it, val_it = make_iter("tr", True), make_iter("va", False)
+
+    epoch_size = max(sum(1 for _ in train_it), 1)
+    train_it.reset()
+    sched = mx.lr_scheduler.FactorScheduler(
+        step=max(int(epoch_size * args.lr_factor_epoch), 1),
+        factor=args.lr_factor)
+
+    mod = mx.mod.Module(get_symbol(num_classes))
+    mod.fit(train_it, eval_data=val_it,
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4, "lr_scheduler": sched,
+                              "clip_gradient": args.clip_gradient},
+            kvstore=args.kv_store,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10),
+            num_epoch=args.num_epochs)
+    res = dict(mod.score(val_it, mx.metric.create("acc")))
+    logging.info("val accuracy %.4f", res["accuracy"])
+
+    if args.save_model_prefix:
+        mod.save_checkpoint(args.save_model_prefix, args.num_epochs)
+
+    # competition submission: per-class probabilities, header = classes
+    import submission_dsb
+    sub = os.path.join(work, "submission.csv")
+    val_it.reset()
+    probs = mod.predict(val_it).asnumpy()
+    ids = ["img_%d.jpg" % i for i in range(len(probs))]
+    submission_dsb.gen_sub(probs, ids, names, sub)
+    logging.info("wrote %s", sub)
+    train_it.close()
+    val_it.close()
+    return res["accuracy"], sub
+
+
+if __name__ == "__main__":
+    main()

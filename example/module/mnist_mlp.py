@@ -1,0 +1,114 @@
+"""Module API walk-through: intermediate loop, fit(), and every predict
+variant.
+
+Capability port of the reference example/module/mnist_mlp.py:1.  MNIST
+(no egress) is replaced by a synthetic digits stand-in with the same
+(784,) flat shape; every API exercised by the reference runs: the
+intermediate-level forward/update_metric/backward/update loop, the
+high-level ``fit``, ``iter_predict``, ``predict`` with and without
+``merge_batches``, and ``score``.
+
+    python mnist_mlp.py
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def synthetic_mnist(num, seed=0, num_classes=10):
+    """Flat (784,) 'digits': class template blobs + noise — linearly
+    separable enough for an MLP, not for nothing."""
+    rs = np.random.RandomState(42)
+    templates = rs.rand(num_classes, 784).astype("f")
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, num).astype("f")
+    X = templates[y.astype(int)] + rs.randn(num, 784).astype("f") * 0.5
+    return X, y
+
+
+def mlp_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main(n_epoch=2, batch_size=100, n_train=2000, n_val=500):
+    logging.basicConfig(level=logging.INFO)
+    Xtr, ytr = synthetic_mnist(n_train, seed=0)
+    Xv, yv = synthetic_mnist(n_val, seed=1)
+    train_iter = mx.io.NDArrayIter(Xtr, ytr, batch_size=batch_size,
+                                   shuffle=True)
+    val_iter = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size)
+    softmax = mlp_sym()
+
+    # ---- intermediate-level API ----------------------------------------
+    mod = mx.mod.Module(softmax)
+    mod.bind(data_shapes=train_iter.provide_data,
+             label_shapes=train_iter.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    for i_epoch in range(n_epoch):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            mod.forward(batch)
+            mod.update_metric(metric, batch.label)
+            mod.backward()
+            mod.update()
+        for name, val in metric.get_name_value():
+            print("epoch %03d: %s=%f" % (i_epoch, name, val))
+
+    # ---- high-level API -------------------------------------------------
+    train_iter.reset()
+    mod = mx.mod.Module(softmax)
+    mod.fit(train_iter, eval_data=val_iter,
+            initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=n_epoch)
+
+    # prediction iterator API
+    for preds, i_batch, batch in mod.iter_predict(val_iter):
+        pred_label = preds[0].asnumpy().argmax(axis=1)
+        label = batch.label[0].asnumpy().astype("int32")
+        if i_batch % 5 == 0:
+            print("batch %03d acc: %.3f"
+                  % (i_batch, (label == pred_label).mean()))
+
+    # merged prediction
+    preds = mod.predict(val_iter)
+    assert preds.shape[0] >= n_val
+
+    # per-batch prediction + manual accuracy
+    preds = mod.predict(val_iter, merge_batches=False)
+    val_iter.reset()
+    acc_sum, acc_cnt = 0.0, 0
+    for i, batch in enumerate(val_iter):
+        pred_label = preds[i][0].asnumpy().argmax(axis=1)
+        label = batch.label[0].asnumpy().astype("int32")
+        k = batch.data[0].shape[0] - batch.pad
+        acc_sum += (label[:k] == pred_label[:k]).sum()
+        acc_cnt += k
+    print("validation accuracy (manual): %.3f" % (acc_sum / acc_cnt))
+
+    # metric-based scoring
+    mod.score(val_iter, metric)
+    for name, val in metric.get_name_value():
+        print("%s=%f" % (name, val))
+    return acc_sum / acc_cnt
+
+
+if __name__ == "__main__":
+    main()

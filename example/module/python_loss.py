@@ -1,0 +1,80 @@
+"""A loss function written in pure Python, composed as a module.
+
+Capability port of the reference example/module/python_loss.py:1: the
+network is a plain Module producing raw scores; the multiclass-hinge
+LOSS is a ``PythonLossModule`` whose gradient is a numpy function; a
+``SequentialModule`` wires them (take_labels + auto_wiring) so
+fit/predict work end to end with no Symbol-level loss at all.
+
+    python python_loss.py
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mc_hinge_grad(scores, labels):
+    """d/d(scores) of the Crammer-Singer multiclass hinge loss
+    (the reference uses numba.jit; vectorized numpy is as fast here)."""
+    scores = scores.asnumpy()
+    labels = labels.asnumpy().astype(int)
+    n = scores.shape[0]
+    rows = np.arange(n)
+    margin = 1.0 + scores - scores[rows, labels][:, None]
+    margin[rows, labels] = 0.0
+    ind_pred = margin.argmax(axis=1)
+    grad = np.zeros_like(scores)
+    grad[rows, labels] -= 1.0
+    grad[rows, ind_pred] += 1.0
+    return grad
+
+
+def main(n_epoch=4, batch_size=100, n_train=2000):
+    logging.basicConfig(level=logging.INFO)
+    from mnist_mlp import synthetic_mnist
+    Xtr, ytr = synthetic_mnist(n_train, seed=0)
+    Xv, yv = synthetic_mnist(500, seed=1)
+    train_iter = mx.io.NDArrayIter(Xtr, ytr, batch_size=batch_size,
+                                   shuffle=True)
+    val_iter = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    scores = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+
+    mlp = mx.mod.Module(scores, label_names=[])
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+    mod = mx.mod.SequentialModule() \
+        .add(mlp) \
+        .add(loss, take_labels=True, auto_wiring=True)
+
+    mod.fit(train_iter, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            num_epoch=n_epoch)
+
+    # accuracy of the raw scores
+    val_iter.reset()
+    correct = total = 0
+    for preds, _i, batch in mod.iter_predict(val_iter):
+        pred = preds[0].asnumpy().argmax(axis=1)
+        lab = batch.label[0].asnumpy().astype(int)
+        k = batch.data[0].shape[0] - batch.pad
+        correct += (pred[:k] == lab[:k]).sum()
+        total += k
+    acc = correct / total
+    print("hinge-trained accuracy: %.3f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Chaining two symbol modules with SequentialModule.
+
+Capability port of the reference example/module/sequential_module.py:1:
+the MLP splits into two Modules (features, then classifier) chained by
+``SequentialModule(take_labels, auto_wiring)`` — the container
+forwards activations, routes labels to the tail, and backpropagates
+input gradients across the boundary.  On a multi-chip host each stage
+can carry its own context list (the reference's data+model parallel
+demo); here both run on the default device.
+
+    python sequential_module.py
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import mxnet_tpu as mx
+
+
+def main(n_epoch=2, batch_size=100, n_train=2000):
+    logging.basicConfig(level=logging.INFO)
+    from mnist_mlp import synthetic_mnist
+    Xtr, ytr = synthetic_mnist(n_train, seed=0)
+    Xv, yv = synthetic_mnist(500, seed=1)
+    train_iter = mx.io.NDArrayIter(Xtr, ytr, batch_size=batch_size,
+                                   shuffle=True)
+    val_iter = mx.io.NDArrayIter(Xv, yv, batch_size=batch_size)
+
+    # module 1: feature stage
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    mod1 = mx.mod.Module(act1, label_names=[])
+
+    # module 2: classifier stage
+    data = mx.sym.Variable("data")
+    fc2 = mx.sym.FullyConnected(data, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    softmax = mx.sym.SoftmaxOutput(fc3, name="softmax")
+    mod2 = mx.mod.Module(softmax)
+
+    mod_seq = mx.mod.SequentialModule()
+    mod_seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    mod_seq.fit(train_iter, eval_data=val_iter,
+                initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                num_epoch=n_epoch)
+    res = dict(mod_seq.score(val_iter, mx.metric.create("acc")))
+    print("sequential accuracy:", res)
+    return res["accuracy"]
+
+
+if __name__ == "__main__":
+    main()

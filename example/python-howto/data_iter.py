@@ -1,0 +1,63 @@
+"""Create a RecordIO image iterator (annotated parameter tour).
+
+Capability port of the reference example/python-howto/data_iter.py:1.
+Packs a small synthetic RecordIO set first (no egress), then walks the
+ImageRecordIter parameters the reference annotates.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_dataset(prefix, n=64, side=36):
+    import cv2
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rs = np.random.RandomState(0)
+    for i in range(n):
+        img = (rs.rand(side, side, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), buf.tobytes()))
+    rec.close()
+    return prefix
+
+
+def main():
+    prefix = make_dataset(os.path.join(tempfile.mkdtemp(), "toy"))
+    dataiter = mx.io.ImageRecordIter(
+        # Dataset parameters: the record file (and its index)
+        path_imgrec=prefix + ".rec",
+        path_imgidx=prefix + ".idx",
+        # image size after preprocessing
+        data_shape=(3, 28, 28),
+        # how many images per batch
+        batch_size=25,
+        # Augmentation parameters
+        rand_crop=True,      # random crop of data_shape from the source
+        rand_mirror=True,    # random horizontal flip
+        shuffle=False,
+        # Backend parameters: decode threads + prefetch depth (a backend
+        # pipeline hides IO cost exactly like the reference's C++ one)
+        preprocess_threads=4,
+        prefetch_buffer=4,
+        # round the last batch with wrapped samples + pad accounting
+        round_batch=True)
+
+    for batchidx, dbatch in enumerate(dataiter):
+        label = dbatch.label[0]
+        print("Batch", batchidx, "pad", dbatch.pad)
+        print(label.asnumpy().flatten())
+    dataiter.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Inspect a convolution with a Monitor (reference
+example/python-howto/debug_conv.py:1): install a Monitor on the
+executor group and forward a ones batch."""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import mxnet_tpu as mx
+
+data_shape = (1, 3, 5, 5)
+
+
+class SimpleData(object):
+    def __init__(self, data):
+        self.data = data
+        self.label = []
+        self.pad = 0
+
+
+def main():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                              stride=(1, 1), num_filter=1)
+    mon = mx.mon.Monitor(1)
+
+    mod = mx.mod.Module(conv, label_names=[])
+    mod.bind(data_shapes=[("data", data_shape)], for_training=False)
+    mod._exec_group.install_monitor(mon)
+    mod.init_params(mx.initializer.Xavier())
+
+    mon.tic()
+    mod.forward(SimpleData([mx.nd.ones(data_shape)]))
+    res = mod.get_outputs()[0].asnumpy()
+    print(res)
+    for name, handle, value in mon.toc():
+        print(name, handle, value)
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Watch weight/gradient norms during training (reference
+example/python-howto/monitor_weights.py:1): a Monitor with a custom
+statistic installed through model.fit."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "module")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main(num_epoch=2):
+    logging.basicConfig(level=logging.INFO)
+    from mnist_mlp import mlp_sym, synthetic_mnist
+    X, y = synthetic_mnist(2000, seed=0)
+    Xv, yv = synthetic_mnist(500, seed=1)
+    train = mx.io.NDArrayIter(X, y, batch_size=100, shuffle=True)
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=100)
+
+    def norm_stat(d):
+        return mx.nd.norm(d) / np.sqrt(d.size)
+
+    mon = mx.mon.Monitor(10, norm_stat)
+    model = mx.model.FeedForward(
+        symbol=mlp_sym(), num_epoch=num_epoch, learning_rate=0.1,
+        momentum=0.9, wd=0.00001,
+        initializer=mx.initializer.Xavier())
+    model.fit(X=train, eval_data=val, monitor=mon,
+              batch_end_callback=mx.callback.Speedometer(100, 10))
+    return model
+
+
+if __name__ == "__main__":
+    main()

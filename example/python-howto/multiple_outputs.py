@@ -1,0 +1,36 @@
+"""Group several outputs into one graph (reference
+example/python-howto/multiple_outputs.py:1)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    net = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(net, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    out = mx.sym.SoftmaxOutput(net, name="softmax")
+    group = mx.sym.Group([fc1, out])
+    print(group.list_outputs())
+
+    # bind on the group: outputs[0] is fc1, outputs[1] is the softmax
+    exe = group.simple_bind(mx.current_context(), data=(4, 784),
+                            softmax_label=(4,), grad_req="null")
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            mx.initializer.Xavier()(mx.initializer.InitDesc(name), arr)
+    exe.arg_dict["data"][:] = np.random.rand(4, 784).astype("f")
+    exe.forward(is_train=False)
+    print("fc1:", exe.outputs[0].shape, "softmax:", exe.outputs[1].shape)
+    return [o.shape for o in exe.outputs]
+
+
+if __name__ == "__main__":
+    main()

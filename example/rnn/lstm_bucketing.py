@@ -69,10 +69,17 @@ def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
 
 def synthetic_corpus(n_sent, vocab_size=200, seed=0):
     """Markov-chain sentences: each token strongly conditions the next, so
-    an LSTM LM can push perplexity well below the uniform baseline."""
+    an LSTM LM can push perplexity well below the uniform baseline.
+
+    The transition STRUCTURE is fixed (its own RandomState) while
+    ``seed`` only varies which sentences are sampled — so corpora drawn
+    with different seeds are train/val splits of the SAME language, not
+    different languages (a val set with a different transition table
+    would make generalization impossible by construction)."""
     rs = np.random.RandomState(seed)
     # sparse transition structure: each token has 4 likely successors
-    succ = rs.randint(1, vocab_size, size=(vocab_size, 4))
+    succ = np.random.RandomState(1234).randint(
+        1, vocab_size, size=(vocab_size, 4))
     sentences = []
     for _ in range(n_sent):
         length = rs.randint(5, 60)

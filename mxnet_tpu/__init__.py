@@ -74,6 +74,7 @@ from .torch_bridge import th
 symbol.contrib = contrib.symbol
 ndarray.contrib = contrib.ndarray
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import profiler
 from .profiler import profiler_set_config, profiler_set_state, dump_profile

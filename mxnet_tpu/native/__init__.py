@@ -15,7 +15,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libmxtpu.so")
-_SRCS = ("engine.cc", "recordio.cc", "imagedec.cc")
+_SRCS = ("engine.cc", "recordio.cc", "imagedec.cc", "im2rec.cc")
 
 _lock = threading.Lock()
 _lib = None
@@ -35,8 +35,10 @@ def _build():
     # Preferred build includes the libjpeg image pipeline; hosts without
     # libjpeg still get the engine + recordio codec (image callers fall
     # back to the cv2 path).
+    _JPEG_SRCS = ("imagedec.cc", "im2rec.cc")
     attempts = [base + srcs + ["-ljpeg"],
-                base + [s for s in srcs if not s.endswith("imagedec.cc")]]
+                base + [s for s in srcs
+                        if not s.endswith(_JPEG_SRCS)]]
     try:
         built = False
         for cmd in attempts:
@@ -133,6 +135,15 @@ def _configure(lib):
         lib._has_imagedec = True
     except AttributeError:
         lib._has_imagedec = False
+    try:
+        lib.MXTPUIm2Rec.restype = ctypes.c_int
+        lib.MXTPUIm2Rec.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib._has_im2rec = True
+    except AttributeError:
+        lib._has_im2rec = False
     return lib
 
 

@@ -1,0 +1,78 @@
+"""Smoke tests for the round-5 example tail: module API demos,
+python-howto notes, and the two Kaggle competition workflows.
+
+Reference parity targets: example/module/{mnist_mlp,python_loss,
+sequential_module}.py, example/python-howto/*, example/kaggle-ndsb1/
+(gen_img_list stratified split + im2rec + train + submission CSV),
+example/kaggle-ndsb2/Train.py (frame-difference LeNet + CDF labels).
+"""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+EX = os.path.join(HERE, "..", "example")
+
+
+def _load(subdir, module_file, name, extra_dirs=()):
+    d = os.path.join(EX, subdir)
+    for p in (d,) + tuple(os.path.join(EX, e) for e in extra_dirs):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(d, module_file))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_module_mnist_mlp_all_apis():
+    mod = _load("module", "mnist_mlp.py", "ex_mnist_mlp")
+    acc = mod.main(n_epoch=2)
+    assert acc > 0.9, acc
+
+
+def test_module_python_loss():
+    mod = _load("module", "python_loss.py", "ex_python_loss")
+    acc = mod.main(n_epoch=3)
+    assert acc > 0.9, acc
+
+
+def test_module_sequential():
+    mod = _load("module", "sequential_module.py", "ex_seq_mod")
+    acc = mod.main(n_epoch=2)
+    assert acc > 0.9, acc
+
+
+def test_python_howto_scripts():
+    d = _load("python-howto", "data_iter.py", "ph_data_iter")
+    d.main()
+    c = _load("python-howto", "debug_conv.py", "ph_debug_conv")
+    assert c.main().shape == (1, 1, 5, 5)
+    m = _load("python-howto", "multiple_outputs.py", "ph_multi_out")
+    # the reference script groups fc1 with a softmax over fc2's 64 units
+    assert m.main() == [(4, 128), (4, 64)]
+    w = _load("python-howto", "monitor_weights.py", "ph_monitor",
+              extra_dirs=("module",))
+    w.main(num_epoch=1)
+
+
+def test_kaggle_ndsb1_pipeline():
+    """Stratified lists -> im2rec -> train -> probability submission."""
+    import csv
+    mod = _load("kaggle-ndsb1", "train_dsb.py", "ex_ndsb1")
+    acc, sub = mod.main(["--num-epochs", "4", "--lr", "0.02"])
+    assert acc > 0.3, acc                      # chance = 0.125
+    rows = list(csv.reader(open(sub)))
+    assert rows[0][0] == "image" and len(rows[0]) == 9  # 8 classes
+    probs = np.array([[float(x) for x in r[1:]] for r in rows[1:]])
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-3)
+    assert os.path.exists(sub + ".gz")
+
+
+def test_kaggle_ndsb2_crps_beats_baseline():
+    mod = _load("kaggle-ndsb2", "train.py", "ex_ndsb2")
+    score, baseline = mod.main(["--num-epochs", "6"])
+    assert score < baseline, (score, baseline)

@@ -118,6 +118,48 @@ def image_encode(args, item, path):
                              img_fmt=args.encoding)
 
 
+def make_record_native(args):
+    """Pack via the C++ packer (native/im2rec.cc — the reference
+    tools/im2rec.cc analog): libjpeg decode -> shorter-edge resize ->
+    libjpeg encode on a worker pool, list-ordered records.  Returns
+    False when the native library is unavailable or the requested
+    options aren't covered (the Python path then serves)."""
+    from mxnet_tpu import native as _native
+    lib = _native.get_lib()
+    if lib is None or not getattr(lib, "_has_im2rec", False):
+        return False
+    if args.center_crop or args.encoding != ".jpg" or args.color != 1:
+        return False   # cv2-only options
+    # the native packer covers single-label JPEG lists; multi-label rows
+    # (label arrays) and non-JPEG sources keep the Python path, which
+    # transcodes/encodes them correctly
+    with open(args.prefix + ".lst") as f:
+        for line in f:
+            fields = line.rstrip("\n").split("\t")
+            if len(fields) < 3:
+                continue
+            if len(fields) > 3:
+                return False   # multi-label
+            if not args.pass_through and \
+                    not fields[-1].lower().endswith((".jpg", ".jpeg")):
+                return False   # non-JPEG needs cv2 transcoding
+    import ctypes
+    packed = ctypes.c_uint64(0)
+    skipped = ctypes.c_uint64(0)
+    tic = time.time()
+    rc = lib.MXTPUIm2Rec(
+        (args.prefix + ".lst").encode(), args.root.encode(),
+        (args.prefix + ".rec").encode(), (args.prefix + ".idx").encode(),
+        0 if args.pass_through else args.resize, args.quality,
+        max(1, args.num_thread), 1 if args.pass_through else 0,
+        ctypes.byref(packed), ctypes.byref(skipped))
+    if rc != 0:
+        raise RuntimeError("native im2rec failed rc=%d" % rc)
+    print("packed %d records into %s.rec (%d skipped) [native, %.1fs]"
+          % (packed.value, args.prefix, skipped.value, time.time() - tic))
+    return True
+
+
 def make_record(args):
     """Pack prefix.lst -> prefix.rec/.idx with a decode worker pool ordered
     through the host dependency engine."""
@@ -199,6 +241,10 @@ def parse_args():
                    help="skip transcoding, pack raw bytes")
     p.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
     p.add_argument("--num-thread", type=int, default=1)
+    p.add_argument("--native", type=lambda s: s.strip().lower() in
+                   ("1", "true", "yes", "on"), default=True,
+                   help="use the C++ packer when available (falls back "
+                        "to the Python pool otherwise)")
     return p.parse_args()
 
 
@@ -206,5 +252,5 @@ if __name__ == "__main__":
     args = parse_args()
     if args.list:
         make_list(args)
-    else:
+    elif not (args.native and make_record_native(args)):
         make_record(args)
