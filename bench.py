@@ -514,6 +514,18 @@ def _run_mode(mode):
         out["compute_roofline"] = _roofline(out["compute"],
                                             _train_flops("resnet-50"))
         out["device_kind"] = _device_peak()[0]
+    elif mode == "compute-large":
+        # MFU headroom row: the baseline config is batch 32 (the
+        # reference's table row); larger per-chip batches raise
+        # arithmetic intensity and show the utilization ceiling
+        big = _env_int("BENCH_LARGE_BATCH", 256)
+        tr = _make_trainer("resnet-50", big)
+        out["compute-large"] = round(
+            _compute_bench(tr, big, max(8, steps // 3), 4, 1,
+                           staged=_staged_batches(big, 2)), 2)
+        out["compute-large_roofline"] = _roofline(
+            out["compute-large"], _train_flops("resnet-50"))
+        out["compute_large_batch"] = big
     elif mode in ("inception-bn", "resnet-152"):
         tr = _make_trainer(mode, batch)
         out[mode] = round(
@@ -571,6 +583,7 @@ def main():
         parts.update(_collect("fed"))
     parts.update(_collect("compute"))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
+        parts.update(_collect("compute-large"))
         parts.update(_collect("inception-bn"))
         parts.update(_collect("resnet-152"))
         parts.update(_collect("lstm"))
@@ -633,11 +646,19 @@ def main():
     if "device_kind" in parts:
         result["device_kind"] = parts["device_kind"]
         result["device_peak_tflops"] = PEAK_TFLOPS.get(parts["device_kind"])
+    if "compute-large" in parts:
+        result["compute_large_img_s"] = parts["compute-large"]
+        result["compute_large_batch"] = parts.get("compute_large_batch")
     violations = []
-    for key in ("fed", "compute", "inception-bn", "resnet-152", "lstm"):
+    for key in ("fed", "compute", "compute-large", "inception-bn",
+                "resnet-152", "lstm"):
         roof = parts.get(key + "_roofline")
         if roof:
-            result[key.replace("-", "") + "_roofline"] = roof
+            # key style matches the sibling *_img_s keys: resnet-152 ->
+            # resnet152_img_s, compute-large -> compute_large_img_s
+            name = ("resnet152" if key == "resnet-152"
+                    else key.replace("-", "_"))
+            result[name + "_roofline"] = roof
             if roof.get("mfu", 0) > 1.0:
                 violations.append("%s: mfu=%.2f" % (key, roof["mfu"]))
     result["sync_method"] = (

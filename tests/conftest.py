@@ -51,11 +51,23 @@ def pytest_collection_modifyitems(config, items):
         reason="multi-process virtual-cluster suite (launcher forks "
                "CPU-collective workers); a single-chip session adds no "
                "coverage — run under MXTPU_TEST_PLATFORM=cpu")
+    # Example-training suites drive long host-side loops (per-step
+    # forwards through the tunneled device link at ~100 ms/op) — on the
+    # single-chip tier they add hours of latency without exercising any
+    # op the unit suites don't already run on chip; the CPU tier runs
+    # them in full (CPU_TESTS_r05.txt).
+    skip_hostloop = pytest.mark.skip(
+        reason="host-loop example training (tunnel-latency-bound); "
+               "covered by the MXTPU_TEST_PLATFORM=cpu tier")
+    hostloop = ("test_rl_examples", "test_example_tail",
+                "test_dec_example", "test_speech_demo_example")
     for item in items:
         if any(k in str(item.fspath) for k in needs_mesh):
             item.add_marker(skip)
         elif "test_dist" in str(item.fspath):
             item.add_marker(skip_procs)
+        elif any(k in str(item.fspath) for k in hostloop):
+            item.add_marker(skip_hostloop)
         # test_kvstore runs everywhere: multi-device aggregation semantics
         # are tested with value LISTS on one device, the reference's own
         # trick (tests/python/unittest/test_kvstore.py on CPU)
