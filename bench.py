@@ -557,9 +557,16 @@ def _collect(mode, timeout=480):
     import subprocess
     env = dict(os.environ)
     env["BENCH_MODE"] = mode
-    res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                         capture_output=True, text=True, timeout=timeout,
-                         env=env)
+    try:
+        res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             capture_output=True, text=True, timeout=timeout,
+                             env=env)
+    except subprocess.TimeoutExpired:
+        # a hung metric must not kill the whole run: record it and let the
+        # remaining metrics produce a partial artifact (rc stays 0)
+        sys.stderr.write("bench mode %s timed out after %ds\n"
+                         % (mode, timeout))
+        return {mode: {"status": "timeout", "timeout_s": timeout}}
     for line in res.stdout.splitlines():
         if line.startswith("BENCH_PART "):
             return json.loads(line[len("BENCH_PART "):])
@@ -587,6 +594,15 @@ def main():
         parts.update(_collect("inception-bn"))
         parts.update(_collect("resnet-152"))
         parts.update(_collect("lstm"))
+
+    # pull timed-out/failed models aside so the numeric consumers below
+    # see only real measurements; the statuses ship in the artifact
+    statuses = {k: v for k, v in parts.items()
+                if isinstance(v, dict) and v.get("status")}
+    for k in statuses:
+        parts.pop(k)
+    if statuses:
+        result["incomplete"] = statuses
 
     baseline = 109.0  # reference: ResNet-50 batch 32 on 1x K80
     fed = parts.get("fed")

@@ -12,6 +12,8 @@ Usage mirrors the reference's ``import mxnet as mx``::
 __version__ = "0.1.0"
 
 from .base import MXNetError
+from . import resilience
+from .resilience import CheckpointManager
 
 # Join the process group BEFORE anything can touch a JAX backend: under
 # tools/launch.py the MXTPU_* envs are set, and jax.distributed.initialize

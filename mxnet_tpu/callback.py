@@ -12,13 +12,19 @@ __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
 
 def do_checkpoint(prefix, period=1):
     """Epoch-end callback saving prefix-symbol.json + prefix-%04d.params
-    (reference callback.py:do_checkpoint)."""
+    (reference callback.py:do_checkpoint).  Writes are atomic; ``prefix``
+    may also be a :class:`~mxnet_tpu.resilience.CheckpointManager`, which
+    adds manifest discovery + keep_last retention."""
     from .model import save_checkpoint
     period = int(max(1, period))
+    managed = hasattr(prefix, "save") and hasattr(prefix, "latest")
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            if managed:
+                prefix.save(iter_no + 1, sym, arg, aux)
+            else:
+                save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
     return _callback
 
 

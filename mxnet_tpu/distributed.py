@@ -46,6 +46,39 @@ def is_initialized():
     return _INITIALIZED
 
 
+def _check_backend_untouched():
+    """Joining after the first JAX backend touch is unrecoverable user
+    error, never retryable — checked once, before the retry ladder."""
+    from jax._src import xla_bridge
+    if xla_bridge.backends_are_initialized():
+        raise MXNetError(
+            "distributed.initialize must run before the first JAX backend "
+            "touch (importing mxnet_tpu under tools/launch.py does it "
+            "automatically; if you initialize manually, do it before "
+            "creating any NDArray)")
+
+
+def _join(coordinator_address, num_processes, process_id, timeout):
+    """One attempt to join the coordination service (separated so the
+    retry ladder — and tests — can wrap exactly the flaky part)."""
+    import jax
+    kwargs = {}
+    if timeout is not None:
+        kwargs["initialization_timeout"] = float(timeout)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except Exception:
+        # leave no half-joined client behind so the next attempt starts
+        # from a clean slate
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — nothing was brought up
+            pass
+        raise
+
+
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
                platform=None):
     """Join (or create) the process group.
@@ -80,13 +113,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
             "distributed.initialize: %s is set but %s is not — launch with "
             "tools/launch.py or pass process_id" % (ENV_COORDINATOR, ENV_RANK))
     import jax
-    from jax._src import xla_bridge
-    if xla_bridge.backends_are_initialized():
-        raise MXNetError(
-            "distributed.initialize must run before the first JAX backend "
-            "touch (importing mxnet_tpu under tools/launch.py does it "
-            "automatically; if you initialize manually, do it before "
-            "creating any NDArray)")
+    _check_backend_untouched()
     if platform:
         # The TPU plugin platform wins over the JAX_PLATFORMS env var, so
         # the override must go through jax.config (see tests/conftest.py).
@@ -95,9 +122,23 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         # Cross-process XLA collectives on the CPU backend need an explicit
         # collectives implementation; TPU has ICI natively.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    # Preemption makes bring-up flaky by design: the coordinator (rank 0)
+    # may still be rescheduling while peers come up, so one attempt is a
+    # coin flip on pods.  Retry with backoff, bounded by MXTPU_INIT_RETRIES
+    # / MXTPU_INIT_TIMEOUT (per-attempt coordination-service timeout),
+    # logging every attempt — the elastic-bring-up discipline the ps-lite
+    # tracker got from its own van retries.
+    from .base import get_env
+    from .resilience import retry, ENV_INIT_RETRIES, ENV_INIT_TIMEOUT, \
+        ENV_INIT_BACKOFF
+    attempts = int(get_env(ENV_INIT_RETRIES, "3"))
+    timeout = get_env(ENV_INIT_TIMEOUT)
+    backoff = float(get_env(ENV_INIT_BACKOFF, "1.0"))
+    retry(lambda: _join(coordinator_address, num_processes, process_id,
+                        timeout),
+          attempts=attempts, backoff=backoff,
+          retry_on=(RuntimeError, ConnectionError, TimeoutError, MXNetError),
+          name="distributed.initialize[rank %d]" % process_id)
     _INITIALIZED = True
 
 

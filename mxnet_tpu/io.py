@@ -267,6 +267,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
+        self._errors = [None] * self.n_iter
 
         def prefetch_func(self, i):
             while True:
@@ -274,8 +275,14 @@ class PrefetchingIter(DataIter):
                 if not self.started:
                     break
                 try:
-                    self.next_batch[i] = self.iters[i].next()
+                    self.next_batch[i] = self._next_with_retry(i)
                 except StopIteration:
+                    self.next_batch[i] = None
+                except Exception as e:  # noqa: BLE001 — surfaced to consumer
+                    # retries exhausted (or a real bug): hand the error to
+                    # the consuming thread instead of dying silently and
+                    # hanging it on data_ready forever
+                    self._errors[i] = e
                     self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
@@ -290,6 +297,33 @@ class PrefetchingIter(DataIter):
         self.started = False
         for e in self.data_taken:
             e.set()
+
+    def _next_with_retry(self, i):
+        """Pull the next batch, retrying transient source errors (flaky
+        network storage, an injected ``iter_next`` fault) with backoff and
+        per-attempt logging; StopIteration and real bugs pass straight
+        through.  Tunables: MXTPU_DATA_RETRIES / MXTPU_DATA_RETRY_BACKOFF.
+
+        CONTRACT: a retried source must not have advanced its cursor on
+        the failed call (true of read-then-decode iterators, where the
+        fetch fails before the position moves).  A source that consumes
+        the record before failing would resume one record later — with
+        multiple wrapped iters set MXTPU_DATA_RETRIES=1 for such sources
+        and handle the surfaced error with reset()."""
+        from .base import get_env
+        from .resilience import (retry, faults, TransientError,
+                                 ENV_DATA_RETRIES, ENV_DATA_BACKOFF)
+
+        def _one():
+            faults.maybe_fail("iter_next")
+            return self.iters[i].next()
+
+        return retry(
+            _one,
+            attempts=int(get_env(ENV_DATA_RETRIES, "3")),
+            backoff=float(get_env(ENV_DATA_BACKOFF, "0.05")),
+            retry_on=(IOError, OSError, TransientError),
+            name="prefetch[%d].next" % i)
 
     @property
     def provide_data(self):
@@ -310,6 +344,7 @@ class PrefetchingIter(DataIter):
     def reset(self):
         for e in self.data_ready:
             e.wait()
+        self._errors = [None] * self.n_iter
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -320,6 +355,19 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        for i, err in enumerate(self._errors):
+            if err is not None:
+                self._errors[i] = None
+                # release ONLY the failed iterator's thread to refetch;
+                # healthy iterators keep their in-flight batches.  Pairing
+                # survives when the failed source did not advance past the
+                # batch (the transient-IO case); a source that consumed the
+                # record before failing cannot be realigned here — with
+                # multiple iters, reset() after an exhausted-retry error is
+                # the only guaranteed realignment
+                self.data_ready[i].clear()
+                self.data_taken[i].set()
+                raise err
         if self.next_batch[0] is None:
             return False
         self.current_batch = DataBatch(

@@ -108,17 +108,25 @@ class KVStore(object):
 
     barrier = _barrier
 
-    def save_optimizer_states(self, fname):
+    def get_optimizer_states(self):
         if self._updater is None:
             raise MXNetError("updater is not set")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states())
+        return self._updater.get_states()
+
+    def set_optimizer_states(self, states):
+        if self._updater is None:
+            raise MXNetError("updater is not set")
+        self._updater.set_states(states)
+
+    def save_optimizer_states(self, fname):
+        # temp + fsync + rename: a crash mid-save can never tear an
+        # existing optimizer-state file (same contract as checkpoints)
+        from .resilience import atomic_write
+        atomic_write(fname, self.get_optimizer_states())
 
     def load_optimizer_states(self, fname):
-        if self._updater is None:
-            raise MXNetError("updater is not set")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            self.set_optimizer_states(f.read())
 
     def _send_command_to_servers(self, head, body):
         pass

@@ -10,7 +10,8 @@ from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
            "MAE", "MSE", "RMSE", "CrossEntropy", "Loss", "Torch", "Caffe",
-           "CustomMetric", "CompositeEvalMetric", "np", "create"]
+           "CustomMetric", "CompositeEvalMetric", "SkippedSteps", "np",
+           "create"]
 
 metric_registry = Registry("metric")
 
@@ -321,6 +322,37 @@ class CustomMetric(EvalMetric):
             else:
                 self.sum_metric += reval
                 self.num_inst += 1
+
+
+class SkippedSteps(EvalMetric):
+    """Surfaces the fused step guard's skipped-update counter as a metric
+    row, so NaN-skips show up in the same epoch logs as accuracy/loss.
+
+    ``source`` is anything exposing the counter — a Module
+    (``skipped_update_count``) or an SPMDTrainer (``skipped_steps``).
+    The value is a monotone total, not a per-batch average; ``reset()``
+    keeps it (the counter belongs to the trainer, not the metric).
+    """
+
+    def __init__(self, source, name="skipped_steps"):
+        self._source = source
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        pass
+
+    def reset(self):
+        pass
+
+    def _count(self):
+        for attr in ("skipped_update_count", "skipped_steps"):
+            v = getattr(self._source, attr, None)
+            if v is not None:
+                return float(v)
+        return 0.0
+
+    def get(self):
+        return (self.name, self._count())
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

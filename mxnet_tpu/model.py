@@ -87,13 +87,16 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save prefix-symbol.json + prefix-%04d.params (reference
     model.py:save_checkpoint; format matches the reference byte-for-byte
-    via ndarray.save)."""
+    via ndarray.save).  Files land via temp + fsync + rename so a crash
+    mid-save can never tear an existing checkpoint."""
+    from .resilience import atomic_path, atomic_write
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        atomic_write("%s-symbol.json" % prefix, symbol.tojson())
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    with atomic_path(param_name) as tmp:
+        nd.save(tmp, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

@@ -135,9 +135,34 @@ class BaseModule(object):
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The canonical training loop (reference base_module.py:368-520)."""
+            monitor=None, checkpoint=None, resume=False):
+        """The canonical training loop (reference base_module.py:368-520).
+
+        ``checkpoint`` (a :class:`~mxnet_tpu.resilience.CheckpointManager`
+        or a directory path) turns on managed epoch-end checkpointing:
+        params + optimizer state land atomically after every epoch, with
+        retention handled by the manager.  ``resume=True`` restores the
+        newest checkpoint before training — params, optimizer state and
+        epoch — so a preempted run relaunched with the same arguments
+        continues where it stopped (the reference's manual
+        ``--load-epoch`` relaunch, made automatic)."""
         assert num_epoch is not None, "please specify number of epochs"
+
+        if checkpoint is not None and not hasattr(checkpoint, "restore"):
+            from ..resilience import CheckpointManager
+            checkpoint = CheckpointManager(checkpoint)
+        restored_states = None
+        if resume:
+            assert checkpoint is not None, "fit(resume=True) needs checkpoint="
+            if checkpoint.latest() is not None:
+                _, arg_restored, aux_restored, restored_states, ck_epoch = \
+                    checkpoint.restore()
+                arg_params, aux_params = arg_restored, aux_restored
+                begin_epoch = max(begin_epoch, ck_epoch)
+                force_init = True
+                self.logger.info("fit(resume=True): restored checkpoint "
+                                 "epoch %d from %s", ck_epoch,
+                                 checkpoint.directory)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -149,6 +174,16 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if restored_states is not None:
+            try:
+                self.set_optimizer_states(restored_states)
+            except NotImplementedError:
+                # module can't carry optimizer state (mirrors the save
+                # side): resume params + epoch only
+                self.logger.warning(
+                    "fit(resume=True): %s has no optimizer-state support; "
+                    "resuming params and epoch only",
+                    type(self).__name__)
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -190,6 +225,16 @@ class BaseModule(object):
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
 
+            if checkpoint is not None:
+                # gather happens above on EVERY rank (collective under
+                # sharded params); the manager then writes on rank 0 only
+                try:
+                    states = self.get_optimizer_states()
+                except NotImplementedError:
+                    states = None
+                checkpoint.save(epoch + 1, self.symbol, arg_params_,
+                                aux_params_, optimizer_states=states)
+
             # ----------------------------------------
             # evaluation on validation set
             if eval_data:
@@ -227,7 +272,9 @@ class BaseModule(object):
         save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
         save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
         from .. import ndarray as nd
-        nd.save(fname, save_dict)
+        from ..resilience import atomic_path
+        with atomic_path(fname) as tmp:
+            nd.save(tmp, save_dict)
 
     def load_params(self, fname):
         from .. import ndarray as nd
@@ -243,6 +290,15 @@ class BaseModule(object):
             else:
                 raise ValueError("Invalid param file " + fname)
         self.set_params(arg_params, aux_params)
+
+    def get_optimizer_states(self):
+        """Serialized optimizer state (bytes), for managed checkpointing.
+        Subclasses with an optimizer implement this; the base raises so
+        ``fit(checkpoint=...)`` degrades to params-only checkpoints."""
+        raise NotImplementedError
+
+    def set_optimizer_states(self, states):
+        raise NotImplementedError
 
     # -- abstract interface ------------------------------------------------
     def forward(self, data_batch, is_train=None):

@@ -81,6 +81,7 @@ class Module(BaseModule):
         self._fused = None
         self._fused_batch = None
         self._fused_outputs = None
+        self._fused_outputs_from_update = False
         self._monitor_installed = False
 
     @staticmethod
@@ -96,8 +97,11 @@ class Module(BaseModule):
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """Save symbol+params(+optimizer states) (reference module.py:135)."""
-        self._symbol.save("%s-symbol.json" % prefix)
+        """Save symbol+params(+optimizer states) (reference module.py:135).
+        Every file lands atomically (temp + fsync + rename) so a crash
+        mid-save leaves any prior checkpoint intact."""
+        from ..resilience import atomic_write
+        atomic_write("%s-symbol.json" % prefix, self._symbol.tojson())
         param_name = "%s-%04d.params" % (prefix, epoch)
         self.save_params(param_name)
         logging.info("Saved checkpoint to \"%s\"", param_name)
@@ -107,6 +111,18 @@ class Module(BaseModule):
             logging.info("Saved optimizer state to \"%s\"", state_name)
 
     # -- properties --------------------------------------------------------
+    @property
+    def skipped_update_count(self):
+        """Updates skipped by the fused step's NaN/Inf guard (0 on the
+        executor path, which has no in-graph guard)."""
+        return self._fused.skipped_steps if self._fused is not None else 0
+
+    @property
+    def consecutive_bad_steps(self):
+        """Current run of guard-skipped updates (0 on the executor path)."""
+        return self._fused.consecutive_bad_steps \
+            if self._fused is not None else 0
+
     @property
     def data_names(self):
         return self._data_names
@@ -284,6 +300,7 @@ class Module(BaseModule):
         self._fused = None
         self._fused_batch = None
         self._fused_outputs = None
+        self._fused_outputs_from_update = False
         self._monitor_installed = False
 
     # -- optimizer ---------------------------------------------------------
@@ -513,9 +530,11 @@ class Module(BaseModule):
                 # deferred step will apply (advisor r2 finding)
                 self._fused_key = None
                 self._fused_outputs = None
+                self._fused_outputs_from_update = False
             else:
                 outs = self._fused.eval_step(*self._fused_feed(data_batch))
                 self._fused_outputs = [NDArray._from_jax(o) for o in outs]
+                self._fused_outputs_from_update = False
             return
         self._exec_group.forward(data_batch, is_train)
 
@@ -539,6 +558,7 @@ class Module(BaseModule):
             outs = self._fused.step(*self._fused_batch,
                                     key=self._draw_fused_key())
             self._fused_outputs = [NDArray._from_jax(o) for o in outs]
+            self._fused_outputs_from_update = True
             self._fused_batch = None
             self._fused_key = None
             return
@@ -582,6 +602,14 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         if self._fused is not None:
+            if self._fused_outputs_from_update and self._fused.step_guard:
+                # a guard-skipped step's outputs are non-finite by
+                # definition; one NaN into a summing metric would poison
+                # the whole epoch's Train-* rows (the flush costs nothing
+                # extra: reading the outputs below syncs the same program)
+                self._fused.flush_step_guard()
+                if self._fused.last_step_skipped:
+                    return
             eval_metric.update(list(labels or []), self.get_outputs())
             return
         self._exec_group.update_metric(eval_metric, labels)
@@ -593,27 +621,35 @@ class Module(BaseModule):
             self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
-    def save_optimizer_states(self, fname):
+    def get_optimizer_states(self):
+        """Serialized optimizer state as bytes, from whichever side owns
+        it (fused trainer / kvstore updater / local updater).  Under
+        sharded fused params the gather is COLLECTIVE — call on all
+        ranks."""
         assert self.optimizer_initialized
         if self._fused is not None:
-            with open(fname, "wb") as fout:
-                fout.write(self._fused.get_states())
+            return self._fused.get_states()
+        if self._update_on_kvstore:
+            return self._kvstore.get_optimizer_states()
+        return self._updater.get_states()
+
+    def set_optimizer_states(self, states):
+        assert self.optimizer_initialized
+        if self._fused is not None:
+            self._fused.set_states(states)
         elif self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
+            self._kvstore.set_optimizer_states(states)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            self._updater.set_states(states)
+
+    def save_optimizer_states(self, fname):
+        from ..resilience import atomic_write
+        atomic_write(fname, self.get_optimizer_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._fused is not None:
-            with open(fname, "rb") as f:
-                self._fused.set_states(f.read())
-        elif self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-        else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+        with open(fname, "rb") as f:
+            self.set_optimizer_states(f.read())
 
     def install_monitor(self, mon):
         assert self.binded

@@ -49,6 +49,7 @@ class Monitor(object):
         self.re_prog = re.compile(pattern)
         self.sort = sort
         self.monitor_all = monitor_all
+        self._guard_sources = []
 
         def stat_helper(name, array):
             if not self.activated or not self.re_prog.match(name):
@@ -60,6 +61,16 @@ class Monitor(object):
         """Attach to an executor (reference monitor.py:install)."""
         exe.set_monitor_callback(self.stat_helper, self.monitor_all)
         self.exes.append(exe)
+
+    def install_step_guard(self, source):
+        """Also report the NaN/Inf step guard's counters each ``toc()``.
+
+        ``source`` is a Module (``skipped_update_count``) or SPMDTrainer
+        (``skipped_steps``/``consecutive_bad_steps``); rows appear as
+        ``step_guard_skipped`` / ``step_guard_consecutive_bad`` next to
+        the per-node stats, so a skipping run is visible in the same
+        place its activations are being debugged."""
+        self._guard_sources.append(source)
 
     def tic(self):
         """Start collecting for this batch if it is a sampled one
@@ -77,6 +88,14 @@ class Monitor(object):
         (reference monitor.py:toc)."""
         if not self.activated:
             return []
+        for src in self._guard_sources:
+            skipped = getattr(src, "skipped_update_count",
+                              getattr(src, "skipped_steps", 0))
+            self.queue.append((self.step, "step_guard_skipped",
+                               float(skipped)))
+            self.queue.append((self.step, "step_guard_consecutive_bad",
+                               float(getattr(src, "consecutive_bad_steps",
+                                             0) or 0)))
         for exe in self.exes:
             for array in exe.arg_arrays:
                 array.wait_to_read()

@@ -111,10 +111,35 @@ class SPMDModule(BaseModule):
         return self._trainer.outputs
 
     def update_metric(self, eval_metric, labels):
+        if getattr(self, "_eval_outputs", None) is None and \
+                self._trainer.step_guard:
+            # train-step outputs: a guard-skipped step's outputs are
+            # non-finite — keep them out of summing metrics
+            self._trainer.flush_step_guard()
+            if self._trainer.last_step_skipped:
+                return
         eval_metric.update(labels, self.get_outputs())
 
     def get_params(self):
         return self._trainer.get_params()
+
+    def get_optimizer_states(self):
+        """Serialized optimizer state for fit(checkpoint=...) — COLLECTIVE
+        under sharded params (all ranks must call together)."""
+        return self._trainer.get_states()
+
+    def set_optimizer_states(self, states):
+        self._trainer.set_states(states)
+
+    @property
+    def skipped_update_count(self):
+        """Updates skipped by the fused step's NaN/Inf guard."""
+        return self._trainer.skipped_steps
+
+    @property
+    def consecutive_bad_steps(self):
+        """Current run of guard-skipped updates."""
+        return self._trainer.consecutive_bad_steps
 
     def install_monitor(self, mon):
         raise MXNetError("SPMDModule does not support Monitor taps (use "

@@ -66,7 +66,8 @@ class SPMDTrainer(object):
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
                  compute_dtype=None, remat=None, input_transforms=None,
-                 grad_sync=None):
+                 grad_sync=None, step_guard=None,
+                 max_consecutive_bad_steps=None):
         import jax
         from ..base import get_env
         self.symbol = symbol
@@ -136,6 +137,29 @@ class SPMDTrainer(object):
             mirror_segments=mirror_segments_for(symbol, force=self.remat))
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
+
+        # NaN/Inf step guard: an in-graph all-finite check over the raw
+        # gradients; a non-finite step applies NO update (params, aux and
+        # optimizer state pass through unchanged inside the same fused
+        # program) and is counted host-side.  After
+        # ``max_consecutive_bad_steps`` bad steps in a row the run aborts
+        # with MXNetError — persistent NaNs mean a diverged model, and
+        # silently skipping forever would burn a pod doing nothing.
+        # The flag is read ONE STEP LATE (at the next step()'s entry, or at
+        # flush_step_guard/get_params/counter reads), so the guard costs a
+        # one-deep pipeline instead of a full host sync per step.
+        from ..resilience import ENV_STEP_GUARD, ENV_MAX_BAD_STEPS
+        if step_guard is None:
+            step_guard = str(get_env(ENV_STEP_GUARD, "1")) != "0"
+        self.step_guard = bool(step_guard)
+        if max_consecutive_bad_steps is None:
+            max_consecutive_bad_steps = int(
+                get_env(ENV_MAX_BAD_STEPS, "10"))
+        self.max_consecutive_bad_steps = int(max_consecutive_bad_steps)
+        self._skipped_steps = 0           # total guarded skips, ever
+        self._consecutive_bad_steps = 0   # current bad-step run length
+        self._pending_flag = None         # last step's unread finite flag
+        self.last_step_skipped = False    # most recently FLUSHED step
 
         self._rep_fn = None       # cached jitted reshard-to-replicated
         self.params = None        # dict name -> jax array (sharded)
@@ -333,6 +357,7 @@ class SPMDTrainer(object):
         param_names = tuple(self.param_names)
         compute_dtype = self.compute_dtype
         transforms = dict(self.input_transforms)
+        guard = self.step_guard
 
         def xform(data):
             if not transforms:
@@ -380,6 +405,15 @@ class SPMDTrainer(object):
             outs, vjp_fn, auxu = jax.vjp(loss_fn, full, has_aux=True)
             heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads, = vjp_fn(heads)
+            if guard:
+                # all-finite over every RAW gradient, folded into the same
+                # XLA program (one fused reduction tree, replicated scalar
+                # under GSPMD) — the in-graph analog of DynamicLossScale /
+                # Orbax-era skip-step guards
+                finite = jnp.asarray(True)
+                for name in param_names:
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(grads[name])))
             new_params, new_state = {}, {}
             for name in param_names:
                 g = grads[name]
@@ -394,10 +428,22 @@ class SPMDTrainer(object):
                 g = g.astype(params[name].dtype)
                 w, s = self._apply_update(name, params[name], g,
                                           opt_state[name], lr, wd, t)
+                if guard:
+                    # non-finite step: params AND optimizer state pass
+                    # through unchanged (selects fuse into the update)
+                    w = jnp.where(finite, w, params[name])
+                    s = tuple(jnp.where(finite, sn, so)
+                              for sn, so in zip(s, opt_state[name]))
                 new_params[name] = w
                 new_state[name] = s
             new_aux = dict(aux)
             new_aux.update(auxu)
+            if guard:
+                # BN moving stats computed from a poisoned batch must not
+                # stick either
+                for name, v in auxu.items():
+                    new_aux[name] = jnp.where(finite, v, aux[name])
+                return new_params, new_aux, new_state, list(outs), finite
             return new_params, new_aux, new_state, list(outs)
 
         def eval_step(params, aux, data, rng, is_train=False):
@@ -480,20 +526,95 @@ class SPMDTrainer(object):
         (module.get_outputs between forward and update) hand in the exact
         key so stochastic layers draw the same masks in both passes."""
         from .. import random as _random
+        from ..resilience import faults
+        if faults.is_armed("poison_grad"):
+            batch_arrays = self._poison_batch(batch_arrays)
+        # consume the PREVIOUS step's finite flag before dispatching this
+        # one: a one-deep pipeline (the device runs step N while the host
+        # preps N+1) instead of a per-step host sync
+        self.flush_step_guard()
         data = self._shard_batch(batch_arrays)
         self._num_update += 1
         lr = self.optimizer.lr if self.optimizer.lr_scheduler is None else \
             self.optimizer.lr_scheduler(self._num_update)
         if key is None:
             key = _random.next_key()
-        self.params, self.aux, self.opt_state, outs = self._step_fn(
+        res = self._step_fn(
             self.params, self.aux, self.opt_state, data, key,
             jnp.asarray(lr, jnp.float32), jnp.asarray(self.optimizer.wd,
                                                       jnp.float32),
             self._num_update)
+        if self.step_guard:
+            self.params, self.aux, self.opt_state, outs, flag = res
+            self._pending_flag = flag
+        else:
+            self.params, self.aux, self.opt_state, outs = res
         outs = self._localize(outs)
         self._outputs = outs
         return outs
+
+    def _poison_batch(self, batch_arrays):
+        """Fault-injection hook: NaN out the first floating input so the
+        step's gradients go non-finite deterministically (tier-1 coverage
+        for the guard without waiting for a real divergence)."""
+        from ..resilience import faults
+        out = list(batch_arrays)
+        for i, v in enumerate(out):
+            host = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            if np.issubdtype(host.dtype, np.floating):
+                if faults.consume("poison_grad"):
+                    out[i] = np.full_like(host, np.nan)
+                break
+        return tuple(out)
+
+    @property
+    def skipped_steps(self):
+        """Total guard-skipped updates (flushes the in-flight flag)."""
+        self.flush_step_guard()
+        return self._skipped_steps
+
+    @property
+    def consecutive_bad_steps(self):
+        """Current run of guard-skipped updates (flushes the in-flight
+        flag)."""
+        self.flush_step_guard()
+        return self._consecutive_bad_steps
+
+    def flush_step_guard(self):
+        """Account any not-yet-read finite flag (blocks until that step's
+        program finished).  Called automatically at the next step(), at
+        get_params/get_states, and by the counter properties; raises the
+        consecutive-bad-steps abort if the flushed flag crosses the
+        limit."""
+        flag, self._pending_flag = self._pending_flag, None
+        if flag is None:
+            return
+        if self._multiproc:
+            good = bool(np.asarray(flag.addressable_shards[0].data))
+        else:
+            good = bool(flag)
+        self.last_step_skipped = not good
+        if good:
+            self._consecutive_bad_steps = 0
+            return
+        # the program applied no update — roll the update counter back so
+        # lr schedules and adam bias correction see only applied steps
+        # (one step late under the pipelined read; self-corrects here)
+        self._num_update -= 1
+        self._skipped_steps += 1
+        self._consecutive_bad_steps += 1
+        import logging
+        logging.getLogger(__name__).warning(
+            "step guard: non-finite gradients — update skipped "
+            "(%d consecutive, %d total)", self._consecutive_bad_steps,
+            self._skipped_steps)
+        if self.max_consecutive_bad_steps > 0 and \
+                self._consecutive_bad_steps >= self.max_consecutive_bad_steps:
+            raise MXNetError(
+                "step guard: %d consecutive steps produced non-finite "
+                "gradients — model has diverged (raise MXTPU_MAX_BAD_STEPS "
+                "or set MXTPU_STEP_GUARD=0 to disable the guard)"
+                % self._consecutive_bad_steps)
 
     def eval_step(self, *batch_arrays):
         from .. import random as _random
@@ -543,6 +664,7 @@ class SPMDTrainer(object):
 
     def get_params(self):
         """Gather params/aux to host NDArrays (for checkpointing)."""
+        self.flush_step_guard()
         arg_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
                       for k, v in self.params.items()}
         aux_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
@@ -553,6 +675,10 @@ class SPMDTrainer(object):
         """Replace parameter values, keeping optimizer state (the
         Module.set_params contract).  Names missing from the given dicts
         keep their current values."""
+        # account any in-flight guarded step against the OLD counters
+        # before its parameters are replaced
+        self.flush_step_guard()
+
         def _host(v):
             return v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
 
@@ -573,6 +699,7 @@ class SPMDTrainer(object):
     def get_states(self):
         """Serialized optimizer state (the Updater.get_states analog —
         reference kvstore.save_optimizer_states / Updater serialization)."""
+        self.flush_step_guard()
         import pickle
         host = {k: tuple(np.asarray(self._gather(x)) for x in s)
                 for k, s in self.opt_state.items()}
@@ -580,6 +707,12 @@ class SPMDTrainer(object):
                              "states": host})
 
     def set_states(self, blob):
+        # restored state opens a fresh guard window: drop any pre-restore
+        # flag (its skip accounting belongs to the discarded run) and the
+        # consecutive-bad count, so a recovery attempt after an abort gets
+        # the full MXTPU_MAX_BAD_STEPS budget again
+        self._pending_flag = None
+        self._consecutive_bad_steps = 0
         import pickle
         payload = pickle.loads(blob)
         if isinstance(payload, dict) and "states" in payload \
@@ -609,6 +742,26 @@ class SPMDTrainer(object):
             placed[name] = tuple(self._place(x, spec) for x in s)
         self.opt_state = placed
 
+    def save_checkpoint(self, manager, step):
+        """Checkpoint params + optimizer state through a
+        :class:`~mxnet_tpu.resilience.CheckpointManager`.  The gathers run
+        on EVERY rank (collective under sharded params — see _gather's
+        note); the manager then writes atomically on rank 0 only."""
+        arg_params, aux_params = self.get_params()
+        states = self.get_states()
+        return manager.save(step, self.symbol, arg_params, aux_params,
+                            optimizer_states=states)
+
+    def restore(self, manager, epoch=None):
+        """Resume params + optimizer state (+ step counter, inside the
+        states blob) from the manager's newest — or given — checkpoint;
+        returns the restored epoch."""
+        _, arg_params, aux_params, states, epoch = manager.restore(epoch)
+        self.set_params(arg_params, aux_params)
+        if states is not None:
+            self.set_states(states)
+        return epoch
+
     # -- lifecycle --------------------------------------------------------
     def close(self):
         """Deterministically release this trainer's device memory and
@@ -627,7 +780,8 @@ class SPMDTrainer(object):
                     except Exception:  # noqa: BLE001 — already deleted
                         pass
 
-        for attr in ("params", "aux", "opt_state", "_outputs"):
+        for attr in ("params", "aux", "opt_state", "_outputs",
+                     "_pending_flag"):
             _delete_tree(getattr(self, attr, None))
             setattr(self, attr, None)
         # drop the jitted callables (each owns its executable + caches)

@@ -1,0 +1,386 @@
+"""Fault-tolerant training runtime.
+
+The reference stack assumed long-lived ps-lite servers: a worker crash was
+an operator page, ``save_checkpoint`` wrote files in place, and a NaN
+gradient silently corrupted the weights on every server shard.  A
+TPU-native design must instead assume preemption is ROUTINE (pods are
+preempted, ICI collectives are all-or-nothing — see
+``kvstore.get_num_dead_node``) and make every run resumable and every step
+guarded.  This module owns the pieces:
+
+- :func:`atomic_write` / :func:`atomic_path` — write-temp + fsync +
+  ``os.replace`` so a crash mid-write can never tear an existing file.
+- :class:`CheckpointManager` — a checkpoint directory with a JSON
+  manifest, ``keep_last`` retention, ``latest()``/``restore()`` discovery
+  and rank-0-guarded multi-process writes (the Orbax-style discipline).
+- :func:`retry` — bounded retry with backoff and structured logging,
+  applied to ``distributed.initialize`` and the prefetcher's ``next()``.
+- :data:`faults` — deterministic fault-injection points (env- or
+  test-driven) so all of the above is exercised in tier-1 CPU tests
+  without real crashes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from contextlib import contextmanager
+
+from .base import MXNetError
+
+__all__ = ["atomic_write", "atomic_path", "retry", "CheckpointManager",
+           "TransientError", "FaultInjector", "faults",
+           "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
+           "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
+           "ENV_STEP_GUARD", "ENV_FAULTS"]
+
+_LOG = logging.getLogger(__name__)
+
+ENV_INIT_RETRIES = "MXTPU_INIT_RETRIES"
+ENV_INIT_TIMEOUT = "MXTPU_INIT_TIMEOUT"
+ENV_INIT_BACKOFF = "MXTPU_INIT_BACKOFF"
+ENV_DATA_RETRIES = "MXTPU_DATA_RETRIES"
+ENV_DATA_BACKOFF = "MXTPU_DATA_RETRY_BACKOFF"
+ENV_MAX_BAD_STEPS = "MXTPU_MAX_BAD_STEPS"
+ENV_STEP_GUARD = "MXTPU_STEP_GUARD"
+ENV_FAULTS = "MXTPU_FAULTS"
+
+
+class TransientError(MXNetError):
+    """An error the caller declared retryable (injected faults, flaky
+    storage, a coordinator that is still coming up)."""
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class FaultInjector(object):
+    """Named failure points, armed programmatically or via the
+    ``MXTPU_FAULTS`` env (``"point:times,point2:times"``).
+
+    Production code plants ``faults.maybe_fail("checkpoint_write")`` (raise)
+    or ``if faults.consume("poison_grad")`` (branch) at the spots a real
+    fault would strike; tests arm a point for N firings and get the exact
+    failure, deterministically, on the tier-1 CPU suite.  Unarmed points
+    cost one dict lookup.
+    """
+
+    def __init__(self):
+        self._armed = {}
+        env = os.environ.get(ENV_FAULTS, "")
+        for part in filter(None, (p.strip() for p in env.split(","))):
+            point, _, times = part.partition(":")
+            self._armed[point] = int(times or 1)
+
+    def arm(self, point, times=1, exc=None):
+        """Make ``point`` fire for the next ``times`` hits (``exc``: the
+        exception type ``maybe_fail`` raises; default TransientError)."""
+        self._armed[point] = int(times)
+        if exc is not None:
+            self._armed[point + "/exc"] = exc
+        else:
+            # re-arming resets to the default exception; never inherit a
+            # previous arm()'s custom type
+            self._armed.pop(point + "/exc", None)
+        return self
+
+    def disarm(self, point=None):
+        """Disarm one point, or everything when called with no argument."""
+        if point is None:
+            self._armed.clear()
+        else:
+            self._armed.pop(point, None)
+            self._armed.pop(point + "/exc", None)
+
+    def is_armed(self, point):
+        return self._armed.get(point, 0) > 0
+
+    def consume(self, point):
+        """True (and decrement) if ``point`` is armed — for fault sites
+        that branch rather than raise."""
+        left = self._armed.get(point, 0)
+        if left <= 0:
+            return False
+        self._armed[point] = left - 1
+        return True
+
+    def maybe_fail(self, point, message=None):
+        """Raise the armed exception at ``point`` (no-op when unarmed)."""
+        if self.consume(point):
+            exc = self._armed.get(point + "/exc", TransientError)
+            raise exc(message or "injected fault at %r" % point)
+
+
+faults = FaultInjector()
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+
+def _fsync_path(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    """Flush a rename's directory entry (without this, a power loss after
+    ``os.replace`` can roll the publish back even though the data blocks
+    are on disk)."""
+    try:
+        fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    except OSError:
+        return  # platform/fs without directory fds: best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextmanager
+def atomic_path(path, fault_point="checkpoint_write"):
+    """Yield a temp path in ``path``'s directory; on clean exit fsync it
+    and ``os.replace`` onto ``path``.  A crash (or injected fault) at any
+    point leaves the existing ``path`` byte-for-byte intact — the file is
+    either the complete old version or the complete new one, never torn.
+    """
+    path = os.fspath(path)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        yield tmp
+        _fsync_path(tmp)
+        faults.maybe_fail(fault_point,
+                          "injected crash before publishing %r" % path)
+        os.replace(tmp, path)
+        _fsync_dir(path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def atomic_write(path, data, fault_point="checkpoint_write"):
+    """Atomically replace ``path`` with ``data`` (bytes or str)."""
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with atomic_path(path, fault_point=fault_point) as tmp:
+        with open(tmp, mode) as f:
+            f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# retry
+# ---------------------------------------------------------------------------
+
+def retry(fn, attempts=3, backoff=0.1, max_backoff=30.0, timeout=None,
+          retry_on=(TransientError,), name=None, logger=None,
+          sleep=time.sleep, clock=time.monotonic):
+    """Call ``fn()`` up to ``attempts`` times with exponential backoff.
+
+    Only exceptions in ``retry_on`` are retried; anything else propagates
+    immediately (StopIteration, programming errors).  ``timeout`` bounds
+    the TOTAL wall time across attempts.  Each failed attempt is logged
+    with attempt number, delay and error so preemption recoveries are
+    visible in run logs.  ``sleep``/``clock`` are injectable so tests run
+    the full retry ladder against a fake clock with zero real sleeping.
+    """
+    name = name or getattr(fn, "__name__", "call")
+    logger = logger or _LOG
+    attempts = max(1, int(attempts))
+    deadline = None if timeout is None else clock() + float(timeout)
+    delay = float(backoff)
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — the ladder IS the point
+            last = e
+            if attempt >= attempts:
+                break
+            if deadline is not None and clock() >= deadline:
+                logger.warning("retry[%s]: attempt %d/%d failed (%s); "
+                               "timeout %.1fs exhausted", name, attempt,
+                               attempts, e, timeout)
+                break
+            wait = delay
+            if deadline is not None:
+                wait = min(wait, max(0.0, deadline - clock()))
+            logger.warning("retry[%s]: attempt %d/%d failed (%s: %s); "
+                           "retrying in %.2fs", name, attempt, attempts,
+                           type(e).__name__, e, wait)
+            sleep(wait)
+            delay = min(delay * 2.0, float(max_backoff))
+    raise MXNetError("retry[%s]: all %d attempts failed (last: %s: %s)"
+                     % (name, attempts, type(last).__name__, last)) from last
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+# ---------------------------------------------------------------------------
+
+def _rank():
+    """This process's rank without forcing a backend init: 0 unless the
+    process group was actually joined."""
+    from . import distributed
+    if not distributed.is_initialized():
+        return 0
+    return distributed.rank()
+
+
+class CheckpointManager(object):
+    """Atomic, discoverable, retention-managed checkpoints in a directory.
+
+    Layout (``prefix`` defaults to "checkpoint")::
+
+        dir/prefix-symbol.json      the network (written once per save)
+        dir/prefix-0007.params      epoch 7 parameters (reference format)
+        dir/prefix-0007.states      epoch 7 optimizer state (optional)
+        dir/manifest.json           {"checkpoints": [...], "prefix": ...}
+
+    Every file lands via temp + fsync + ``os.replace``; the manifest is
+    updated LAST, so a checkpoint only becomes visible to ``latest()``
+    once all of its files are complete.  A crash mid-save leaves the
+    previous checkpoint untouched and discoverable.
+
+    Multi-process: only rank 0 writes (callers must gather params on ALL
+    ranks first when they are sharded — see SPMDTrainer.get_params's
+    collective note); other ranks no-op and return the same epoch.
+    """
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, directory, prefix="checkpoint", keep_last=5):
+        self.directory = os.fspath(directory)
+        self.prefix = prefix
+        self.keep_last = None if keep_last is None else max(1, int(keep_last))
+        if _rank() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+    def _path(self, name):
+        return os.path.join(self.directory, name)
+
+    def symbol_path(self):
+        return self._path("%s-symbol.json" % self.prefix)
+
+    def params_path(self, epoch):
+        return self._path("%s-%04d.params" % (self.prefix, epoch))
+
+    def states_path(self, epoch):
+        return self._path("%s-%04d.states" % (self.prefix, epoch))
+
+    # -- manifest ---------------------------------------------------------
+    def _read_manifest(self):
+        try:
+            with open(self._path(self.MANIFEST)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"prefix": self.prefix, "checkpoints": []}
+
+    def _write_manifest(self, manifest):
+        atomic_write(self._path(self.MANIFEST),
+                     json.dumps(manifest, indent=2, sort_keys=True),
+                     fault_point="manifest_write")
+
+    def checkpoints(self):
+        """Epochs recorded in the manifest whose params file exists,
+        ascending."""
+        out = []
+        for entry in self._read_manifest().get("checkpoints", []):
+            epoch = int(entry["epoch"])
+            if os.path.exists(self.params_path(epoch)):
+                out.append(epoch)
+        return sorted(out)
+
+    def latest(self):
+        """The newest complete checkpoint's epoch, or None."""
+        epochs = self.checkpoints()
+        return epochs[-1] if epochs else None
+
+    # -- save/restore -----------------------------------------------------
+    def save(self, epoch, symbol=None, arg_params=None, aux_params=None,
+             optimizer_states=None):
+        """Write one checkpoint atomically; returns the epoch.
+
+        ``optimizer_states`` is the serialized blob (bytes) from
+        ``Module.get_optimizer_states()`` / ``Updater.get_states()``.
+        On ranks != 0 this is a no-op (gather before calling — see class
+        docstring).
+        """
+        epoch = int(epoch)
+        if _rank() != 0:
+            return epoch
+        # one serialization contract: the classic prefix-based writer (made
+        # atomic in this same subsystem) produces exactly this manager's
+        # params/symbol layout, so files stay loadable by load_checkpoint
+        from .model import save_checkpoint as _save_checkpoint
+        _save_checkpoint(os.path.join(self.directory, self.prefix), epoch,
+                         symbol, arg_params or {}, aux_params or {})
+        has_states = optimizer_states is not None
+        if has_states:
+            atomic_write(self.states_path(epoch), optimizer_states)
+        manifest = self._read_manifest()
+        entries = [e for e in manifest.get("checkpoints", [])
+                   if int(e["epoch"]) != epoch]
+        entries.append({"epoch": epoch,
+                        "params": os.path.basename(self.params_path(epoch)),
+                        "states": (os.path.basename(self.states_path(epoch))
+                                   if has_states else None),
+                        "time": time.time()})
+        entries.sort(key=lambda e: int(e["epoch"]))
+        if self.keep_last is not None and len(entries) > self.keep_last:
+            for stale in entries[:-self.keep_last]:
+                for path in (self.params_path(int(stale["epoch"])),
+                             self.states_path(int(stale["epoch"]))):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+            entries = entries[-self.keep_last:]
+        manifest["prefix"] = self.prefix
+        manifest["checkpoints"] = entries
+        self._write_manifest(manifest)
+        _LOG.info("CheckpointManager: saved epoch %d to %s", epoch,
+                  self.params_path(epoch))
+        return epoch
+
+    def restore(self, epoch=None):
+        """Load (symbol, arg_params, aux_params, optimizer_states, epoch)
+        for ``epoch`` (default: latest).  ``symbol`` is None when no
+        symbol file was saved; ``optimizer_states`` is the bytes blob or
+        None.  Raises MXNetError when nothing restorable exists."""
+        from . import ndarray as nd
+        from . import symbol as sym_mod
+        if epoch is None:
+            epoch = self.latest()
+        if epoch is None:
+            raise MXNetError("CheckpointManager: no checkpoint in %r"
+                             % self.directory)
+        epoch = int(epoch)
+        params_file = self.params_path(epoch)
+        if not os.path.exists(params_file):
+            raise MXNetError("CheckpointManager: epoch %d has no params "
+                             "file %r" % (epoch, params_file))
+        symbol = None
+        if os.path.exists(self.symbol_path()):
+            symbol = sym_mod.load(self.symbol_path())
+        arg_params, aux_params = {}, {}
+        for k, v in nd.load(params_file).items():
+            tp, name = k.split(":", 1)
+            if tp == "arg":
+                arg_params[name] = v
+            elif tp == "aux":
+                aux_params[name] = v
+        states = None
+        if os.path.exists(self.states_path(epoch)):
+            with open(self.states_path(epoch), "rb") as f:
+                states = f.read()
+        return symbol, arg_params, aux_params, states, epoch

@@ -37,6 +37,24 @@ else:
     jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini in-repo) so `-m 'not slow'` and the
+    # resilience suite produce no unknown-marker warnings
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "resilience: fault-injection / recovery test")
+
+
+@pytest.fixture
+def clean_faults():
+    """Disarm every injected fault point after the test, even on failure."""
+    from mxnet_tpu.resilience import faults
+    faults.disarm()
+    yield faults
+    faults.disarm()
+
+
 def pytest_collection_modifyitems(config, items):
     if _PLATFORM == "cpu":
         return

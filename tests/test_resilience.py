@@ -1,0 +1,519 @@
+"""Fault-tolerant training runtime: atomic CheckpointManager + auto-resume,
+the fused step's NaN/Inf guard, retry/backoff bring-up, and the
+deterministic fault-injection points that exercise all of it on CPU."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import (CheckpointManager, FaultInjector,
+                                  TransientError, atomic_write, retry)
+
+pytestmark = pytest.mark.resilience
+
+
+def make_blobs(n, d, c, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+
+def mlp_sym(num_classes=3, nh=16):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# retry helper (fake clock — zero real sleeping)
+# ---------------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise TransientError("not yet")
+        return 42
+
+    assert retry(flaky, attempts=5, backoff=0.5,
+                 sleep=sleeps.append, clock=lambda: 0.0) == 42
+    assert sleeps == [0.5, 1.0]  # exponential backoff, no real sleep
+
+
+def test_retry_exhaustion_raises_mxnet_error():
+    def always(): raise TransientError("down")
+    with pytest.raises(MXNetError, match="all 2 attempts"):
+        retry(always, attempts=2, backoff=0.1,
+              sleep=lambda s: None, clock=lambda: 0.0)
+
+
+def test_retry_timeout_bounds_total_wall_time():
+    now = {"t": 0.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        now["t"] += s
+
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise TransientError("down")
+
+    with pytest.raises(MXNetError):
+        retry(always, attempts=10, backoff=4.0, timeout=10.0,
+              sleep=sleep, clock=lambda: now["t"])
+    # deadline cuts the ladder well short of 10 attempts, and the final
+    # wait is clamped to the time remaining
+    assert calls == [1, 1, 1]
+    assert sleeps == [4.0, 6.0]
+
+
+def test_retry_does_not_catch_unlisted_exceptions():
+    def bug(): raise ValueError("programming error")
+    with pytest.raises(ValueError):
+        retry(bug, attempts=5, sleep=lambda s: None)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+def test_fault_injector_env_arming(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULTS", "iter_next:2, checkpoint_write")
+    fi = FaultInjector()
+    assert fi.is_armed("iter_next") and fi.is_armed("checkpoint_write")
+    with pytest.raises(TransientError):
+        fi.maybe_fail("checkpoint_write")
+    assert not fi.is_armed("checkpoint_write")
+    assert fi.consume("iter_next") and fi.consume("iter_next")
+    assert not fi.consume("iter_next")
+
+
+# ---------------------------------------------------------------------------
+# atomic writes + CheckpointManager
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_replaces_not_tears(tmp_path, clean_faults):
+    target = tmp_path / "f.json"
+    atomic_write(str(target), "old")
+    clean_faults.arm("checkpoint_write")
+    with pytest.raises(TransientError):
+        atomic_write(str(target), "new")
+    assert target.read_text() == "old"
+    assert list(tmp_path.iterdir()) == [target]  # temp cleaned up
+
+
+def test_checkpoint_crash_mid_write_keeps_previous(tmp_path, clean_faults):
+    man = CheckpointManager(str(tmp_path), keep_last=3)
+    man.save(1, mlp_sym(), {"w": mx.nd.array(np.ones((3, 2), "f"))}, {})
+    assert man.latest() == 1
+    old_bytes = (tmp_path / "checkpoint-0001.params").read_bytes()
+
+    clean_faults.arm("checkpoint_write")
+    with pytest.raises(TransientError):
+        man.save(2, None, {"w": mx.nd.array(np.full((3, 2), 7, "f"))}, {})
+    # the kill-during-checkpoint run: previous checkpoint byte-for-byte
+    # intact, still discoverable, still loadable
+    assert (tmp_path / "checkpoint-0001.params").read_bytes() == old_bytes
+    assert not (tmp_path / "checkpoint-0002.params").exists()
+    assert man.latest() == 1
+    sym, args, auxs, states, epoch = man.restore()
+    assert epoch == 1 and sym is not None and states is None
+    assert np.allclose(args["w"].asnumpy(), 1.0)
+
+    # the relaunched run saves the same epoch cleanly
+    man.save(2, None, {"w": mx.nd.array(np.full((3, 2), 7, "f"))}, {})
+    assert man.latest() == 2
+    _, args2, _, _, _ = man.restore()
+    assert np.allclose(args2["w"].asnumpy(), 7.0)
+
+
+def test_checkpoint_retention_keep_last(tmp_path):
+    man = CheckpointManager(str(tmp_path), keep_last=2)
+    for epoch in range(1, 5):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {},
+                 optimizer_states=b"state-%d" % epoch)
+    assert man.checkpoints() == [3, 4]
+    assert not (tmp_path / "checkpoint-0001.params").exists()
+    assert not (tmp_path / "checkpoint-0002.states").exists()
+    _, args, _, states, epoch = man.restore()
+    assert epoch == 4 and states == b"state-4"
+    assert np.allclose(args["w"].asnumpy(), 4.0)
+
+
+def test_do_checkpoint_accepts_manager(tmp_path):
+    man = CheckpointManager(str(tmp_path), keep_last=2)
+    cb = mx.callback.do_checkpoint(man, period=2)
+    sym = mlp_sym()
+    for iter_no in range(4):
+        cb(iter_no, sym, {"w": mx.nd.array(np.full((2,), iter_no, "f"))}, {})
+    assert man.checkpoints() == [2, 4]
+
+
+def test_kvstore_optimizer_states_atomic(tmp_path, clean_faults):
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                         momentum=0.9))
+    w = mx.nd.array(np.ones((4, 3), "f"))
+    kv.init(0, w)
+    kv.push(0, [mx.nd.array(np.full((4, 3), 0.5, "f"))])
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    old_bytes = (tmp_path / "opt.states").read_bytes()
+
+    kv.push(0, [mx.nd.array(np.full((4, 3), 0.25, "f"))])
+    clean_faults.arm("checkpoint_write")
+    with pytest.raises(TransientError):
+        kv.save_optimizer_states(fname)
+    # a torn/partial write is impossible: the old file survives whole
+    assert (tmp_path / "opt.states").read_bytes() == old_bytes
+    kv.load_optimizer_states(fname)  # and still loads
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf step guard
+# ---------------------------------------------------------------------------
+
+def _fused_module(X, y, batch=32, seed=11):
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mod = mx.mod.Module(mlp_sym())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    assert mod._fused is not None, "fused path did not engage"
+    return mod, it
+
+
+def test_step_guard_skips_poisoned_batch_params_unchanged(clean_faults):
+    X, y = make_blobs(128, 10, 3)
+    mod, it = _fused_module(X, y)
+    batch = next(iter(it))
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+
+    clean_faults.arm("poison_grad")
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod.get_params()[0]
+    for name, old in before.items():
+        assert np.array_equal(old, after[name].asnumpy()), \
+            "guard leaked a non-finite update into %s" % name
+    assert mod.skipped_update_count == 1
+    assert mod._fused.consecutive_bad_steps == 1
+
+    # the very next (clean) batch trains normally
+    mod.forward_backward(batch)
+    mod.update()
+    newer = mod.get_params()[0]
+    assert any(not np.array_equal(before[k], newer[k].asnumpy())
+               for k in before)
+    assert mod.skipped_update_count == 1
+    assert mod._fused.consecutive_bad_steps == 0
+
+
+def test_training_converges_after_poisoned_batch(clean_faults):
+    mx.random.seed(106)
+    X, y = make_blobs(512, 10, 3)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(mlp_sym())
+    clean_faults.arm("poison_grad")  # poisons the first step's batch
+    mod.fit(it, num_epoch=6, kvstore="tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    assert mod.skipped_update_count == 1
+    acc = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=64), "acc"))
+    assert acc["accuracy"] > 0.9, acc
+
+
+def test_step_guard_aborts_after_max_consecutive_bad_steps(clean_faults):
+    from mxnet_tpu.parallel import SPMDTrainer
+    trainer = SPMDTrainer(mlp_sym(), "sgd",
+                          {"learning_rate": 0.1, "rescale_grad": 1.0 / 16},
+                          max_consecutive_bad_steps=2)
+    trainer.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    mx.random.seed(3)
+    trainer.init_params(mx.initializer.Xavier())
+    X = np.random.RandomState(0).randn(16, 10).astype("f")
+    y = np.zeros((16,), "f")
+
+    clean_faults.arm("poison_grad", times=2)
+    trainer.step(X, y)  # skip 1: guarded
+    assert trainer.skipped_steps == 1  # counter read flushes the flag
+    trainer.step(X, y)  # skip 2: flag read is pipelined one step late ...
+    with pytest.raises(MXNetError, match="consecutive"):
+        trainer.flush_step_guard()  # ... and aborts when accounted
+    assert trainer._skipped_steps == 2
+
+
+def test_step_guard_counter_surfaces_in_metric_and_monitor(clean_faults):
+    X, y = make_blobs(64, 10, 3)
+    mod, it = _fused_module(X, y)
+    skipped = mx.metric.SkippedSteps(mod)
+    assert skipped.get() == ("skipped_steps", 0.0)
+
+    clean_faults.arm("poison_grad")
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    assert skipped.get() == ("skipped_steps", 1.0)
+
+    mon = mx.mon.Monitor(1)
+    mon.install_step_guard(mod)
+    mon.tic()
+    rows = {k: v for _, k, v in mon.toc()}
+    assert rows["step_guard_skipped"] == str(1.0)
+    assert rows["step_guard_consecutive_bad"] == str(1.0)
+
+
+def test_poisoned_step_does_not_contaminate_metric(clean_faults):
+    X, y = make_blobs(64, 10, 3)
+    mod, it = _fused_module(X, y)
+    batch = next(iter(it))
+    metric = mx.metric.CrossEntropy()
+
+    clean_faults.arm("poison_grad")
+    mod.forward_backward(batch)
+    mod.update()
+    mod.update_metric(metric, batch.label)
+    # the skipped step's NaN outputs contributed nothing to the sum
+    assert metric.num_inst == 0
+
+    mod.forward_backward(batch)
+    mod.update()
+    mod.update_metric(metric, batch.label)
+    assert metric.num_inst > 0
+    assert np.isfinite(metric.get()[1]), metric.get()
+
+
+def test_step_guard_can_be_disabled():
+    from mxnet_tpu.parallel import SPMDTrainer
+    trainer = SPMDTrainer(mlp_sym(), "sgd",
+                          {"learning_rate": 0.1, "rescale_grad": 1.0 / 16},
+                          step_guard=False)
+    trainer.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    mx.random.seed(3)
+    trainer.init_params(mx.initializer.Xavier())
+    X = np.random.RandomState(0).randn(16, 10).astype("f")
+    trainer.step(X, np.zeros((16,), "f"))
+    assert trainer.skipped_steps == 0
+
+
+# ---------------------------------------------------------------------------
+# auto-resume
+# ---------------------------------------------------------------------------
+
+def _fit_params(tmp_dir, kvstore, epochs, resume=False, seed=21):
+    X, y = make_blobs(256, 10, 3, seed=4)
+    it = mx.io.NDArrayIter(X, y, batch_size=64)
+    mod = mx.mod.Module(mlp_sym())
+    mx.random.seed(seed)
+    mod.fit(it, num_epoch=epochs, kvstore=kvstore, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            checkpoint=tmp_dir, resume=resume)
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+@pytest.mark.parametrize("kvstore", ["local", "tpu"])
+def test_fit_resume_matches_uninterrupted_run(tmp_path, kvstore):
+    full = _fit_params(str(tmp_path / "full"), kvstore, epochs=4)
+    # "preempted" run: 2 epochs, then a fresh module resumes to 4
+    _fit_params(str(tmp_path / "cut"), kvstore, epochs=2)
+    man = CheckpointManager(str(tmp_path / "cut"))
+    assert man.latest() == 2
+    resumed = _fit_params(str(tmp_path / "cut"), kvstore, epochs=4,
+                          resume=True)
+    for name in full:
+        np.testing.assert_allclose(resumed[name], full[name], rtol=2e-5,
+                                   atol=2e-6, err_msg=name)
+    # resumed run checkpointed epochs 3 and 4 on top
+    assert man.latest() == 4
+
+
+def test_fit_resume_with_empty_dir_starts_fresh(tmp_path):
+    params = _fit_params(str(tmp_path / "fresh"), "local", epochs=2,
+                         resume=True)
+    assert params  # no checkpoint existed: trains from scratch, no error
+    assert CheckpointManager(str(tmp_path / "fresh")).latest() == 2
+
+
+def test_spmd_module_fit_resume_restores_optimizer_state(tmp_path):
+    from mxnet_tpu.parallel import SPMDModule
+
+    def run(d, epochs, resume=False):
+        X, y = make_blobs(256, 10, 3, seed=9)
+        it = mx.io.NDArrayIter(X, y, batch_size=64)
+        mod = SPMDModule(mlp_sym())
+        mx.random.seed(31)
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.initializer.Xavier(),
+                checkpoint=d, resume=resume)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    full = run(str(tmp_path / "full"), 4)
+    run(str(tmp_path / "cut"), 2)
+    # the cut run saved optimizer state too (momentum must survive)
+    assert os.path.exists(str(tmp_path / "cut" / "checkpoint-0002.states"))
+    resumed = run(str(tmp_path / "cut"), 4, resume=True)
+    for name in full:
+        np.testing.assert_allclose(resumed[name], full[name], rtol=2e-5,
+                                   atol=2e-6, err_msg=name)
+
+
+def test_spmd_trainer_checkpoint_roundtrip(tmp_path):
+    from mxnet_tpu.parallel import SPMDTrainer
+    X = np.random.RandomState(1).randn(16, 10).astype("f")
+    y = np.zeros((16,), "f")
+
+    def make():
+        t = SPMDTrainer(mlp_sym(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9,
+                         "rescale_grad": 1.0 / 16})
+        t.bind([("data", (16, 10))], [("softmax_label", (16,))])
+        mx.random.seed(5)
+        t.init_params(mx.initializer.Xavier())
+        return t
+
+    man = CheckpointManager(str(tmp_path))
+    a = make()
+    for _ in range(3):
+        a.step(X, y)
+    a.save_checkpoint(man, 3)
+
+    b = make()
+    assert b.restore(man) == 3
+    assert b._num_update == a._num_update  # momentum schedule continues
+    a.step(X, y)
+    b.step(X, y)
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    for name in pa:
+        np.testing.assert_allclose(pb[name].asnumpy(), pa[name].asnumpy(),
+                                   rtol=1e-6, err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# retryable bring-up + prefetcher
+# ---------------------------------------------------------------------------
+
+def test_distributed_initialize_retries_transient_failure(monkeypatch):
+    from mxnet_tpu import distributed as dist
+    calls = []
+
+    def fake_join(addr, n, pid, timeout):
+        calls.append((addr, n, pid, timeout))
+        if len(calls) == 1:
+            raise RuntimeError("injected transient coordinator failure")
+
+    monkeypatch.setattr(dist, "_join", fake_join)
+    monkeypatch.setattr(dist, "_check_backend_untouched", lambda: None)
+    monkeypatch.delenv("MXTPU_PLATFORM", raising=False)
+    monkeypatch.setenv("MXTPU_INIT_RETRIES", "3")
+    monkeypatch.setenv("MXTPU_INIT_BACKOFF", "0")
+    monkeypatch.setenv("MXTPU_INIT_TIMEOUT", "7")
+    assert not dist.is_initialized()
+    try:
+        dist.initialize(coordinator_address="127.0.0.1:1", num_processes=2,
+                        process_id=0)
+        assert dist.is_initialized()
+    finally:
+        dist._INITIALIZED = False
+    assert len(calls) == 2  # failed once, joined on the retry
+    assert calls[0] == ("127.0.0.1:1", 2, 0, "7")
+
+
+def test_distributed_initialize_retry_exhaustion(monkeypatch):
+    from mxnet_tpu import distributed as dist
+
+    def always_fail(addr, n, pid, timeout):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(dist, "_join", always_fail)
+    monkeypatch.setattr(dist, "_check_backend_untouched", lambda: None)
+    monkeypatch.delenv("MXTPU_PLATFORM", raising=False)
+    monkeypatch.setenv("MXTPU_INIT_RETRIES", "2")
+    monkeypatch.setenv("MXTPU_INIT_BACKOFF", "0")
+    with pytest.raises(MXNetError, match="all 2 attempts"):
+        dist.initialize(coordinator_address="127.0.0.1:1", num_processes=2,
+                        process_id=0)
+    assert not dist.is_initialized()
+
+
+def test_prefetcher_retries_transient_iterator_error(monkeypatch,
+                                                     clean_faults):
+    monkeypatch.setenv("MXTPU_DATA_RETRY_BACKOFF", "0")
+    X = np.arange(64, dtype="f").reshape(16, 4)
+    base = mx.io.NDArrayIter(X, np.zeros(16, "f"), batch_size=4)
+    clean_faults.arm("iter_next", times=2)  # both absorbed by one next()
+    it = mx.io.PrefetchingIter(base)
+    seen = [b.data[0].asnumpy().copy() for b in it]
+    assert len(seen) == 4
+    np.testing.assert_allclose(seen[0], X[:4])  # no batch lost or reordered
+    np.testing.assert_allclose(seen[-1], X[12:])
+
+
+def test_prefetcher_surfaces_exhausted_retries(monkeypatch, clean_faults):
+    monkeypatch.setenv("MXTPU_DATA_RETRY_BACKOFF", "0")
+    monkeypatch.setenv("MXTPU_DATA_RETRIES", "2")
+    X = np.arange(64, dtype="f").reshape(16, 4)
+    base = mx.io.NDArrayIter(X, np.zeros(16, "f"), batch_size=4)
+    clean_faults.arm("iter_next", times=2)  # beats the 2-attempt budget
+    it = mx.io.PrefetchingIter(base)
+    # the error reaches the consuming thread (no silent hang) ...
+    with pytest.raises(MXNetError, match="all 2 attempts"):
+        next(it)
+    # ... and iteration continues past the failed fetch
+    assert next(it) is not None
+
+
+# ---------------------------------------------------------------------------
+# bench.py timeout handling (satellite)
+# ---------------------------------------------------------------------------
+
+def test_bench_collect_records_timeout(monkeypatch):
+    import subprocess
+    import bench
+
+    def fake_run(*args, **kwargs):
+        raise subprocess.TimeoutExpired(cmd=args[0], timeout=kwargs["timeout"])
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    part = bench._collect("inception-bn", timeout=1)
+    assert part == {"inception-bn": {"status": "timeout", "timeout_s": 1}}
+
+
+def test_bench_main_emits_partial_json_on_timeouts(monkeypatch, capsys):
+    import bench
+
+    def fake_collect(mode, timeout=480):
+        if mode in ("compute", "resnet-152"):
+            return {mode: {"status": "timeout", "timeout_s": timeout}}
+        return {mode: 100.0}
+
+    monkeypatch.setattr(bench, "_collect", fake_collect)
+    monkeypatch.delenv("BENCH_MODE", raising=False)
+    monkeypatch.setenv("BENCH_PIPELINE", "0")
+    bench.main()  # must not raise (rc 0) despite the timed-out metrics
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["incomplete"]["compute"]["status"] == "timeout"
+    assert result["incomplete"]["resnet-152"]["status"] == "timeout"
+    assert "resnet152_img_s" not in result
+    assert result["inception_bn_img_s"] == 100.0
+    assert result["lstm_tok_s"] == 100.0
