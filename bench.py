@@ -439,6 +439,144 @@ def _fed_cpu_bench(batch=64, steps=40, warmup=8, trials=3):
     }
 
 
+def _pipeline_bench(batch=64, steps=40, warmup=6, trials=3):
+    """Async input-pipeline overlap proof on the CPU backend: fused-step
+    steps/sec against a DELIBERATELY SLOW host iterator (a per-batch
+    sleep calibrated to ~1.5x the staged step time), with prefetch depth
+    0 (synchronous staging on the consuming thread) vs depth 2
+    (DevicePrefetchIter staging on a background thread).  The serial
+    bound is 1/(delay+step); full overlap reaches 1/max(delay, step) —
+    with delay = 1.5x step that is a ~1.67x ceiling, so the reported
+    speedup demonstrates real overlap, not noise."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.dataflow import DevicePrefetchIter
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    dim, classes = 256, 10
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=1024, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=1024, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc3")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    trainer = SPMDTrainer(
+        net, "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+        mesh=None)
+    trainer.bind([("data", (batch, dim))], [("softmax_label", (batch,))])
+    trainer.init_params(mx.initializer.Xavier())
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(batch * 16, dim).astype("f")
+    y = rs.randint(0, classes, batch * 16).astype("f")
+
+    # calibrate: staged step-only time (batches pre-placed, warm program)
+    staged = [trainer.stage_batch(X[i:i + batch], y[i:i + batch])
+              for i in range(0, batch * 4, batch)]
+    from mxnet_tpu.io import StagedBatch
+    staged = [StagedBatch(s, data=[], label=[]) for s in staged]
+    for i in range(warmup):
+        trainer.step(staged[i % len(staged)])
+    jax.block_until_ready(trainer.params)
+    tic = time.perf_counter()
+    for i in range(steps):
+        trainer.step(staged[i % len(staged)])
+    jax.block_until_ready(trainer.params)
+    step_s = (time.perf_counter() - tic) / steps
+    delay = max(1.5 * step_s, 0.002)
+
+    class SlowIter(mx.io.NDArrayIter):
+        """Host iterator with a fixed per-batch stall (sleep releases the
+        GIL, like real decode/storage waits do)."""
+
+        def next(self):
+            time.sleep(delay)
+            return super().next()
+
+        __next__ = next
+
+    def run(depth):
+        src = SlowIter(X, y, batch_size=batch)
+        it = DevicePrefetchIter(src, stage=trainer, depth=depth)
+        gen = iter(self_repeat(it))
+        for _ in range(warmup):
+            trainer.step(next(gen))
+        jax.block_until_ready(trainer.params)
+
+        def trial():
+            tic = time.perf_counter()
+            for _ in range(steps):
+                trainer.step(next(gen))
+            jax.block_until_ready(trainer.params)
+            return steps / (time.perf_counter() - tic)
+
+        best = _best_of(trial, trials)
+        it.close()
+        return best
+
+    def self_repeat(it):
+        while True:
+            it.reset()
+            for b in it:
+                yield b
+
+    d0 = run(0)
+    d2 = run(2)
+    trainer.close()
+    return {
+        "pipeline_steps_s_depth0": round(d0, 2),
+        "pipeline_steps_s_depth2": round(d2, 2),
+        "pipeline_speedup": round(d2 / d0, 3),
+        "pipeline_step_ms": round(step_s * 1e3, 3),
+        "pipeline_iter_delay_ms": round(delay * 1e3, 3),
+    }
+
+
+def _compile_probe():
+    """Bring-up time: trainer construction + bind + first step, the part
+    MXTPU_COMPILE_CACHE amortizes.  Run twice in fresh subprocesses with
+    the same cache dir: run 1 = cold (compiles + populates), run 2 = warm
+    (loads compiled programs from disk)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer
+
+    batch, side = 32, 32
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=32, kernel=(3, 3),
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=64, kernel=(3, 3),
+                             pad=(1, 1), name="c2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(batch, 3, side, side).astype("f")
+    y = rs.randint(0, 10, batch).astype("f")
+
+    tic = time.perf_counter()
+    trainer = SPMDTrainer(
+        net, "sgd", {"learning_rate": 0.1, "rescale_grad": 1.0 / batch},
+        mesh=None)
+    trainer.bind([("data", (batch, 3, side, side))],
+                 [("softmax_label", (batch,))])
+    trainer.init_params(mx.initializer.Xavier())
+    trainer.step(X, y)
+    jax.block_until_ready(trainer.params)
+    bringup = time.perf_counter() - tic
+    trainer.close()
+    return {"compile_bringup_s": round(bringup, 3)}
+
+
 def _lstm_bench(batch, seq_len, steps, warmup, trials):
     """2-layer LSTM LM (lstm_bucketing workload, one bucket) tokens/sec."""
     import jax
@@ -492,7 +630,7 @@ def _run_mode(mode):
     trials = _env_int("BENCH_TRIALS", 2)
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
-    if mode in ("decode", "fed-cpu"):
+    if mode in ("decode", "fed-cpu", "pipeline", "compile-probe"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -502,6 +640,10 @@ def _run_mode(mode):
         out.update(_decode_bench())
     elif mode == "fed-cpu":
         out.update(_fed_cpu_bench())
+    elif mode == "pipeline":
+        out.update(_pipeline_bench())
+    elif mode == "compile-probe":
+        out.update(_compile_probe())
     elif mode == "fed":
         out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
         out["fed_roofline"] = _roofline(out["fed"],
@@ -544,7 +686,7 @@ def _run_mode(mode):
     print("BENCH_PART " + json.dumps(out))
 
 
-def _collect(mode, timeout=480):
+def _collect(mode, timeout=480, extra_env=None):
     """Run one metric in a FRESH subprocess.
 
     Each metric gets its own process because the tunneled device runtime
@@ -553,10 +695,13 @@ def _collect(mode, timeout=480):
     slower after another trainer has lived in the process — per-step
     overhead grows from ~2.5 ms to ~30 ms).  Fresh sessions give every
     metric the steady-state it would have in a real training job.
+    ``extra_env`` overlays the child environment (the compile-cache
+    probes point both runs at one cache directory this way).
     """
     import subprocess
     env = dict(os.environ)
     env["BENCH_MODE"] = mode
+    env.update(extra_env or {})
     try:
         res = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              capture_output=True, text=True, timeout=timeout,
@@ -587,6 +732,23 @@ def main():
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         parts.update(_collect("decode"))
         parts.update(_collect("fed-cpu"))
+        parts.update(_collect("pipeline"))
+        # cold vs warm bring-up through the persistent compile cache: two
+        # fresh processes sharing one MXTPU_COMPILE_CACHE dir — the first
+        # compiles and populates, the second loads from disk
+        import shutil
+        import tempfile
+        cache_dir = tempfile.mkdtemp(prefix="bench_compile_cache_")
+        try:
+            cache_env = {"MXTPU_COMPILE_CACHE": cache_dir}
+            cold = _collect("compile-probe", extra_env=cache_env)
+            warm = _collect("compile-probe", extra_env=cache_env)
+        finally:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+        if "compile_bringup_s" in cold:
+            parts["compile_cold_s"] = cold["compile_bringup_s"]
+        if "compile_bringup_s" in warm:
+            parts["compile_warm_s"] = warm["compile_bringup_s"]
         parts.update(_collect("fed"))
     parts.update(_collect("compute"))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
@@ -630,7 +792,11 @@ def main():
         result["pipeline_decode_scaling"] = parts["decode_scaling"]
         result["pipeline_ncores"] = parts["ncores"]
     for k in ("fed_cpu", "fed_cpu_decode", "fed_cpu_step",
-              "fed_cpu_ceiling", "fed_cpu_overlap"):
+              "fed_cpu_ceiling", "fed_cpu_overlap",
+              "pipeline_steps_s_depth0", "pipeline_steps_s_depth2",
+              "pipeline_speedup", "pipeline_step_ms",
+              "pipeline_iter_delay_ms",
+              "compile_cold_s", "compile_warm_s"):
         if k in parts:
             result[k] = parts[k]
     if compute is not None:

@@ -38,8 +38,11 @@ def measure(network, batch, mirror):
     import numpy as np
     d = mx.nd.array(np.zeros((batch, 3, 224, 224), "f")).astype("bfloat16")
     l = mx.nd.array(np.zeros(batch, "f"))
+    extras = {"guard": (trainer._scalar_acc(0, np.int32),
+                        trainer._scalar_acc(0, np.int32),
+                        trainer._scalar_acc(0, np.int32))}
     lowered = trainer._step_fn.lower(
-        trainer.params, trainer.aux, trainer.opt_state,
+        trainer.params, trainer.aux, trainer.opt_state, extras,
         {"data": d._data, "softmax_label": l._data},
         jax.random.PRNGKey(0), 0.1, 0.0, 1)
     compiled = lowered.compile()

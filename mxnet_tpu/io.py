@@ -21,8 +21,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array as nd_array
 
 __all__ = [
-    "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-    "PrefetchingIter", "MNISTIter", "CSVIter",
+    "DataDesc", "DataBatch", "StagedBatch", "DataIter", "NDArrayIter",
+    "ResizeIter", "PrefetchingIter", "MNISTIter", "CSVIter",
 ]
 
 
@@ -58,6 +58,28 @@ class DataBatch(object):
         self.bucket_key = bucket_key
         self.provide_data = provide_data
         self.provide_label = provide_label
+
+
+class StagedBatch(DataBatch):
+    """A DataBatch whose inputs are ALREADY placed on the mesh.
+
+    ``staged`` maps input name -> device array, sharded/cast exactly the
+    way ``SPMDTrainer._shard_batch`` would place it (see
+    ``SPMDTrainer.stage_batch``); a trainer handed a StagedBatch skips the
+    per-step host->device transfer entirely, which is how
+    ``dataflow.DevicePrefetchIter`` overlaps the upload of batch N+1 with
+    the execution of batch N.  The host-side ``data``/``label`` references
+    are kept (no extra copy — they are the source iterator's arrays) so
+    host consumers (metrics in blocking mode, the executor-group path,
+    fault-injection re-staging) still see a plain DataBatch.
+    """
+
+    def __init__(self, staged, data=None, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        super().__init__(data, label=label, pad=pad, index=index,
+                         provide_data=provide_data,
+                         provide_label=provide_label)
+        self.staged = dict(staged)
 
 
 class DataIter(object):
@@ -299,31 +321,12 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def _next_with_retry(self, i):
-        """Pull the next batch, retrying transient source errors (flaky
-        network storage, an injected ``iter_next`` fault) with backoff and
-        per-attempt logging; StopIteration and real bugs pass straight
-        through.  Tunables: MXTPU_DATA_RETRIES / MXTPU_DATA_RETRY_BACKOFF.
-
-        CONTRACT: a retried source must not have advanced its cursor on
-        the failed call (true of read-then-decode iterators, where the
-        fetch fails before the position moves).  A source that consumes
-        the record before failing would resume one record later — with
-        multiple wrapped iters set MXTPU_DATA_RETRIES=1 for such sources
-        and handle the surfaced error with reset()."""
-        from .base import get_env
-        from .resilience import (retry, faults, TransientError,
-                                 ENV_DATA_RETRIES, ENV_DATA_BACKOFF)
-
-        def _one():
-            faults.maybe_fail("iter_next")
-            return self.iters[i].next()
-
-        return retry(
-            _one,
-            attempts=int(get_env(ENV_DATA_RETRIES, "3")),
-            backoff=float(get_env(ENV_DATA_BACKOFF, "0.05")),
-            retry_on=(IOError, OSError, TransientError),
-            name="prefetch[%d].next" % i)
+        """Pull the next batch through the shared retry discipline
+        (resilience.retrying_next: MXTPU_DATA_RETRIES with backoff;
+        StopIteration and real bugs pass straight through — see its
+        docstring for the no-cursor-advance contract)."""
+        from .resilience import retrying_next
+        return retrying_next(self.iters[i], name="prefetch[%d].next" % i)
 
     @property
     def provide_data(self):

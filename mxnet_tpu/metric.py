@@ -11,7 +11,8 @@ from .ndarray import NDArray
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
            "MAE", "MSE", "RMSE", "CrossEntropy", "Loss", "Torch", "Caffe",
            "CustomMetric", "CompositeEvalMetric", "SkippedSteps", "np",
-           "create"]
+           "create", "try_install_deferred",
+           "ENV_METRIC_INTERVAL", "ENV_METRIC_BLOCKING"]
 
 metric_registry = Registry("metric")
 
@@ -27,7 +28,16 @@ def check_label_shapes(labels, preds, shape=False):
 
 
 class EvalMetric(object):
-    """Base metric (reference metric.py:EvalMetric)."""
+    """Base metric (reference metric.py:EvalMetric).
+
+    Deferred device accumulation: a fused trainer can keep this metric's
+    (sum, count) IN-GRAPH (``SPMDTrainer.install_metric``) so per-step
+    ``update`` calls never force a device->host sync.  The trainer is
+    attached as a deferred source (:meth:`attach_deferred_source`); any
+    ``get()``/``reset()`` first folds the device-side totals in, so reads
+    are always exact — between reads the host copy lags by at most the
+    fetch interval (MXTPU_METRIC_INTERVAL).
+    """
 
     def __init__(self, name, num=None):
         self.name = name
@@ -44,8 +54,45 @@ class EvalMetric(object):
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        reset_fn = getattr(self, "_deferred_reset", None)
+        if reset_fn is not None:
+            reset_fn()
+
+    # -- deferred (in-graph) accumulation ----------------------------------
+    def graph_update(self, label_names):
+        """A jax-traceable ``fn(outs, data) -> (sum, count)`` mirroring
+        :meth:`update` for in-graph accumulation, or None when this metric
+        has no device-side rule (the caller then stays on the blocking
+        host path).  ``outs`` is the step's output list; ``data`` the
+        pre-transform input dict (labels under ``label_names``)."""
+        return None
+
+    def attach_deferred_source(self, fetch, reset):
+        """Fold device-side accumulators into this metric lazily:
+        ``fetch() -> (sum_delta, count_delta)`` is drained on every
+        ``get``/explicit fold; ``reset()`` zeroes the device side when the
+        metric resets."""
+        self._deferred_fetch = fetch
+        self._deferred_reset = reset
+
+    def detach_deferred_source(self):
+        self._deferred_fetch = None
+        self._deferred_reset = None
+
+    def fold_deferred(self):
+        """Drain any pending device-side (sum, count) into the host
+        accumulators (one small device->host read; no-op when no deferred
+        source is attached)."""
+        fetch = getattr(self, "_deferred_fetch", None)
+        if fetch is None:
+            return
+        s, c = fetch()
+        if c:
+            self.sum_metric += s
+            self.num_inst += int(c)
 
     def get(self):
+        self.fold_deferred()
         if self.num is None:
             if self.num_inst == 0:
                 return (self.name, float("nan"))
@@ -120,6 +167,29 @@ class Accuracy(EvalMetric):
             check_label_shapes(label, pred)
             self.sum_metric += (pred == label).sum()
             self.num_inst += len(label)
+
+    def graph_update(self, label_names):
+        """In-graph (sum, count) rule — integer counts in f32, so the
+        deferred totals are bit-identical to the host path's."""
+        if not label_names:
+            return None
+        axis = self.axis
+
+        def fn(outs, data):
+            import jax.numpy as jnp
+            s = jnp.float32(0.0)
+            c = jnp.float32(0.0)
+            for name, pred in zip(label_names, outs):
+                label = data[name]
+                if pred.ndim > label.ndim:
+                    pred = jnp.argmax(pred, axis=axis)
+                pred = pred.astype(jnp.int32).reshape(-1)
+                label = label.astype(jnp.int32).reshape(-1)
+                s = s + jnp.sum(pred == label).astype(jnp.float32)
+                c = c + jnp.float32(label.shape[0])
+            return s, c
+
+        return fn
 
 
 @metric_registry.register(name="top_k_accuracy", aliases=("topkaccuracy",))
@@ -205,6 +275,7 @@ class Perplexity(EvalMetric):
         self.num_inst += num
 
     def get(self):
+        self.fold_deferred()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
@@ -332,6 +403,12 @@ class SkippedSteps(EvalMetric):
     (``skipped_update_count``) or an SPMDTrainer (``skipped_steps``).
     The value is a monotone total, not a per-batch average; ``reset()``
     keeps it (the counter belongs to the trainer, not the metric).
+
+    Deferred-metric interaction: the skip counters live in-graph and the
+    source's counter PROPERTY flushes them on read, so ``get()`` is
+    always exact even when metric fetches are deferred — between reads
+    the host copy is stale by at most the trainer's ``flush_interval``
+    (MXTPU_METRIC_INTERVAL) steps.
     """
 
     def __init__(self, source, name="skipped_steps"):
@@ -353,6 +430,50 @@ class SkippedSteps(EvalMetric):
 
     def get(self):
         return (self.name, self._count())
+
+
+#: fold the device-side accumulators into the host metric every N
+#: ``update_metric`` calls; 0 (default) folds only at epoch end / on get()
+ENV_METRIC_INTERVAL = "MXTPU_METRIC_INTERVAL"
+#: "1" disables deferred metrics entirely — every step updates the host
+#: metric from fetched outputs (the exact-parity blocking mode for tests)
+ENV_METRIC_BLOCKING = "MXTPU_METRIC_BLOCKING"
+
+
+def try_install_deferred(trainer, metric):
+    """Move ``metric``'s accumulation into ``trainer``'s fused step when
+    possible.  Returns the fold interval (int, possibly 0 = epoch-end
+    only) when installed, or None when the blocking path must be used
+    (no trainer, MXTPU_METRIC_BLOCKING=1, composite/multi-slot metric, or
+    a metric without an in-graph rule).
+
+    Call BEFORE the first step (fit does) — installation rebuilds the
+    step function, which is free pre-compile and one recompile after."""
+    from .base import get_env
+    if trainer is None or getattr(trainer, "_step_fn", None) is None:
+        return None
+    if str(get_env(ENV_METRIC_BLOCKING, "0")) == "1":
+        return None
+    if getattr(trainer, "compute_dtype", None) is not None:
+        # _shard_batch casts floating LABELS to the compute dtype too, and
+        # e.g. bf16 cannot represent odd class ids above 256 — the
+        # in-graph comparison would silently diverge from the blocking
+        # path's exact host labels, breaking the bit-parity contract
+        return None
+    if not isinstance(metric, EvalMetric) or metric.num is not None:
+        return None
+    fn = metric.graph_update(list(trainer.label_names))
+    if fn is None:
+        return None
+    interval = int(get_env(ENV_METRIC_INTERVAL, "0"))
+    # equivalence key: re-installing the same rule (a second fit() with
+    # the same metric config) must not rebuild — and recompile — the step
+    key = (type(metric).__name__, getattr(metric, "axis", None),
+           tuple(trainer.label_names), interval)
+    trainer.install_metric(fn, flush_interval=interval, key=key)
+    metric.attach_deferred_source(trainer.fetch_metric,
+                                  trainer.reset_metric)
+    return interval
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):

@@ -145,7 +145,16 @@ class BaseModule(object):
         newest checkpoint before training — params, optimizer state and
         epoch — so a preempted run relaunched with the same arguments
         continues where it stopped (the reference's manual
-        ``--load-epoch`` relaunch, made automatic)."""
+        ``--load-epoch`` relaunch, made automatic).
+
+        Async pipeline: ``train_data`` may yield
+        :class:`~mxnet_tpu.io.StagedBatch` objects (wrap it in
+        ``dataflow.DevicePrefetchIter`` after ``init_optimizer``) to
+        overlap the host->device transfer with the running step; on fused
+        modules the train metric is accumulated in-graph (deferred — see
+        MXTPU_METRIC_INTERVAL / MXTPU_METRIC_BLOCKING) and
+        MXTPU_PROFILE_DIR captures a ``jax.profiler`` trace of steps
+        10-15 of the first epoch.  See docs/how_to/performance.md."""
         assert num_epoch is not None, "please specify number of epochs"
 
         if checkpoint is not None and not hasattr(checkpoint, "restore"):
@@ -189,65 +198,93 @@ class BaseModule(object):
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        # deferred metrics: fused modules fold the train metric's
+        # (sum, count) INTO the step program so update_metric never forces
+        # a per-step device->host sync (installed before the first step,
+        # so the one compile already includes the accumulators; no-op on
+        # the executor path / unsupported metrics / MXTPU_METRIC_BLOCKING)
+        self._install_deferred_metric(eval_metric)
 
-        ################################################################
-        # training loop
-        ################################################################
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+        # MXTPU_PROFILE_DIR: capture a jax.profiler trace of steps 10-15
+        # of the first epoch (None when the env is unset).  The finally
+        # below guarantees the profiler is stopped even when the loop
+        # raises mid-window (guard abort, callback error) — a leaked
+        # running trace would crash the next fit()'s start_trace
+        from .. import profiler as _profiler
+        trace = _profiler.StepTraceCapture.from_env()
+        try:
 
-            # one epoch of training is finished
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            ############################################################
+            # training loop
+            ############################################################
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                for nbatch, data_batch in enumerate(train_data):
+                    if trace is not None:
+                        trace.on_batch(nbatch)
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                if trace is not None:
+                    trace.stop()  # epoch shorter than the window: close
+                    trace = None  # first epoch only
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+                # one epoch of training is finished
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 (toc - tic))
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                # sync aux params across devices
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
 
-            if checkpoint is not None:
-                # gather happens above on EVERY rank (collective under
-                # sharded params); the manager then writes on rank 0 only
-                try:
-                    states = self.get_optimizer_states()
-                except NotImplementedError:
-                    states = None
-                checkpoint.save(epoch + 1, self.symbol, arg_params_,
-                                aux_params_, optimizer_states=states)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_,
+                                 aux_params_)
 
-            # ----------------------------------------
-            # evaluation on validation set
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                if checkpoint is not None:
+                    # gather happens above on EVERY rank (collective under
+                    # sharded params); the manager then writes on rank 0
+                    # only
+                    try:
+                        states = self.get_optimizer_states()
+                    except NotImplementedError:
+                        states = None
+                    checkpoint.save(epoch + 1, self.symbol, arg_params_,
+                                    aux_params_, optimizer_states=states)
 
-            # end of 1 epoch, reset the data-iter for another epoch
-            train_data.reset()
+                # ----------------------------------------
+                # evaluation on validation set
+                if eval_data:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+
+                # end of 1 epoch, reset the data-iter for another epoch
+                train_data.reset()
+        finally:
+            if trace is not None:
+                trace.stop()
 
     # -- symbol / params ---------------------------------------------------
     @property
@@ -290,6 +327,48 @@ class BaseModule(object):
             else:
                 raise ValueError("Invalid param file " + fname)
         self.set_params(arg_params, aux_params)
+
+    def _deferred_metric_trainer(self):
+        """The fused SPMDTrainer that can carry in-graph metrics, or None
+        — the base has none, so every module type stays on the classic
+        blocking path unless it overrides this."""
+        return None
+
+    def _install_deferred_metric(self, eval_metric):
+        """fit() hook: move the train metric's accumulation into the
+        fused step program (metric.try_install_deferred).  Detaches any
+        previously installed metric and uninstalls a stale in-graph rule
+        when the new metric cannot defer, so a second fit() never leaks
+        the first run's accumulators or steals its deltas."""
+        from .. import metric as metric_mod
+        prev = getattr(self, "_deferred_metric", None)
+        if prev is not None:
+            prev.detach_deferred_source()
+        self._deferred_metric = None
+        self._deferred_interval = 0
+        self._deferred_calls = 0
+        trainer = self._deferred_metric_trainer()
+        if trainer is None:
+            return
+        interval = metric_mod.try_install_deferred(trainer, eval_metric)
+        if interval is None:
+            if getattr(trainer, "_metric_fn", None) is not None:
+                trainer.install_metric(None)
+            return
+        self._deferred_metric = eval_metric
+        self._deferred_interval = interval
+
+    def _deferred_metric_update(self, eval_metric):
+        """True when ``eval_metric`` is accumulated in-graph for train
+        steps (the per-step host update must be skipped); folds the
+        device totals every ``_deferred_interval`` calls."""
+        if getattr(self, "_deferred_metric", None) is not eval_metric:
+            return False
+        self._deferred_calls += 1
+        if self._deferred_interval > 0 and \
+                self._deferred_calls % self._deferred_interval == 0:
+            eval_metric.fold_deferred()
+        return True
 
     def get_optimizer_states(self):
         """Serialized optimizer state (bytes), for managed checkpointing.

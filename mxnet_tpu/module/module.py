@@ -302,6 +302,11 @@ class Module(BaseModule):
         self._fused_outputs = None
         self._fused_outputs_from_update = False
         self._monitor_installed = False
+        if getattr(self, "_deferred_metric", None) is not None:
+            self._deferred_metric.detach_deferred_source()
+        self._deferred_metric = None
+        self._deferred_interval = 0
+        self._deferred_calls = 0
 
     # -- optimizer ---------------------------------------------------------
     def reshape(self, data_shapes, label_shapes=None):
@@ -495,7 +500,13 @@ class Module(BaseModule):
     def _fused_feed(self, data_batch):
         """Assemble the trainer's input list (data then labels) from a
         DataBatch, synthesizing zero labels when absent (predict path —
-        labels only matter for the backward)."""
+        labels only matter for the backward).  A StagedBatch (inputs
+        already placed on the mesh by DevicePrefetchIter/stage_batch)
+        passes through whole — the trainer consumes it directly and skips
+        the host->device transfer."""
+        from ..io import StagedBatch
+        if isinstance(data_batch, StagedBatch):
+            return [data_batch]
         arrays = list(data_batch.data)
         labels = list(data_batch.label or [])
         if len(labels) < len(self._fused.label_names):
@@ -600,8 +611,16 @@ class Module(BaseModule):
             self.inputs_need_grad
         return self._exec_group.get_input_grads(merge_multi_context)
 
+    def _deferred_metric_trainer(self):
+        return self._fused  # None on the executor path
+
     def update_metric(self, eval_metric, labels):
         if self._fused is not None:
+            if self._fused_outputs_from_update and \
+                    self._deferred_metric_update(eval_metric):
+                # the step itself accumulated (sum, count) in-graph —
+                # nothing to fetch per step
+                return
             if self._fused_outputs_from_update and self._fused.step_guard:
                 # a guard-skipped step's outputs are non-finite by
                 # definition; one NaN into a summing metric would poison

@@ -69,7 +69,14 @@ class Monitor(object):
         (``skipped_steps``/``consecutive_bad_steps``); rows appear as
         ``step_guard_skipped`` / ``step_guard_consecutive_bad`` next to
         the per-node stats, so a skipping run is visible in the same
-        place its activations are being debugged."""
+        place its activations are being debugged.
+
+        Deferred-metric interaction: the counters live in-graph and the
+        source properties FLUSH them on read, so every reported row is
+        exact at its ``toc()`` — even when the trainer's routine
+        host<->device sync is deferred to every MXTPU_METRIC_INTERVAL
+        steps, reading here forces the fold (between tocs the host copy
+        lags by at most that interval)."""
         self._guard_sources.append(source)
 
     def tic(self):

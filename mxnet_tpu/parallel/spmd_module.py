@@ -81,12 +81,22 @@ class SPMDModule(BaseModule):
 
     # fused: forward_backward does the whole step; update is a no-op
     def forward_backward(self, data_batch):
+        from ..io import StagedBatch
+        if isinstance(data_batch, StagedBatch):
+            # inputs already placed on the mesh (DevicePrefetchIter):
+            # the step skips the host->device transfer
+            self._trainer.step(data_batch)
+            return
         arrays = list(data_batch.data) + list(data_batch.label or [])
         self._trainer.step(*arrays)
 
     def forward(self, data_batch, is_train=None):
         if is_train:
             return self.forward_backward(data_batch)
+        from ..io import StagedBatch
+        if isinstance(data_batch, StagedBatch):
+            self._eval_outputs = self._trainer.eval_step(data_batch)
+            return
         arrays = list(data_batch.data) + list(data_batch.label or [])
         if len(arrays) < len(self._trainer.input_names):
             # predict without labels: pad with zeros of the right shape
@@ -110,7 +120,15 @@ class SPMDModule(BaseModule):
             return outs
         return self._trainer.outputs
 
+    def _deferred_metric_trainer(self):
+        return self._trainer  # None before init_optimizer
+
     def update_metric(self, eval_metric, labels):
+        if getattr(self, "_eval_outputs", None) is None and \
+                self._deferred_metric_update(eval_metric):
+            # train-step path with in-graph accumulation: the step already
+            # counted this batch (guard-skipped steps excluded in-graph)
+            return
         if getattr(self, "_eval_outputs", None) is None and \
                 self._trainer.step_guard:
             # train-step outputs: a guard-skipped step's outputs are

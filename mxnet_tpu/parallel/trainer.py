@@ -30,11 +30,19 @@ from ..executor import _build_eval
 from ..ndarray import NDArray
 from ..io import DataDesc
 
-__all__ = ["SPMDTrainer", "SUPPORTED_OPTIMIZERS"]
+__all__ = ["SPMDTrainer", "SUPPORTED_OPTIMIZERS",
+           "DEFAULT_GUARD_FLUSH_INTERVAL"]
 
 # optimizers with an in-graph update rule (_apply_update); Module's fused
 # path consults this before engaging
 SUPPORTED_OPTIMIZERS = ("sgd", "ccsgd", "adam", "rmsprop")
+
+#: guard-counter flush cadence when deferred metrics are installed with no
+#: explicit MXTPU_METRIC_INTERVAL (interval 0 = fold metrics on reads
+#: only): the guard still syncs every this-many steps so skip logging and
+#: the divergence abort lag by a bounded, documented amount instead of a
+#: whole epoch
+DEFAULT_GUARD_FLUSH_INTERVAL = 25
 
 
 def _slice_shape(idx, shape):
@@ -141,13 +149,18 @@ class SPMDTrainer(object):
         # NaN/Inf step guard: an in-graph all-finite check over the raw
         # gradients; a non-finite step applies NO update (params, aux and
         # optimizer state pass through unchanged inside the same fused
-        # program) and is counted host-side.  After
-        # ``max_consecutive_bad_steps`` bad steps in a row the run aborts
-        # with MXNetError — persistent NaNs mean a diverged model, and
-        # silently skipping forever would burn a pod doing nothing.
-        # The flag is read ONE STEP LATE (at the next step()'s entry, or at
-        # flush_step_guard/get_params/counter reads), so the guard costs a
-        # one-deep pipeline instead of a full host sync per step.
+        # program).  Skip accounting is ALSO in-graph: the step carries a
+        # donated (total_skips, consecutive_bad) i32 pair, so the host
+        # never needs a per-step device sync to know how many updates were
+        # dropped.  The counters are read ONE STEP LATE by default (at the
+        # next step()'s entry, or at flush_step_guard/get_params/counter
+        # reads) — a one-deep pipeline — and when deferred metrics raise
+        # ``flush_interval`` above 1, only every that-many steps (at most
+        # ``flush_interval`` steps of staleness; counter-property reads
+        # always flush and are exact).  After ``max_consecutive_bad_steps``
+        # bad steps in a row the flush aborts with MXNetError — persistent
+        # NaNs mean a diverged model, and silently skipping forever would
+        # burn a pod doing nothing.
         from ..resilience import ENV_STEP_GUARD, ENV_MAX_BAD_STEPS
         if step_guard is None:
             step_guard = str(get_env(ENV_STEP_GUARD, "1")) != "0"
@@ -158,8 +171,23 @@ class SPMDTrainer(object):
         self.max_consecutive_bad_steps = int(max_consecutive_bad_steps)
         self._skipped_steps = 0           # total guarded skips, ever
         self._consecutive_bad_steps = 0   # current bad-step run length
-        self._pending_flag = None         # last step's unread finite flag
+        self._skip_base = 0               # host total when counters placed
+        self._guard_acc = None            # device (total, consec, trips) i32
+        self._guard_pending = False       # unread counters in flight
+        self._trips_seen = 0              # abort events already raised
         self.last_step_skipped = False    # most recently FLUSHED step
+        # deferred in-graph metrics: optional (sum, count) f32 accumulators
+        # carried through the donated step (install_metric); fetch_metric
+        # reads them and re-zeroes, so each accumulation window spans at
+        # most flush_interval steps and f32 stays exact for integer sums
+        self._metric_fn = None
+        self._metric_key = None
+        self._metric_acc = None
+        # host<->device sync cadence for the guard counters: 1 = flush at
+        # every step entry (classic one-deep pipeline); >1 = flush every
+        # N steps (set by install_metric for deferred-metric runs)
+        self.flush_interval = 1
+        self._steps_since_flush = 0
 
         self._rep_fn = None       # cached jitted reshard-to-replicated
         self.params = None        # dict name -> jax array (sharded)
@@ -358,6 +386,8 @@ class SPMDTrainer(object):
         compute_dtype = self.compute_dtype
         transforms = dict(self.input_transforms)
         guard = self.step_guard
+        metric_fn = self._metric_fn
+        maxbad = self.max_consecutive_bad_steps
 
         def xform(data):
             if not transforms:
@@ -375,7 +405,8 @@ class SPMDTrainer(object):
                     if jnp.issubdtype(v.dtype, jnp.floating) else v
                     for k, v in p.items()}
 
-        def step(params, aux, opt_state, data, rng, lr, wd, t):
+        def step(params, aux, opt_state, extras, data, rng, lr, wd, t):
+            raw_data = data  # pre-transform inputs (labels for metrics)
             data = xform(data)
             if zero:
                 # cast the dp-sharded f32 master to compute dtype BEFORE
@@ -438,13 +469,39 @@ class SPMDTrainer(object):
                 new_state[name] = s
             new_aux = dict(aux)
             new_aux.update(auxu)
+            new_extras = {}
             if guard:
                 # BN moving stats computed from a poisoned batch must not
                 # stick either
                 for name, v in auxu.items():
                     new_aux[name] = jnp.where(finite, v, aux[name])
-                return new_params, new_aux, new_state, list(outs), finite
-            return new_params, new_aux, new_state, list(outs)
+                # in-graph skip accounting: totals accumulate, the
+                # consecutive run resets on any good step, and ``trips``
+                # counts runs REACHING the abort threshold — so a bad run
+                # that ends between two deferred flushes still aborts at
+                # the next flush (the peak would otherwise be lost when
+                # consec resets).  The host reads all three lazily
+                # (flush_step_guard), never per-step.
+                total, consec, trips = extras["guard"]
+                new_consec = jnp.where(finite, jnp.zeros_like(consec),
+                                       consec + 1)
+                if maxbad > 0:
+                    trips = trips + (new_consec == maxbad).astype(
+                        trips.dtype)
+                new_extras["guard"] = (
+                    jnp.where(finite, total, total + 1), new_consec, trips)
+            if metric_fn is not None:
+                # in-graph metric accumulation from this step's own
+                # outputs and (pre-transform) labels; a guard-skipped
+                # step contributes nothing — EXACT parity with the
+                # blocking host path, which drops skipped steps too
+                msum, mcnt = extras["metric"]
+                ds, dc = metric_fn(list(outs), raw_data)
+                if guard:
+                    ds = jnp.where(finite, ds, jnp.zeros_like(ds))
+                    dc = jnp.where(finite, dc, jnp.zeros_like(dc))
+                new_extras["metric"] = (msum + ds, mcnt + dc)
+            return new_params, new_aux, new_state, new_extras, list(outs)
 
         def eval_step(params, aux, data, rng, is_train=False):
             if zero:
@@ -467,11 +524,41 @@ class SPMDTrainer(object):
         # input shardings propagate from the placed arguments (params were
         # device_put with their NamedShardings, batches are sharded in
         # _shard_batch) — GSPMD partitions the step and inserts collectives.
-        # Donation lets params/opt-state update in place in HBM.
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        # Donation lets params/opt-state (and the guard/metric carries in
+        # ``extras``) update in place in HBM.
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
         self._eval_fn = jax.jit(eval_step, static_argnums=(4,))
 
     # -- public API --------------------------------------------------------
+    def stage_batch(self, *batch_arrays):
+        """Place one batch (data+labels in ``input_names`` order) onto the
+        mesh ahead of time: sharded device_put, compute-dtype cast, and
+        the multihost global-array conversion — exactly what ``step``
+        would do internally.  Returns the ``{name: device_array}`` dict a
+        :class:`~mxnet_tpu.io.StagedBatch` carries; safe to call from a
+        background thread (dataflow.DevicePrefetchIter), which is how the
+        upload of batch N+1 overlaps the execution of batch N."""
+        return self._shard_batch(batch_arrays)
+
+    def _resolve_batch(self, batch_arrays):
+        """Input dict for one step: a single StagedBatch short-circuits
+        the transfer; raw arrays go through _shard_batch.  An armed
+        poison_grad fault re-stages from the (poisoned) host copies so
+        fault injection keeps working on the prefetched path."""
+        from ..io import StagedBatch
+        from ..resilience import faults
+        if len(batch_arrays) == 1 and isinstance(batch_arrays[0],
+                                                 StagedBatch):
+            b = batch_arrays[0]
+            if faults.is_armed("poison_grad"):
+                arrays = self._poison_batch(
+                    tuple(list(b.data) + list(b.label or [])))
+                return self._shard_batch(arrays)
+            return dict(b.staged)
+        if faults.is_armed("poison_grad"):
+            batch_arrays = self._poison_batch(batch_arrays)
+        return self._shard_batch(batch_arrays)
+
     def _shard_batch(self, arrays):
         out = {}
         for name, v in zip(self.input_names, arrays):
@@ -519,36 +606,57 @@ class SPMDTrainer(object):
                 o, self.mesh, spec))
         return local
 
+    def _scalar_acc(self, value, dtype):
+        """One replicated scalar accumulator on the mesh."""
+        return self._place(np.asarray(value, dtype), P())
+
     def step(self, *batch_arrays, key=None):
-        """One fused train step: data+labels in input_names order.
+        """One fused train step: data+labels in input_names order, or a
+        single pre-placed :class:`~mxnet_tpu.io.StagedBatch` (from
+        ``stage_batch``/``DevicePrefetchIter``) that skips the
+        host->device transfer.
 
         ``key`` lets a caller that already previewed this step's outputs
         (module.get_outputs between forward and update) hand in the exact
         key so stochastic layers draw the same masks in both passes."""
         from .. import random as _random
-        from ..resilience import faults
-        if faults.is_armed("poison_grad"):
-            batch_arrays = self._poison_batch(batch_arrays)
-        # consume the PREVIOUS step's finite flag before dispatching this
-        # one: a one-deep pipeline (the device runs step N while the host
-        # preps N+1) instead of a per-step host sync
-        self.flush_step_guard()
-        data = self._shard_batch(batch_arrays)
+        # consume the PREVIOUS steps' guard counters before dispatching
+        # this one: a one-deep pipeline by default (the device runs step N
+        # while the host preps N+1); with flush_interval > 1 (deferred
+        # metrics) the read happens only every that-many steps
+        self._steps_since_flush += 1
+        if self._steps_since_flush >= max(1, self.flush_interval):
+            self.flush_step_guard()
+        data = self._resolve_batch(batch_arrays)
         self._num_update += 1
         lr = self.optimizer.lr if self.optimizer.lr_scheduler is None else \
             self.optimizer.lr_scheduler(self._num_update)
         if key is None:
             key = _random.next_key()
-        res = self._step_fn(
-            self.params, self.aux, self.opt_state, data, key,
-            jnp.asarray(lr, jnp.float32), jnp.asarray(self.optimizer.wd,
-                                                      jnp.float32),
-            self._num_update)
+        extras = {}
         if self.step_guard:
-            self.params, self.aux, self.opt_state, outs, flag = res
-            self._pending_flag = flag
-        else:
-            self.params, self.aux, self.opt_state, outs = res
+            if self._guard_acc is None:
+                self._guard_acc = (self._scalar_acc(0, np.int32),
+                                   self._scalar_acc(0, np.int32),
+                                   self._scalar_acc(0, np.int32))
+                self._trips_seen = 0
+            extras["guard"] = self._guard_acc
+        if self._metric_fn is not None:
+            if self._metric_acc is None:
+                self._metric_acc = (self._scalar_acc(0.0, np.float32),
+                                    self._scalar_acc(0.0, np.float32))
+            extras["metric"] = self._metric_acc
+        self.params, self.aux, self.opt_state, extras, outs = \
+            self._step_fn(
+                self.params, self.aux, self.opt_state, extras, data, key,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(self.optimizer.wd, jnp.float32),
+                self._num_update)
+        if self.step_guard:
+            self._guard_acc = extras["guard"]
+            self._guard_pending = True
+        if self._metric_fn is not None:
+            self._metric_acc = extras["metric"]
         outs = self._localize(outs)
         self._outputs = outs
         return outs
@@ -580,45 +688,119 @@ class SPMDTrainer(object):
         self.flush_step_guard()
         return self._consecutive_bad_steps
 
-    def flush_step_guard(self):
-        """Account any not-yet-read finite flag (blocks until that step's
-        program finished).  Called automatically at the next step(), at
-        get_params/get_states, and by the counter properties; raises the
-        consecutive-bad-steps abort if the flushed flag crosses the
-        limit."""
-        flag, self._pending_flag = self._pending_flag, None
-        if flag is None:
-            return
+    def _read_scalar(self, v):
+        """Host value of one replicated device scalar."""
         if self._multiproc:
-            good = bool(np.asarray(flag.addressable_shards[0].data))
-        else:
-            good = bool(flag)
-        self.last_step_skipped = not good
-        if good:
-            self._consecutive_bad_steps = 0
+            return np.asarray(v.addressable_shards[0].data)
+        return np.asarray(v)
+
+    def flush_step_guard(self):
+        """Fold the in-graph skip counters into host state (blocks until
+        the last dispatched step's program finished).  Called
+        automatically at step() entry every ``flush_interval`` steps, at
+        get_params/get_states, and by the counter properties — so counter
+        reads are always exact; between reads the host may lag the device
+        by at most ``flush_interval`` steps (deferred-metric mode).
+        Raises the consecutive-bad-steps abort when the flushed run
+        crosses the limit."""
+        self._steps_since_flush = 0
+        if not self._guard_pending:
             return
-        # the program applied no update — roll the update counter back so
-        # lr schedules and adam bias correction see only applied steps
-        # (one step late under the pipelined read; self-corrects here)
-        self._num_update -= 1
-        self._skipped_steps += 1
-        self._consecutive_bad_steps += 1
-        import logging
-        logging.getLogger(__name__).warning(
-            "step guard: non-finite gradients — update skipped "
-            "(%d consecutive, %d total)", self._consecutive_bad_steps,
-            self._skipped_steps)
-        if self.max_consecutive_bad_steps > 0 and \
-                self._consecutive_bad_steps >= self.max_consecutive_bad_steps:
+        self._guard_pending = False
+        total = int(self._read_scalar(self._guard_acc[0])) + self._skip_base
+        consec = int(self._read_scalar(self._guard_acc[1]))
+        trips = int(self._read_scalar(self._guard_acc[2]))
+        delta = total - self._skipped_steps
+        self.last_step_skipped = consec > 0
+        self._consecutive_bad_steps = consec
+        if delta > 0:
+            # those programs applied no update — roll the update counter
+            # back so lr schedules and adam bias correction see only
+            # applied steps (late by at most flush_interval steps under
+            # the pipelined read; self-corrects here)
+            self._num_update -= delta
+            self._skipped_steps = total
+            import logging
+            logging.getLogger(__name__).warning(
+                "step guard: non-finite gradients — %d update(s) skipped "
+                "(%d consecutive, %d total)", delta,
+                self._consecutive_bad_steps, self._skipped_steps)
+        if trips > self._trips_seen and self.max_consecutive_bad_steps > 0:
+            # a bad run reached the threshold since the last flush (the
+            # in-graph trip counter latches runs whose peak fell between
+            # deferred flushes); raise once per such run
+            self._trips_seen = trips
             raise MXNetError(
                 "step guard: %d consecutive steps produced non-finite "
                 "gradients — model has diverged (raise MXTPU_MAX_BAD_STEPS "
                 "or set MXTPU_STEP_GUARD=0 to disable the guard)"
-                % self._consecutive_bad_steps)
+                % self.max_consecutive_bad_steps)
+
+    # -- deferred in-graph metrics ----------------------------------------
+    def install_metric(self, graph_fn, flush_interval=0, key=None):
+        """Fold a metric's (sum, count) accumulation INTO the fused step.
+
+        ``graph_fn(outs, data) -> (sum, count)`` is a jax-traceable rule
+        (see ``EvalMetric.graph_update``); the step then carries donated
+        f32 accumulators and ``EvalMetric.update`` never needs a per-step
+        device->host sync — the host fetches the running totals with
+        :meth:`fetch_metric` every MXTPU_METRIC_INTERVAL steps / at epoch
+        end.  Guard-skipped steps contribute nothing (exact parity with
+        the blocking path, which drops them too).
+
+        Installing (or removing with ``graph_fn=None``) rebuilds the step
+        function — free before the first step, one recompile after;
+        ``key`` identifies an equivalent rule (same metric type/labels/
+        interval) so re-installing it — a second fit() with the same
+        metric — skips the rebuild and keeps the compiled step.  The
+        guard's ``flush_interval`` is raised alongside so the skip-counter
+        read stops forcing a per-step sync (staleness is bounded by the
+        same interval)."""
+        if graph_fn is None and self._metric_fn is None:
+            return  # nothing installed, nothing to remove
+        if graph_fn is not None and key is not None and \
+                key == self._metric_key:
+            # same rule re-installed: keep the compiled step, just start
+            # a fresh accumulation window
+            self._metric_acc = None
+            return
+        self._metric_fn = graph_fn
+        self._metric_key = key if graph_fn is not None else None
+        self._metric_acc = None
+        if graph_fn is not None:
+            self.flush_interval = int(flush_interval) if flush_interval \
+                and int(flush_interval) > 0 else DEFAULT_GUARD_FLUSH_INTERVAL
+        else:
+            self.flush_interval = 1
+        self._build_step()
+
+    def fetch_metric(self):
+        """(sum, count) accumulated in-graph since the last fetch (a
+        device->host read of two scalars; blocks on the last step), then
+        re-zeroed — bounded windows keep f32 exact for integer sums."""
+        if self._metric_acc is None:
+            return 0.0, 0.0
+        s = float(self._read_scalar(self._metric_acc[0]))
+        c = float(self._read_scalar(self._metric_acc[1]))
+        self._metric_acc = None  # fresh zeros at the next step
+        return s, c
+
+    def reset_metric(self):
+        """Zero the in-graph accumulators (epoch start)."""
+        self._metric_acc = None
+
+    def _eval_batch(self, batch_arrays):
+        """Eval-path input dict: accepts a StagedBatch (no poison-fault
+        re-staging — fault consumption belongs to train steps only)."""
+        from ..io import StagedBatch
+        if len(batch_arrays) == 1 and isinstance(batch_arrays[0],
+                                                 StagedBatch):
+            return dict(batch_arrays[0].staged)
+        return self._shard_batch(batch_arrays)
 
     def eval_step(self, *batch_arrays):
         from .. import random as _random
-        data = self._shard_batch(batch_arrays)
+        data = self._eval_batch(batch_arrays)
         return self._localize(
             self._eval_fn(self.params, self.aux, data, _random.next_key()))
 
@@ -629,7 +811,7 @@ class SPMDTrainer(object):
         identical masks; with no key, a peeked key is used (training stream
         not advanced, but masks differ from the eventual step)."""
         from .. import random as _random
-        data = self._shard_batch(batch_arrays)
+        data = self._eval_batch(batch_arrays)
         if key is None:
             key = _random.peek_key()
         return self._localize(
@@ -708,10 +890,13 @@ class SPMDTrainer(object):
 
     def set_states(self, blob):
         # restored state opens a fresh guard window: drop any pre-restore
-        # flag (its skip accounting belongs to the discarded run) and the
-        # consecutive-bad count, so a recovery attempt after an abort gets
-        # the full MXTPU_MAX_BAD_STEPS budget again
-        self._pending_flag = None
+        # counters (their skip accounting belongs to the discarded run)
+        # and the consecutive-bad count, so a recovery attempt after an
+        # abort gets the full MXTPU_MAX_BAD_STEPS budget again; the
+        # lifetime skip total survives via the host base
+        self._guard_pending = False
+        self._guard_acc = None
+        self._skip_base = self._skipped_steps
         self._consecutive_bad_steps = 0
         import pickle
         payload = pickle.loads(blob)
@@ -781,9 +966,10 @@ class SPMDTrainer(object):
                         pass
 
         for attr in ("params", "aux", "opt_state", "_outputs",
-                     "_pending_flag"):
+                     "_guard_acc", "_metric_acc"):
             _delete_tree(getattr(self, attr, None))
             setattr(self, attr, None)
+        self._guard_pending = False
         # drop the jitted callables (each owns its executable + caches)
         for attr in ("_step_fn", "_eval_fn", "_rep_fn"):
             fn = getattr(self, attr, None)

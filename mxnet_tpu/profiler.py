@@ -19,7 +19,58 @@ import os
 from .base import MXNetError
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "dumps", "get_op_stats", "State", "Mode"]
+           "dumps", "get_op_stats", "State", "Mode", "StepTraceCapture",
+           "ENV_PROFILE_DIR"]
+
+#: when set, fit() captures a jax.profiler trace of steps 10-15 of the
+#: first epoch into this directory (viewable in TensorBoard/Perfetto)
+ENV_PROFILE_DIR = "MXTPU_PROFILE_DIR"
+
+
+class StepTraceCapture(object):
+    """Window-bounded ``jax.profiler`` trace for a training loop.
+
+    Captures steps ``[start_step, stop_step]`` (default 10-15) of the
+    epoch it is driven through: the caller invokes :meth:`on_batch` with
+    the 0-based batch index before each step and :meth:`stop` at epoch
+    end (closing a window the epoch cut short).  A steady-state window —
+    not step 0 — so the trace shows the pipeline, not compilation."""
+
+    def __init__(self, directory, start_step=10, stop_step=15):
+        self.directory = os.fspath(directory)
+        self.start_step = int(start_step)
+        self.stop_step = int(stop_step)
+        self._active = False
+        self._done = False
+
+    @classmethod
+    def from_env(cls):
+        """A capture configured from MXTPU_PROFILE_DIR, or None."""
+        directory = os.environ.get(ENV_PROFILE_DIR)
+        return cls(directory) if directory else None
+
+    def on_batch(self, nbatch):
+        if self._done:
+            return
+        if not self._active and nbatch >= self.start_step:
+            import jax
+            os.makedirs(self.directory, exist_ok=True)
+            jax.profiler.start_trace(self.directory)
+            self._active = True
+        elif self._active and nbatch > self.stop_step:
+            self.stop()
+
+    def stop(self):
+        if not self._active:
+            return
+        import jax
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        import logging
+        logging.getLogger(__name__).info(
+            "StepTraceCapture: wrote steps %d-%d trace to %s",
+            self.start_step, self.stop_step, self.directory)
 
 
 class Mode(object):

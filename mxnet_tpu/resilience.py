@@ -29,7 +29,8 @@ from contextlib import contextmanager
 
 from .base import MXNetError
 
-__all__ = ["atomic_write", "atomic_path", "retry", "CheckpointManager",
+__all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
+           "CheckpointManager",
            "TransientError", "FaultInjector", "faults",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
            "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
@@ -220,6 +221,33 @@ def retry(fn, attempts=3, backoff=0.1, max_backoff=30.0, timeout=None,
             delay = min(delay * 2.0, float(max_backoff))
     raise MXNetError("retry[%s]: all %d attempts failed (last: %s: %s)"
                      % (name, attempts, type(last).__name__, last)) from last
+
+
+def retrying_next(data_iter, name="next"):
+    """Pull ``data_iter.next()`` once, retrying transient source errors
+    (flaky network storage, an injected ``iter_next`` fault) with backoff;
+    StopIteration and real bugs pass straight through.  The shared fetch
+    discipline of every background prefetcher (io.PrefetchingIter,
+    dataflow.DevicePrefetchIter).  Tunables: MXTPU_DATA_RETRIES /
+    MXTPU_DATA_RETRY_BACKOFF.
+
+    CONTRACT: a retried source must not have advanced its cursor on the
+    failed call (true of read-then-decode iterators, where the fetch fails
+    before the position moves).  A source that consumes the record before
+    failing would resume one record later — set MXTPU_DATA_RETRIES=1 for
+    such sources and handle the surfaced error with ``reset()``."""
+    from .base import get_env
+
+    def _one():
+        faults.maybe_fail("iter_next")
+        return data_iter.next()
+
+    return retry(
+        _one,
+        attempts=int(get_env(ENV_DATA_RETRIES, "3")),
+        backoff=float(get_env(ENV_DATA_BACKOFF, "0.05")),
+        retry_on=(IOError, OSError, TransientError),
+        name=name)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,308 @@
+"""DevicePrefetchIter + deferred-metric pipeline tests: staged batches
+are byte-identical and ordered vs the source (including through the
+transient-error retry ladder), shutdown is clean mid-epoch, and deferred
+in-graph metrics match the blocking host path exactly — including across
+a guard-skipped poisoned step."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.dataflow import DevicePrefetchIter
+from mxnet_tpu.parallel import SPMDTrainer
+
+
+def make_blobs(n, d, c, seed=0):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+
+def mlp_sym(num_classes=3, nh=16):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _trainer(batch=16, d=8, classes=3, seed=7):
+    tr = SPMDTrainer(mlp_sym(classes), "sgd",
+                     {"learning_rate": 0.1, "rescale_grad": 1.0 / batch})
+    tr.bind([("data", (batch, d))], [("softmax_label", (batch,))])
+    mx.random.seed(seed)
+    tr.init_params(mx.initializer.Xavier())
+    return tr
+
+
+def _epoch_batches(it):
+    out = []
+    for b in it:
+        out.append(([np.array(a.asnumpy()) for a in b.data],
+                    [np.array(a.asnumpy()) for a in (b.label or [])],
+                    b.pad))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ordering / byte-identity
+# ---------------------------------------------------------------------------
+
+def test_prefetch_yields_identical_batches_in_order():
+    X, y = make_blobs(96, 8, 3)
+    ref = _epoch_batches(mx.io.NDArrayIter(X, y, batch_size=16))
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16), depth=2)
+    got = _epoch_batches(pf)
+    assert len(got) == len(ref) == 6
+    for (rd, rl, rp), (gd, gl, gp) in zip(ref, got):
+        assert rp == gp
+        for a, b in zip(rd + rl, gd + gl):
+            assert a.tobytes() == b.tobytes()
+    # a second epoch after reset() is identical again
+    pf.reset()
+    got2 = _epoch_batches(pf)
+    assert len(got2) == 6
+    pf.close()
+
+
+def test_prefetch_staged_arrays_match_host_bytes():
+    X, y = make_blobs(64, 8, 3)
+    tr = _trainer()
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16),
+                            stage=tr, depth=2)
+    n = 0
+    for b in pf:
+        assert isinstance(b, mx.io.StagedBatch)
+        assert set(b.staged) == {"data", "softmax_label"}
+        np.testing.assert_array_equal(np.asarray(b.staged["data"]),
+                                      b.data[0].asnumpy())
+        np.testing.assert_array_equal(np.asarray(b.staged["softmax_label"]),
+                                      b.label[0].asnumpy())
+        tr.step(b)  # and the trainer consumes it whole
+        n += 1
+    assert n == 4
+    pf.close()
+    tr.close()
+
+
+def test_depth0_stages_synchronously():
+    X, y = make_blobs(48, 8, 3)
+    tr = _trainer()
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16),
+                            stage=tr, depth=0)
+    assert pf._thread is None
+    batches = list(pf)
+    assert len(batches) == 3
+    assert all(isinstance(b, mx.io.StagedBatch) for b in batches)
+    tr.close()
+
+
+# ---------------------------------------------------------------------------
+# resilience interaction
+# ---------------------------------------------------------------------------
+
+def test_prefetch_retries_transient_error(clean_faults):
+    """Two injected iter_next faults are absorbed by the default
+    MXTPU_DATA_RETRIES=3 ladder — the epoch comes out complete, ordered
+    and byte-identical."""
+    X, y = make_blobs(96, 8, 3)
+    ref = _epoch_batches(mx.io.NDArrayIter(X, y, batch_size=16))
+    clean_faults.arm("iter_next", times=2)
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16), depth=2)
+    got = _epoch_batches(pf)
+    assert len(got) == len(ref)
+    for (rd, rl, _), (gd, gl, _) in zip(ref, got):
+        for a, b in zip(rd + rl, gd + gl):
+            assert a.tobytes() == b.tobytes()
+    pf.close()
+
+
+def test_prefetch_surfaces_exhausted_retries_then_reset_recovers(
+        monkeypatch, clean_faults):
+    from mxnet_tpu.resilience import ENV_DATA_RETRIES, ENV_DATA_BACKOFF
+    monkeypatch.setenv(ENV_DATA_RETRIES, "1")
+    monkeypatch.setenv(ENV_DATA_BACKOFF, "0.001")
+    X, y = make_blobs(48, 8, 3)
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16), depth=2)
+    clean_faults.arm("iter_next", times=1)
+    with pytest.raises(MXNetError, match="attempts failed"):
+        _epoch_batches(pf)
+    # realign: reset restarts the worker and replays a full clean epoch
+    pf.reset()
+    assert len(_epoch_batches(pf)) == 3
+    pf.close()
+
+
+def test_prefetch_clean_shutdown_mid_epoch():
+    X, y = make_blobs(320, 8, 2)
+    pf = DevicePrefetchIter(mx.io.NDArrayIter(X, y, batch_size=16), depth=2)
+    pf.next()
+    pf.next()
+    worker = pf._thread
+    assert worker is not None and worker.is_alive()
+    pf.close()
+    worker.join(timeout=5.0)
+    assert not worker.is_alive()
+    with pytest.raises(StopIteration):
+        pf.next()
+    # and close() twice is safe
+    pf.close()
+    # no stray live workers from this iterator remain registered
+    assert all(t is not worker for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# deferred metrics
+# ---------------------------------------------------------------------------
+
+def _fused_module(seed=21, batch=16, d=8, classes=3):
+    mod = mx.mod.Module(mlp_sym(classes))
+    mod.bind(data_shapes=[("data", (batch, d))],
+             label_shapes=[("softmax_label", (batch,))])
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None
+    return mod
+
+
+def _run_50_steps(mod, metric, X, y, poison_at, clean_faults):
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    for i, batch in enumerate(it):
+        if i == poison_at:
+            clean_faults.arm("poison_grad")
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+
+def test_deferred_metric_matches_blocking_exactly(monkeypatch, clean_faults):
+    """50 train steps with a poisoned (guard-skipped) step in the middle:
+    the in-graph deferred accumulators equal the blocking host path
+    bit-for-bit — same integer sums, same instance counts, same skip
+    accounting."""
+    from mxnet_tpu.metric import ENV_METRIC_INTERVAL
+    X, y = make_blobs(800, 8, 3)  # 50 batches of 16
+
+    # blocking reference: classic per-step host update (no install)
+    mod_b = _fused_module()
+    acc_b = mx.metric.Accuracy()
+    _run_50_steps(mod_b, acc_b, X, y, 25, clean_faults)
+    assert mod_b.skipped_update_count == 1
+
+    # deferred: in-graph accumulation, folded every 7 steps + on get()
+    monkeypatch.setenv(ENV_METRIC_INTERVAL, "7")
+    mod_d = _fused_module()
+    acc_d = mx.metric.Accuracy()
+    mod_d._install_deferred_metric(acc_d)
+    assert mod_d._deferred_metric is acc_d
+    _run_50_steps(mod_d, acc_d, X, y, 25, clean_faults)
+    assert mod_d.skipped_update_count == 1
+
+    name_b, val_b = acc_b.get()
+    name_d, val_d = acc_d.get()
+    assert name_b == name_d
+    assert val_d == val_b  # bit-identical, not approximately equal
+    assert acc_d.num_inst == acc_b.num_inst == 49 * 16
+    assert float(acc_d.sum_metric) == float(acc_b.sum_metric)
+
+
+def test_metric_reset_clears_device_accumulators(clean_faults):
+    mod = _fused_module()
+    acc = mx.metric.Accuracy()
+    mod._install_deferred_metric(acc)
+    X, y = make_blobs(64, 8, 3)
+    _run_50_steps(mod, acc, X, y, poison_at=-1, clean_faults=clean_faults)
+    acc.get()  # any read folds the device-side totals in
+    assert acc.num_inst == 64
+    acc.reset()
+    assert acc.num_inst == 0
+    # a fresh epoch counts only its own batches
+    _run_50_steps(mod, acc, X, y, poison_at=-1, clean_faults=clean_faults)
+    acc.get()
+    assert acc.num_inst == 64
+
+
+def test_fit_with_prefetch_and_deferred_metrics_converges():
+    """End-to-end: fit() fed by DevicePrefetchIter staged batches, with
+    the train metric accumulated in-graph (installed by fit itself)."""
+    X, y = make_blobs(480, 10, 3)
+    mod = mx.mod.Module(mlp_sym(nh=32))
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(101)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+    pf = DevicePrefetchIter(it, stage=mod, depth=2)
+    mod.fit(pf, num_epoch=5, kvstore="tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    assert mod._deferred_metric is not None, \
+        "fit did not install the deferred metric on the fused path"
+    pf.close()
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_deferred_guard_abort_survives_flush_boundary(clean_faults):
+    """A bad run that reaches MXTPU_MAX_BAD_STEPS and ENDS between two
+    deferred flushes must still abort at the next flush: the in-graph
+    trip counter latches the event even though the consecutive counter
+    has already reset on the good steps that followed."""
+    tr = _trainer()
+    tr.max_consecutive_bad_steps = 2
+    acc = mx.metric.Accuracy()
+    from mxnet_tpu.metric import try_install_deferred
+    assert try_install_deferred(tr, acc) is not None
+    assert tr.flush_interval > 10  # deferred cadence, not per-step
+    X, y = make_blobs(16, 8, 3)
+    clean_faults.arm("poison_grad", times=2)
+    tr.step(X, y)  # bad 1
+    tr.step(X, y)  # bad 2 — run reaches the limit...
+    tr.step(X, y)  # ...and a clean step resets the consecutive counter
+    with pytest.raises(MXNetError, match="consecutive"):
+        tr.flush_step_guard()
+    assert tr._skipped_steps == 2
+    # the abort is raised once per tripping run, not forever after
+    tr.step(X, y)
+    tr.flush_step_guard()
+    tr.close()
+
+
+def test_blocking_env_disables_deferred(monkeypatch):
+    from mxnet_tpu.metric import ENV_METRIC_BLOCKING
+    monkeypatch.setenv(ENV_METRIC_BLOCKING, "1")
+    mod = _fused_module()
+    acc = mx.metric.Accuracy()
+    mod._install_deferred_metric(acc)
+    assert mod._deferred_metric is None
+    assert mod._fused._metric_fn is None
+
+
+# ---------------------------------------------------------------------------
+# profiler trace capture
+# ---------------------------------------------------------------------------
+
+def test_profile_dir_trace_captured(monkeypatch, tmp_path):
+    """MXTPU_PROFILE_DIR: fit() captures a jax.profiler trace of steps
+    10-15 of the first epoch (smoke: the trace directory materializes
+    with profiler output under JAX_PLATFORMS=cpu)."""
+    from mxnet_tpu.profiler import ENV_PROFILE_DIR
+    trace_dir = tmp_path / "trace"
+    monkeypatch.setenv(ENV_PROFILE_DIR, str(trace_dir))
+    X, y = make_blobs(288, 8, 3)  # 18 batches of 16 > stop_step
+    mod = mx.mod.Module(mlp_sym())
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    assert os.path.isdir(str(trace_dir))
+    assert os.listdir(str(trace_dir)), "profiler wrote nothing"

@@ -117,8 +117,12 @@ def test_spmd_trainer_zero_collectives_in_hlo():
     from mxnet_tpu import random as _random
     X, y = make_blobs(64, 10, 4)
     data = trainer._shard_batch((X, y))
+    import numpy as _np
+    extras = {"guard": (trainer._scalar_acc(0, _np.int32),
+                        trainer._scalar_acc(0, _np.int32),
+                        trainer._scalar_acc(0, _np.int32))}
     lowered = trainer._step_fn.lower(
-        trainer.params, trainer.aux, trainer.opt_state, data,
+        trainer.params, trainer.aux, trainer.opt_state, extras, data,
         _random.peek_key(), jnp.asarray(0.3, jnp.float32),
         jnp.asarray(0.0, jnp.float32), 1)
     hlo = lowered.compile().as_text()

@@ -81,7 +81,9 @@ def lower_tpu(batch=64, side=224):
             "softmax_label": sds((batch,), jnp.bfloat16, P("dp"))}
     rng = jax.ShapeDtypeStruct((2,), np.uint32)
     scalar = jax.ShapeDtypeStruct((), np.float32)
-    lowered = tr._step_fn.lower(params, aux, opt_state, data, rng,
+    counter = sds((), np.int32, P())
+    extras = {"guard": (counter, counter, counter)}
+    lowered = tr._step_fn.lower(params, aux, opt_state, extras, data, rng,
                                 scalar, scalar, 1)
     return lowered.compile().as_text(), "tpu-aot v5e:2x4"
 
@@ -101,8 +103,11 @@ def lower_cpu(batch=8, side=64):
     X = np.random.RandomState(0).rand(batch, 3, side, side).astype("f")
     y = np.random.RandomState(1).randint(0, 100, batch).astype("f")
     data = tr._shard_batch((X, y))
+    extras = {"guard": (tr._scalar_acc(0, np.int32),
+                        tr._scalar_acc(0, np.int32),
+                        tr._scalar_acc(0, np.int32))}
     lowered = tr._step_fn.lower(
-        tr.params, tr.aux, tr.opt_state, data, _random.peek_key(),
+        tr.params, tr.aux, tr.opt_state, extras, data, _random.peek_key(),
         jnp.asarray(0.1, jnp.float32), jnp.asarray(0.0, jnp.float32), 1)
     return lowered.compile().as_text(), "cpu virtual 8-mesh"
 
