@@ -577,6 +577,102 @@ def _compile_probe():
     return {"compile_bringup_s": round(bringup, 3)}
 
 
+def _resume_bench(steps=60, batch=64):
+    """resume_overhead: the wall-clock price of surviving a preemption —
+    mid-run checkpoint save + fresh-trainer restore + refit of the
+    remaining steps to parity — against an uninterrupted run of the same
+    total step budget (CPU backend: this measures the framework's
+    save/restore/recompile machinery, not the chip).  The refit finishes
+    BIT-identical to the baseline (asserted), so "refit-to-parity" is
+    exactly the second half's steps; the overhead is save + restore +
+    the relaunch recompile (the part MXTPU_COMPILE_CACHE amortizes)."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer
+    from mxnet_tpu.resilience import CheckpointManager
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(batch, 64).astype("f")
+    y = rs.randint(0, 10, batch).astype("f")
+
+    def make():
+        t = SPMDTrainer(net, "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9,
+                         "rescale_grad": 1.0 / batch}, mesh=None)
+        t.bind([("data", (batch, 64))], [("softmax_label", (batch,))])
+        mx.random.seed(11)
+        t.init_params(mx.initializer.Xavier())
+        return t
+
+    def run(t, n):
+        for _ in range(n):
+            t.step(X, y)
+        t.flush_step_guard()
+
+    # uninterrupted baseline (includes its one compile, like any run)
+    base = make()
+    tic = time.perf_counter()
+    run(base, steps)
+    baseline_s = time.perf_counter() - tic
+    base_params, _ = base.get_params()
+    base.close()
+
+    half = steps // 2
+    tmp = tempfile.mkdtemp(prefix="bench_resume_")
+    try:
+        man = CheckpointManager(tmp)
+        a = make()
+        run(a, half)
+        tic = time.perf_counter()
+        a.save_checkpoint(man, half)
+        save_s = time.perf_counter() - tic
+        a.close()
+
+        # the relaunch: a FRESH trainer (new process in real life —
+        # restore + recompile both count)
+        b = make()
+        tic = time.perf_counter()
+        b.restore(man)
+        restore_s = time.perf_counter() - tic
+        tic = time.perf_counter()
+        run(b, steps - half)
+        refit_s = time.perf_counter() - tic
+        res_params, _ = b.get_params()
+        b.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    parity = all(
+        np.array_equal(base_params[k].asnumpy(), res_params[k].asnumpy())
+        for k in base_params)
+    total = save_s + restore_s + refit_s
+    out = {
+        "resume_save_s": round(save_s, 4),
+        "resume_restore_s": round(restore_s, 4),
+        "resume_refit_s": round(refit_s, 4),
+        "resume_baseline_s": round(baseline_s, 4),
+        # the preempted run re-trains NO steps (bit-identical resume), so
+        # its extra cost over the uninterrupted run is save + restore +
+        # the second compile hiding inside refit's first step
+        "resume_overhead_s": round(total + baseline_s * half / steps
+                                   - baseline_s, 4),
+        "resume_parity": parity,
+    }
+    if not parity:
+        out["resume_parity_note"] = ("restored run diverged from the "
+                                     "uninterrupted baseline — resume is "
+                                     "broken, numbers above are invalid")
+    return out
+
+
 def _lstm_bench(batch, seq_len, steps, warmup, trials):
     """2-layer LSTM LM (lstm_bucketing workload, one bucket) tokens/sec."""
     import jax
@@ -630,7 +726,8 @@ def _run_mode(mode):
     trials = _env_int("BENCH_TRIALS", 2)
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
-    if mode in ("decode", "fed-cpu", "pipeline", "compile-probe"):
+    if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
+                "resume"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -644,6 +741,8 @@ def _run_mode(mode):
         out.update(_pipeline_bench())
     elif mode == "compile-probe":
         out.update(_compile_probe())
+    elif mode == "resume":
+        out.update(_resume_bench())
     elif mode == "fed":
         out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
         out["fed_roofline"] = _roofline(out["fed"],
@@ -749,6 +848,7 @@ def main():
             parts["compile_cold_s"] = cold["compile_bringup_s"]
         if "compile_bringup_s" in warm:
             parts["compile_warm_s"] = warm["compile_bringup_s"]
+        parts.update(_collect("resume"))
         parts.update(_collect("fed"))
     parts.update(_collect("compute"))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
@@ -796,7 +896,10 @@ def main():
               "pipeline_steps_s_depth0", "pipeline_steps_s_depth2",
               "pipeline_speedup", "pipeline_step_ms",
               "pipeline_iter_delay_ms",
-              "compile_cold_s", "compile_warm_s"):
+              "compile_cold_s", "compile_warm_s",
+              "resume_save_s", "resume_restore_s", "resume_refit_s",
+              "resume_baseline_s", "resume_overhead_s", "resume_parity",
+              "resume_parity_note"):
         if k in parts:
             result[k] = parts[k]
     if compute is not None:

@@ -13,7 +13,7 @@ __version__ = "0.1.0"
 
 from .base import MXNetError
 from . import resilience
-from .resilience import CheckpointManager
+from .resilience import CheckpointManager, PreemptionHandler, StepWatchdog
 
 # Persistent XLA compilation cache: MXTPU_COMPILE_CACHE=<dir> makes every
 # relaunch reuse compiled programs from disk instead of recompiling the

@@ -7,7 +7,56 @@ import sys
 import time
 
 __all__ = ["Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
-           "module_checkpoint"]
+           "module_checkpoint", "PreemptionCheckpoint"]
+
+
+class PreemptionCheckpoint(object):
+    """Batch-end callback giving CUSTOM training loops the graceful-
+    preemption exit ``fit(preemption_safe=True)`` has built in: installs
+    a :class:`~mxnet_tpu.resilience.PreemptionHandler`, and at the first
+    batch boundary after SIGTERM/SIGINT saves a mid-epoch checkpoint
+    (step + RNG state in the manifest) through ``manager`` and exits
+    with ``resilience.PREEMPT_EXIT_CODE`` for a supervisor to relaunch.
+
+    Use it as a context manager (or call :meth:`close`) so the signal
+    handlers are restored when the loop finishes WITHOUT a preemption —
+    leaked handlers would swallow the process's next Ctrl-C::
+
+        man = mx.CheckpointManager("ckpt/")
+        with mx.callback.PreemptionCheckpoint(mod, man) as cb:
+            for epoch in ...:
+                for nbatch, batch in enumerate(train_iter):
+                    mod.forward_backward(batch); mod.update()
+                    cb(mx.model.BatchEndParam(epoch, nbatch, metric,
+                                              locals()))
+    """
+
+    def __init__(self, mod, manager, handler=None):
+        from .resilience import PreemptionHandler
+        self.mod = mod
+        self.manager = manager
+        self.handler = handler or PreemptionHandler()
+        self.handler.install()
+
+    def __call__(self, param):
+        if not self.handler.triggered:
+            return
+        from .resilience import preempted_exit
+        self.mod._save_preemption_checkpoint(self.manager, param.epoch,
+                                             param.nbatch + 1)
+        self.handler.uninstall()
+        preempted_exit()
+
+    def close(self):
+        """Restore the original signal handlers (idempotent)."""
+        self.handler.uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+        return False
 
 
 def do_checkpoint(prefix, period=1):

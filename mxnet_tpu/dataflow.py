@@ -141,6 +141,14 @@ class DevicePrefetchIter(DataIter):
                 continue
 
     def _stage_one(self, batch):
+        # deterministic fault points for the staging path: "stage_batch"
+        # raises (surfaced to the consumer like a failed device_put),
+        # "hang_stage" stalls the worker — the consumer then blocks in
+        # next() exactly like a wedged host->device transfer, which is
+        # what the fit() watchdog window is armed to catch
+        from .resilience import faults
+        faults.maybe_hang("hang_stage")
+        faults.maybe_fail("stage_batch")
         if self._stage is None:
             return batch
         arrays = list(batch.data) + list(batch.label or [])

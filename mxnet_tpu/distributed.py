@@ -32,7 +32,7 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["initialize", "is_initialized", "rank", "num_workers",
-           "Collective", "barrier"]
+           "Collective", "barrier", "agree_flag"]
 
 _INITIALIZED = False
 
@@ -159,6 +159,24 @@ def barrier(tag="mxtpu_barrier"):
         multihost_utils.sync_global_devices(tag)
 
 
+def agree_flag(flag):
+    """Cross-process OR of a local boolean — the preemption-consensus
+    primitive.  The scheduler's SIGTERM lands on different ranks at
+    different instants; if each rank consumed its own flag, one rank
+    would enter the (collective) checkpoint gather while another entered
+    the next step's allreduce and the job would deadlock inside its
+    grace window.  Agreeing at every step boundary makes all ranks take
+    the same branch at the same boundary: any rank signaled => every
+    rank checkpoints.  Single-process returns the flag unchanged; the
+    multi-process cost is one scalar allgather per call."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(flag)
+    from jax.experimental import multihost_utils
+    total = multihost_utils.process_allgather(np.int32(bool(flag)))
+    return bool(np.asarray(total).sum() > 0)
+
+
 class Collective:
     """Jitted cross-process collectives over a 1-axis global device mesh.
 
@@ -200,8 +218,22 @@ class Collective:
         """The replicated result's addressable copy on this process."""
         return out.addressable_shards[0].data
 
+    @staticmethod
+    def _fault_point():
+        """Deterministic fault points shared by every collective entry:
+        "collective" raises (a peer dropped: the all-or-nothing failure
+        every rank sees), "hang_collective" stalls the caller (a wedged
+        reduction — the hung-step watchdog's production target, made
+        reproducible on the CPU tier)."""
+        from .resilience import faults
+        faults.maybe_hang("hang_collective")
+        faults.maybe_fail(
+            "collective", "injected collective failure (a peer is gone; "
+            "relaunch and resume)")
+
     def allreduce_sum(self, x):
         """Sum a same-shaped array across all worker processes."""
+        self._fault_point()
         if self.num_workers == 1:
             return x
         return self._local_view(self._sum(self._global(x)))
@@ -213,6 +245,7 @@ class Collective:
         The analog of init-time weight broadcast from worker 0's push
         (``kvstore_dist.h`` Init + pull).
         """
+        self._fault_point()
         if self.num_workers == 1:
             return x
         contrib = x if self.rank == root else np.zeros_like(x)

@@ -135,17 +135,39 @@ class BaseModule(object):
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, checkpoint=None, resume=False):
+            monitor=None, checkpoint=None, resume=False,
+            preemption_safe=None, watchdog=None):
         """The canonical training loop (reference base_module.py:368-520).
 
         ``checkpoint`` (a :class:`~mxnet_tpu.resilience.CheckpointManager`
         or a directory path) turns on managed epoch-end checkpointing:
         params + optimizer state land atomically after every epoch, with
-        retention handled by the manager.  ``resume=True`` restores the
-        newest checkpoint before training — params, optimizer state and
-        epoch — so a preempted run relaunched with the same arguments
-        continues where it stopped (the reference's manual
-        ``--load-epoch`` relaunch, made automatic).
+        retention handled by the manager.  ``resume=True`` (or the
+        ``MXTPU_RESUME=1`` env set by ``tools/supervise.py`` relaunches)
+        restores the newest checkpoint before training — params,
+        optimizer state and epoch — so a preempted run relaunched with
+        the same arguments continues where it stopped.  A MID-EPOCH
+        checkpoint (saved by graceful preemption, below) additionally
+        carries step + RNG state: the resumed run fast-forwards the data
+        iterator past the consumed batches and restores the random
+        stream, making the relaunch bit-identical to the uninterrupted
+        run (the iterator must be deterministic across ``reset()``, which
+        every built-in iterator is).
+
+        ``preemption_safe=True`` (or ``MXTPU_ON_PREEMPT=save``) installs
+        a SIGTERM/SIGINT handler: the signal sets a flag, the next step
+        boundary saves a mid-epoch checkpoint and exits with
+        ``resilience.PREEMPT_EXIT_CODE`` — preemption costs at most one
+        step of work, not an epoch.  Needs ``checkpoint=``.
+
+        ``watchdog`` arms a hung-step monitor around every batch:
+        ``True`` / a :class:`~mxnet_tpu.resilience.StepWatchdog`
+        instance, or None to follow the ``MXTPU_STEP_TIMEOUT`` env
+        (seconds, or ``auto`` to calibrate from the first steps'
+        median).  An overrunning step dumps all thread stacks + device
+        state (stderr and ``MXTPU_DEBUG_DIR``) and aborts with
+        ``resilience.WATCHDOG_EXIT_CODE`` so a supervisor relaunches
+        with resume instead of burning a pod on a wedged collective.
 
         Async pipeline: ``train_data`` may yield
         :class:`~mxnet_tpu.io.StagedBatch` objects (wrap it in
@@ -156,22 +178,49 @@ class BaseModule(object):
         MXTPU_PROFILE_DIR captures a ``jax.profiler`` trace of steps
         10-15 of the first epoch.  See docs/how_to/performance.md."""
         assert num_epoch is not None, "please specify number of epochs"
+        from ..base import get_env
+        from .. import resilience
+        from ..resilience import (CheckpointManager, PreemptionHandler,
+                                  StepWatchdog, faults, preempted_exit)
 
         if checkpoint is not None and not hasattr(checkpoint, "restore"):
-            from ..resilience import CheckpointManager
             checkpoint = CheckpointManager(checkpoint)
+        if not resume and str(get_env(resilience.ENV_RESUME, "0")) == "1":
+            # a supervise.py relaunch: same command line, resume forced
+            resume = checkpoint is not None
         restored_states = None
+        resume_step_state = None
         if resume:
             assert checkpoint is not None, "fit(resume=True) needs checkpoint="
             if checkpoint.latest() is not None:
                 _, arg_restored, aux_restored, restored_states, ck_epoch = \
                     checkpoint.restore()
                 arg_params, aux_params = arg_restored, aux_restored
-                begin_epoch = max(begin_epoch, ck_epoch)
+                entry = checkpoint.entry(ck_epoch) or {}
+                resume_step_state = entry.get("step_state")
+                if resume_step_state is not None:
+                    # partial (preemption) checkpoint: re-enter the
+                    # interrupted epoch, not the one after it
+                    begin_epoch = max(begin_epoch,
+                                      int(resume_step_state["epoch"]))
+                else:
+                    begin_epoch = max(begin_epoch, ck_epoch)
                 force_init = True
                 self.logger.info("fit(resume=True): restored checkpoint "
-                                 "epoch %d from %s", ck_epoch,
+                                 "epoch %d%s from %s", ck_epoch,
+                                 " (mid-epoch, step %d)"
+                                 % resume_step_state["step"]
+                                 if resume_step_state else "",
                                  checkpoint.directory)
+
+        if preemption_safe is None:
+            preemption_safe = checkpoint is not None and str(
+                get_env(resilience.ENV_ON_PREEMPT, "")).lower() in \
+                ("save", "1")
+        if preemption_safe and checkpoint is None:
+            raise MXNetError("fit(preemption_safe=True) needs checkpoint= "
+                             "(there is nowhere to save the mid-epoch "
+                             "state)")
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -205,14 +254,59 @@ class BaseModule(object):
         # the executor path / unsupported metrics / MXTPU_METRIC_BLOCKING)
         self._install_deferred_metric(eval_metric)
 
-        # MXTPU_PROFILE_DIR: capture a jax.profiler trace of steps 10-15
-        # of the first epoch (None when the env is unset).  The finally
-        # below guarantees the profiler is stopped even when the loop
-        # raises mid-window (guard abort, callback error) — a leaked
-        # running trace would crash the next fit()'s start_trace
-        from .. import profiler as _profiler
-        trace = _profiler.StepTraceCapture.from_env()
+        # mid-epoch resume: restore the RNG stream the interrupted run
+        # saved at the preemption boundary (AFTER init — the restore must
+        # win over anything initialization consumed) and remember how many
+        # batches of begin_epoch to fast-forward past
+        fast_forward = 0
+        if resume_step_state is not None:
+            fast_forward = int(resume_step_state.get("step", 0))
+            if resume_step_state.get("rng") is not None:
+                from .. import random as _random
+                _random.set_state(resume_step_state["rng"])
+
+        from contextlib import nullcontext
+
+        # graceful preemption + hung-step watchdog + profiler trace are
+        # all set up INSIDE the try so a failure anywhere in bring-up
+        # still runs the finally — a leaked signal handler would swallow
+        # the process's next Ctrl-C, a leaked monitor thread its memory,
+        # a leaked running trace the next fit()'s start_trace
+        preempt = None
+        wd = None
+        own_watchdog = False
+        fused_trainer = self._deferred_metric_trainer()
+        trace = None
         try:
+            if preemption_safe:
+                # flag set by SIGTERM/SIGINT, consumed at the step
+                # boundaries below.  Multi-process runs AGREE on the flag
+                # at each boundary (distributed.agree_flag) so every rank
+                # checkpoints at the same step instead of deadlocking in
+                # mismatched collectives.
+                preempt = PreemptionHandler(logger=self.logger).install()
+            import jax as _jax
+            preempt_sync = preempt is not None and _jax.process_count() > 1
+
+            # fit owns the watchdog's monitor thread; the fused trainer
+            # (when present) is armed too so its per-step context lands
+            # in the hang report
+            if watchdog is None:
+                watchdog = resilience.step_timeout_configured()
+            if isinstance(watchdog, StepWatchdog):
+                wd = watchdog
+            elif watchdog:
+                wd = StepWatchdog(logger=self.logger)
+                own_watchdog = True
+            if wd is not None:
+                wd.start()
+                if fused_trainer is not None:
+                    fused_trainer.install_watchdog(wd)
+
+            # MXTPU_PROFILE_DIR: capture a jax.profiler trace of steps
+            # 10-15 of the first epoch (None when the env is unset)
+            from .. import profiler as _profiler
+            trace = _profiler.StepTraceCapture.from_env()
 
             ############################################################
             # training loop
@@ -220,22 +314,68 @@ class BaseModule(object):
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
                 eval_metric.reset()
-                for nbatch, data_batch in enumerate(train_data):
-                    if trace is not None:
-                        trace.on_batch(nbatch)
-                    if monitor is not None:
-                        monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
-                    self.update_metric(eval_metric, data_batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
+                data_stream = iter(train_data)
+                nbatch = -1
+                if epoch == begin_epoch and fast_forward > 0:
+                    # fast-forward past the batches the interrupted run
+                    # already trained on (deterministic iterators replay
+                    # the same order after reset)
+                    for _ in range(fast_forward):
+                        try:
+                            next(data_stream)
+                        except StopIteration:
+                            break
+                        nbatch += 1
+                    self.logger.info(
+                        "fit(resume=True): fast-forwarded %d batches of "
+                        "epoch %d", nbatch + 1, epoch)
+                while True:
+                    # the armed window covers the data fetch too — a
+                    # wedged staging thread hangs the consumer in next()
+                    with wd.armed("epoch %d batch %d"
+                                  % (epoch, nbatch + 1)) \
+                            if wd is not None else nullcontext():
+                        try:
+                            data_batch = next(data_stream)
+                        except StopIteration:
+                            break
+                        nbatch += 1
+                        if trace is not None:
+                            trace.on_batch(nbatch)
+                        if monitor is not None:
+                            monitor.tic()
+                        self.forward_backward(data_batch)
+                        self.update()
+                        self.update_metric(eval_metric, data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                    # step boundary: consume a pending preemption —
+                    # checkpoint mid-epoch and exit cleanly for the
+                    # supervisor to relaunch with resume
+                    if preempt is not None:
+                        if faults.consume("preempt"):
+                            # in-band drill: deliver a REAL signal so the
+                            # whole handler path is what gets tested
+                            import os as _os
+                            import signal as _signal
+                            _os.kill(_os.getpid(), _signal.SIGTERM)
+                            time.sleep(0.05)  # let the handler run
+                        triggered = preempt.triggered
+                        if preempt_sync:
+                            # all ranks take the same branch at the same
+                            # boundary (any rank signaled => all save)
+                            from .. import distributed as _dist
+                            triggered = _dist.agree_flag(triggered)
+                        if triggered:
+                            self._save_preemption_checkpoint(
+                                checkpoint, epoch, nbatch + 1)
+                            preempted_exit()
                 if trace is not None:
                     trace.stop()  # epoch shorter than the window: close
                     trace = None  # first epoch only
@@ -285,6 +425,37 @@ class BaseModule(object):
         finally:
             if trace is not None:
                 trace.stop()
+            if preempt is not None:
+                preempt.uninstall()
+            if wd is not None:
+                if fused_trainer is not None:
+                    fused_trainer.install_watchdog(None)
+                if own_watchdog:
+                    wd.stop()
+
+    def _save_preemption_checkpoint(self, checkpoint, epoch, step):
+        """Mid-epoch checkpoint at a step boundary: params + optimizer
+        state under the SAME epoch number the epoch-end save will use
+        (epoch + 1), plus a ``step_state`` manifest record — epoch index,
+        batches consumed, RNG stream — that ``fit(resume=True)`` uses to
+        fast-forward.  The later epoch-end save of the same number
+        replaces the partial entry."""
+        from .. import random as _random
+        arg_params_, aux_params_ = self.get_params()
+        try:
+            states = self.get_optimizer_states()
+        except NotImplementedError:
+            states = None
+        checkpoint.save(epoch + 1, self.symbol, arg_params_, aux_params_,
+                        optimizer_states=states,
+                        step_state={"epoch": int(epoch), "step": int(step),
+                                    "rng": _random.get_state()})
+        from ..resilience import PREEMPT_EXIT_CODE
+        self.logger.warning(
+            "preemption: saved mid-epoch checkpoint (epoch %d, step %d) "
+            "to %s; exiting with code %d — relaunch with resume to "
+            "continue", epoch, step, checkpoint.directory,
+            PREEMPT_EXIT_CODE)
 
     # -- symbol / params ---------------------------------------------------
     @property

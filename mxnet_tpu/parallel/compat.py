@@ -1,0 +1,68 @@
+"""JAX version-compatibility shims for the parallel layer.
+
+``shard_map`` has lived at three spellings across JAX releases:
+``jax.experimental.shard_map.shard_map`` (<= 0.4.x, kwarg ``check_rep``),
+``jax.shard_map`` (newer releases, kwarg ``check_vma``), and briefly
+``jax.experimental.shard_map`` re-exporting the new one.  The parallel
+modules (ring_attention, pipeline) call :func:`shard_map` from HERE with
+the modern signature; this module resolves whichever spelling the
+installed JAX provides and translates the kwargs — one shim, every
+caller un-broken on old and new JAX alike.
+
+When no spelling exists (a future removal, a stripped build) callers
+raise a clear :class:`~mxnet_tpu.base.MXNetError` at use time and tests
+skip via :data:`HAS_SHARD_MAP`.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..base import MXNetError
+
+__all__ = ["shard_map", "HAS_SHARD_MAP"]
+
+
+def _resolve():
+    """(callable, kwarg-name-for-replication-check) or (None, None)."""
+    import jax
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        try:
+            from jax.experimental.shard_map import shard_map as fn
+        except ImportError:
+            return None, None
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # pragma: no cover — exotic wrapper
+        params = {}
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return fn, name
+    return fn, None
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+#: True when the installed JAX provides shard_map under either spelling —
+#: tests gate on this instead of import-crashing
+HAS_SHARD_MAP = _SHARD_MAP is not None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """Version-tolerant ``shard_map`` (modern calling convention).
+
+    ``check_vma=False`` maps onto the installed spelling's replication-
+    check kwarg (``check_rep`` on older JAX) — the parallel kernels here
+    use collectives (ppermute rings) whose replication the checker cannot
+    prove, so they all pass False.
+    """
+    if _SHARD_MAP is None:
+        raise MXNetError(
+            "this JAX provides neither jax.shard_map nor "
+            "jax.experimental.shard_map.shard_map — ring attention and "
+            "pipeline parallelism need one of them")
+    kwargs = {}
+    if _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

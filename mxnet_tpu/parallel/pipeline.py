@@ -39,7 +39,7 @@ def pipeline_apply(fn, stage_params, x, mesh, axis_name="pp",
     returns: (B, ...) replicated result of stage S-1 ∘ ... ∘ stage 0
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     n_stages = mesh.shape[axis_name]
     n_given = jax.tree_util.tree_leaves(stage_params)[0].shape[0]

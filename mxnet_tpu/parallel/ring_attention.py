@@ -100,7 +100,7 @@ def ring_attention_sharded(q, k, v, mesh, axis_name="sp", causal=False,
     """Ring attention with q/k/v sharded on the sequence axis (axis 1) over
     ``axis_name`` of ``mesh``.  q,k,v: (B, T, H, D) global shapes."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from .compat import shard_map
 
     n_blocks = mesh.shape[axis_name]
     D = q.shape[-1]

@@ -189,6 +189,11 @@ class SPMDTrainer(object):
         self.flush_interval = 1
         self._steps_since_flush = 0
 
+        # optional hung-step watchdog (resilience.StepWatchdog): when set
+        # (fit() wires it through install_watchdog), every fused-step
+        # dispatch+sync is armed so a wedged collective aborts the
+        # process with a stack dump instead of hanging the pod silently
+        self.watchdog = None
         self._rep_fn = None       # cached jitted reshard-to-replicated
         self.params = None        # dict name -> jax array (sharded)
         self.aux = None
@@ -619,6 +624,18 @@ class SPMDTrainer(object):
         ``key`` lets a caller that already previewed this step's outputs
         (module.get_outputs between forward and update) hand in the exact
         key so stochastic layers draw the same masks in both passes."""
+        from contextlib import nullcontext
+        from .. import random as _random
+        from ..resilience import faults
+        wd = self.watchdog
+        with wd.armed("fused step %d" % (self._num_update + 1)) \
+                if wd is not None else nullcontext():
+            # deterministic hang injection (watchdog drill): stalls here,
+            # inside the armed window, exactly like a wedged collective
+            faults.maybe_hang("hang_step")
+            return self._step_impl(batch_arrays, key)
+
+    def _step_impl(self, batch_arrays, key):
         from .. import random as _random
         # consume the PREVIOUS steps' guard counters before dispatching
         # this one: a one-deep pipeline by default (the device runs step N
@@ -946,6 +963,24 @@ class SPMDTrainer(object):
         if states is not None:
             self.set_states(states)
         return epoch
+
+    def install_watchdog(self, watchdog):
+        """Arm ``watchdog`` (resilience.StepWatchdog) around every fused
+        step, and give its hang report this trainer's mesh/step context.
+        Pass None to detach (also clears the info hook — a stale closure
+        would pin this trainer alive and stamp a later run's hang report
+        with the wrong trainer's context)."""
+        if watchdog is None and self.watchdog is not None:
+            self.watchdog.info = None
+        self.watchdog = watchdog
+        if watchdog is not None:
+            def _info(_self=self):
+                mesh = _self.mesh
+                return ("trainer: step %d, grad_sync=%r, mesh=%s" %
+                        (_self._num_update, _self.grad_sync,
+                         "none" if mesh is None else dict(mesh.shape)))
+            watchdog.info = _info
+        return watchdog
 
     # -- lifecycle --------------------------------------------------------
     def close(self):

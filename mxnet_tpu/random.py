@@ -13,7 +13,8 @@ import threading
 import jax
 import numpy as _np
 
-__all__ = ["seed", "next_key", "uniform", "normal", "randint"]
+__all__ = ["seed", "next_key", "get_state", "set_state", "uniform",
+           "normal", "randint"]
 
 _state = threading.local()
 _DEFAULT_SEED = 0
@@ -56,6 +57,22 @@ def next_key():
     key = _get_key()
     _state.key, sub = jax.random.split(key)
     return sub
+
+
+def get_state():
+    """This thread's RNG chain as a JSON-serializable dict — saved into
+    mid-epoch (preemption) checkpoints so a resumed run's stochastic
+    layers draw the exact keys the interrupted run would have."""
+    return {"key": _np.asarray(_get_key()).tolist(), "seed": _seed_int}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (the mid-epoch-resume
+    counterpart of :func:`seed`)."""
+    global _seed_int
+    key = _np.asarray(state["key"], dtype=_np.uint32)
+    _state.key = jax.numpy.asarray(key)
+    _seed_int = int(state.get("seed", _DEFAULT_SEED))
 
 
 def peek_key():

@@ -18,23 +18,41 @@ guarded.  This module owns the pieces:
 - :data:`faults` — deterministic fault-injection points (env- or
   test-driven) so all of the above is exercised in tier-1 CPU tests
   without real crashes.
+- :class:`StepWatchdog` — a monitor thread armed around each training
+  step; a step that exceeds its (auto-calibrated) budget dumps every
+  Python thread's stack plus device/mesh state and aborts the process
+  with :data:`WATCHDOG_EXIT_CODE` so a supervisor can relaunch-and-resume
+  (the MegaScale-style hang detector).
+- :class:`PreemptionHandler` — SIGTERM/SIGINT becomes a flag consumed at
+  the next step boundary: ``fit`` saves a mid-epoch checkpoint (with
+  step/iterator/RNG state in the manifest) and exits with
+  :data:`PREEMPT_EXIT_CODE`.
+- ``tools/supervise.py`` — the matching supervisor: exit-code-aware
+  relaunch with a restart budget, setting ``MXTPU_RESUME=1``.
 """
 from __future__ import annotations
 
 import json
 import logging
 import os
+import signal
+import sys
+import threading
 import time
+import traceback
 from contextlib import contextmanager
 
 from .base import MXNetError
 
 __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
-           "CheckpointManager",
+           "CheckpointManager", "StepWatchdog", "PreemptionHandler",
+           "preempted_exit",
            "TransientError", "FaultInjector", "faults",
+           "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
            "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
-           "ENV_STEP_GUARD", "ENV_FAULTS"]
+           "ENV_STEP_GUARD", "ENV_FAULTS", "ENV_STEP_TIMEOUT",
+           "ENV_ON_PREEMPT", "ENV_DEBUG_DIR", "ENV_RESUME"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -46,6 +64,41 @@ ENV_DATA_BACKOFF = "MXTPU_DATA_RETRY_BACKOFF"
 ENV_MAX_BAD_STEPS = "MXTPU_MAX_BAD_STEPS"
 ENV_STEP_GUARD = "MXTPU_STEP_GUARD"
 ENV_FAULTS = "MXTPU_FAULTS"
+ENV_STEP_TIMEOUT = "MXTPU_STEP_TIMEOUT"
+ENV_ON_PREEMPT = "MXTPU_ON_PREEMPT"
+ENV_DEBUG_DIR = "MXTPU_DEBUG_DIR"
+ENV_RESUME = "MXTPU_RESUME"
+
+#: process exit code of a watchdog abort (hung step): the supervisor
+#: relaunches with resume.  Distinct from signal codes (128+N) and from
+#: PREEMPT_EXIT_CODE so exit-code-aware restart policies can tell a hang
+#: from a graceful preemption.  tools/supervise.py hardcodes the same
+#: values (it must not import jax); test_chaos.py asserts they match.
+WATCHDOG_EXIT_CODE = 87
+
+#: process exit code of a graceful preemption (mid-epoch checkpoint was
+#: saved; relaunch with resume to continue)
+PREEMPT_EXIT_CODE = 85
+
+
+def step_timeout_configured():
+    """True when ``MXTPU_STEP_TIMEOUT`` asks for a watchdog: ``auto`` or
+    a positive number of seconds.  Unset, ``0``, negative or unparseable
+    values mean DISABLED — ``MXTPU_STEP_TIMEOUT=0`` is the natural "off"
+    spelling and must never arm a zero-second budget."""
+    from .base import get_env
+    env = get_env(ENV_STEP_TIMEOUT)
+    if not env:
+        return False
+    s = str(env).strip().lower()
+    if s == "auto":
+        return True
+    try:
+        return float(s) > 0
+    except ValueError:
+        _LOG.warning("%s=%r is neither a number nor 'auto' — watchdog "
+                     "disabled", ENV_STEP_TIMEOUT, env)
+        return False
 
 
 class TransientError(MXNetError):
@@ -59,13 +112,16 @@ class TransientError(MXNetError):
 
 class FaultInjector(object):
     """Named failure points, armed programmatically or via the
-    ``MXTPU_FAULTS`` env (``"point:times,point2:times"``).
+    ``MXTPU_FAULTS`` env (``"point:times,point2:times"``; a
+    ``times@after`` count delays the first firing until ``after`` hits
+    have passed clean, so a fault can strike at exactly step N).
 
-    Production code plants ``faults.maybe_fail("checkpoint_write")`` (raise)
-    or ``if faults.consume("poison_grad")`` (branch) at the spots a real
-    fault would strike; tests arm a point for N firings and get the exact
-    failure, deterministically, on the tier-1 CPU suite.  Unarmed points
-    cost one dict lookup.
+    Production code plants ``faults.maybe_fail("checkpoint_write")``
+    (raise), ``if faults.consume("poison_grad")`` (branch) or
+    ``faults.maybe_hang("hang_step")`` (stall — watchdog coverage) at the
+    spots a real fault would strike; tests arm a point for N firings and
+    get the exact failure, deterministically, on the tier-1 CPU suite.
+    Unarmed points cost one dict lookup.
     """
 
     def __init__(self):
@@ -73,11 +129,16 @@ class FaultInjector(object):
         env = os.environ.get(ENV_FAULTS, "")
         for part in filter(None, (p.strip() for p in env.split(","))):
             point, _, times = part.partition(":")
+            times, _, after = (times or "1").partition("@")
             self._armed[point] = int(times or 1)
+            if after:
+                self._armed[point + "/after"] = int(after)
 
-    def arm(self, point, times=1, exc=None):
+    def arm(self, point, times=1, exc=None, after=0):
         """Make ``point`` fire for the next ``times`` hits (``exc``: the
-        exception type ``maybe_fail`` raises; default TransientError)."""
+        exception type ``maybe_fail`` raises; default TransientError).
+        ``after`` lets the first ``after`` hits pass clean — "fail at
+        exactly the Nth step" determinism for preemption/hang drills."""
         self._armed[point] = int(times)
         if exc is not None:
             self._armed[point + "/exc"] = exc
@@ -85,6 +146,17 @@ class FaultInjector(object):
             # re-arming resets to the default exception; never inherit a
             # previous arm()'s custom type
             self._armed.pop(point + "/exc", None)
+        if after:
+            self._armed[point + "/after"] = int(after)
+        else:
+            self._armed.pop(point + "/after", None)
+        return self
+
+    def arm_hang(self, point, seconds, times=1, after=0):
+        """Arm ``point`` as a stall of ``seconds`` for ``maybe_hang``
+        sites (deliberately-hung-step coverage for the watchdog)."""
+        self.arm(point, times=times, after=after)
+        self._armed[point + "/secs"] = float(seconds)
         return self
 
     def disarm(self, point=None):
@@ -92,17 +164,23 @@ class FaultInjector(object):
         if point is None:
             self._armed.clear()
         else:
-            self._armed.pop(point, None)
-            self._armed.pop(point + "/exc", None)
+            for k in (point, point + "/exc", point + "/after",
+                      point + "/secs"):
+                self._armed.pop(k, None)
 
     def is_armed(self, point):
         return self._armed.get(point, 0) > 0
 
     def consume(self, point):
         """True (and decrement) if ``point`` is armed — for fault sites
-        that branch rather than raise."""
+        that branch rather than raise.  A pending ``after`` delay is
+        consumed first (those hits return False)."""
         left = self._armed.get(point, 0)
         if left <= 0:
+            return False
+        delay = self._armed.get(point + "/after", 0)
+        if delay > 0:
+            self._armed[point + "/after"] = delay - 1
             return False
         self._armed[point] = left - 1
         return True
@@ -112,6 +190,28 @@ class FaultInjector(object):
         if self.consume(point):
             exc = self._armed.get(point + "/exc", TransientError)
             raise exc(message or "injected fault at %r" % point)
+
+    #: default stall length of an armed hang point — far beyond any step
+    #: budget, so the watchdog (or the supervisor's own timeout) is what
+    #: ends the process, exactly like a wedged collective would
+    HANG_SECONDS = 3600.0
+
+    def maybe_hang(self, point):
+        """Stall the calling thread for the armed duration at ``point``
+        (no-op when unarmed) — the deterministic stand-in for a hung
+        collective/transfer.  Sleeps in short slices so an in-process
+        test that injected a small ``seconds`` via :meth:`arm_hang`
+        regains control promptly."""
+        if not self.consume(point):
+            return
+        seconds = self._armed.get(point + "/secs", self.HANG_SECONDS)
+        _LOG.warning("fault injection: hanging %.1fs at %r", seconds, point)
+        deadline = time.monotonic() + seconds
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(0.05, left))
 
 
 faults = FaultInjector()
@@ -251,6 +351,313 @@ def retrying_next(data_iter, name="next"):
 
 
 # ---------------------------------------------------------------------------
+# hung-step watchdog
+# ---------------------------------------------------------------------------
+
+def _dump_thread_stacks(out):
+    """Write every Python thread's current stack to ``out`` (the hang
+    post-mortem: which thread is wedged inside which call)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sorted(sys._current_frames().items()):
+        out.write("\n--- thread %s (ident %d) ---\n"
+                  % (names.get(ident, "?"), ident))
+        out.write("".join(traceback.format_stack(frame)))
+
+
+def _dump_device_state(out):
+    """Best-effort device/mesh/process snapshot for the hang report.
+    Must never raise (a wedged backend is exactly when this runs) and
+    must not itself touch the device (a device call could hang too)."""
+    try:
+        import jax
+        out.write("\njax backend: %s, process %d/%d\n"
+                  % (jax.default_backend(), jax.process_index(),
+                     jax.process_count()))
+        out.write("devices: %s\n" % ([str(d) for d in jax.devices()],))
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        out.write("\n(device state unavailable: %s)\n" % (e,))
+
+
+class StepWatchdog(object):
+    """Abort-and-dump monitor for hung training steps.
+
+    The reference's only liveness signal was the ps-lite heartbeat
+    (``get_num_dead_node``); a hung XLA collective under SPMD hangs every
+    rank silently forever.  The watchdog is armed around each step
+    (``with watchdog.armed("step 12"): ...``); a step that overruns its
+    budget gets every Python thread's stack plus device state dumped to
+    stderr (and to a timestamped file under ``MXTPU_DEBUG_DIR`` when
+    set), then the process aborts with :data:`WATCHDOG_EXIT_CODE` via
+    ``os._exit`` — a wedged device thread cannot block the exit — so a
+    supervisor (``tools/supervise.py``) can relaunch with resume.
+
+    The budget: ``MXTPU_STEP_TIMEOUT`` seconds when set; otherwise
+    auto-calibrated as ``multiplier`` x the median of the first
+    ``calibrate_steps`` completed steps (never below ``min_timeout``).
+    Until calibration completes no deadline is enforced — the first
+    steps include XLA compilation and are two orders of magnitude slower
+    than steady state, and any fixed guess would either fire on the
+    compile or be useless afterwards.  Set ``MXTPU_STEP_TIMEOUT``
+    explicitly to also cover bring-up.
+
+    ``clock``/``abort`` are injectable so tests drive the full
+    fire path with a fake clock and no real process death; the monitor
+    thread just calls :meth:`poll` every ``check_interval``.
+    """
+
+    def __init__(self, timeout=None, calibrate_steps=5, multiplier=20.0,
+                 min_timeout=10.0, check_interval=0.25, debug_dir=None,
+                 exit_code=WATCHDOG_EXIT_CODE, clock=time.monotonic,
+                 abort=None, logger=None):
+        from .base import get_env
+        if timeout is None:
+            # MXTPU_STEP_TIMEOUT: seconds, or "auto" (calibrate from the
+            # first steps' median; also what fit() treats as opt-in).
+            # Nonpositive/garbage values mean "no fixed budget" — never a
+            # zero-second budget that would abort every first step.
+            env = get_env(ENV_STEP_TIMEOUT)
+            if env and str(env).strip().lower() != "auto":
+                try:
+                    timeout = float(env)
+                except ValueError:
+                    timeout = None
+                if timeout is not None and timeout <= 0:
+                    timeout = None
+        self.timeout = timeout                # None => auto-calibrate
+        self.calibrate_steps = max(1, int(calibrate_steps))
+        self.multiplier = float(multiplier)
+        self.min_timeout = float(min_timeout)
+        self.check_interval = float(check_interval)
+        self.debug_dir = debug_dir if debug_dir is not None \
+            else get_env(ENV_DEBUG_DIR)
+        self.exit_code = int(exit_code)
+        self.clock = clock
+        self.abort = abort or (lambda code: os._exit(code))
+        self.logger = logger or _LOG
+        self.fired = False
+        self.info = None          # optional () -> str extra context
+        self._durations = []      # calibration window
+        self._lock = threading.Lock()
+        self._label = None
+        self._armed_at = None
+        self._depth = 0           # re-entrant arming: outer arm wins
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- arming ------------------------------------------------------------
+    @contextmanager
+    def armed(self, label="step"):
+        """Arm around one step.  Re-entrant: a nested arm (fit() wraps the
+        batch, trainer.step wraps the dispatch) keeps the OUTER deadline
+        so the budget covers the whole host-visible step."""
+        with self._lock:
+            self._depth += 1
+            outer = self._depth == 1
+            if outer:
+                self._label = label
+                self._armed_at = self.clock()
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._depth -= 1
+                if outer and self._armed_at is not None:
+                    self._observe(self.clock() - self._armed_at)
+                    self._armed_at = None
+                    self._label = None
+
+    def _observe(self, duration):
+        """Record one completed step for auto-calibration."""
+        if self.timeout is not None or \
+                len(self._durations) >= self.calibrate_steps:
+            return
+        self._durations.append(float(duration))
+        if len(self._durations) >= self.calibrate_steps:
+            med = sorted(self._durations)[len(self._durations) // 2]
+            self.timeout = max(self.min_timeout, self.multiplier * med)
+            self.logger.info(
+                "StepWatchdog: calibrated step budget %.1fs "
+                "(%.0fx median %.3fs of first %d steps)", self.timeout,
+                self.multiplier, med, len(self._durations))
+
+    @property
+    def calibrated_timeout(self):
+        """The active budget in seconds, or None while still
+        calibrating."""
+        return self.timeout
+
+    # -- monitor -----------------------------------------------------------
+    def start(self):
+        """Start the monitor thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="StepWatchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the monitor thread (the armed() bookkeeping still works,
+        e.g. to keep calibrating a paused watchdog)."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _monitor(self):
+        while not self._stop.wait(self.check_interval):
+            self.poll()
+
+    def poll(self, now=None):
+        """One deadline check (what the monitor thread runs; tests call
+        it directly with a fake clock).  Returns True when it fired."""
+        with self._lock:
+            armed_at, label = self._armed_at, self._label
+        if armed_at is None or self.timeout is None or self.fired:
+            return False
+        now = self.clock() if now is None else now
+        overrun = now - armed_at
+        if overrun <= self.timeout:
+            return False
+        self.fired = True
+        self._fire(label, overrun)
+        return True
+
+    def _fire(self, label, overrun):
+        import io as _io
+        buf = _io.StringIO()
+        buf.write("=" * 70 + "\n")
+        buf.write("StepWatchdog: %r exceeded its %.1fs budget "
+                  "(%.1fs elapsed) — dumping state and aborting with "
+                  "exit code %d\n" % (label, self.timeout, overrun,
+                                      self.exit_code))
+        if self.info is not None:
+            try:
+                buf.write(str(self.info()) + "\n")
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                buf.write("(info hook failed: %s)\n" % (e,))
+        _dump_device_state(buf)
+        _dump_thread_stacks(buf)
+        buf.write("=" * 70 + "\n")
+        report = buf.getvalue()
+        sys.stderr.write(report)
+        sys.stderr.flush()
+        if self.debug_dir:
+            try:
+                os.makedirs(self.debug_dir, exist_ok=True)
+                path = os.path.join(
+                    self.debug_dir,
+                    "watchdog-%d-%d.txt" % (os.getpid(), int(time.time())))
+                with open(path, "w") as f:
+                    f.write(report)
+                sys.stderr.write("StepWatchdog: report written to %s\n"
+                                 % path)
+                sys.stderr.flush()
+            except OSError as e:
+                sys.stderr.write("StepWatchdog: could not write report "
+                                 "(%s)\n" % (e,))
+        self.abort(self.exit_code)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+# ---------------------------------------------------------------------------
+
+def preempted_exit():
+    """Terminate with :data:`PREEMPT_EXIT_CODE` (SystemExit — finally
+    blocks and atexit run; the checkpoint is already on disk)."""
+    raise SystemExit(PREEMPT_EXIT_CODE)
+
+
+class PreemptionHandler(object):
+    """SIGTERM/SIGINT -> a flag consumed at the next step boundary.
+
+    Cloud schedulers deliver preemption as SIGTERM with a grace window;
+    killing mid-step loses up to an epoch of work (the PR-1 runtime only
+    checkpoints at epoch end).  Installing this handler makes the signal
+    set :attr:`triggered`; ``fit(preemption_safe=True)`` checks it after
+    every batch, saves a mid-epoch checkpoint (step + RNG state in the
+    manifest) and exits cleanly with :data:`PREEMPT_EXIT_CODE`.
+
+    A second signal restores the original disposition and re-raises it —
+    an operator's double Ctrl-C still kills a wedged run immediately.
+    Signal handlers can only be installed on the main thread; elsewhere
+    ``install`` is a no-op that logs (the flag can still be set
+    programmatically via :meth:`trigger`, which tests and in-band fault
+    injection use).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 logger=None):
+        self.signals = tuple(signals)
+        self.logger = logger or _LOG
+        self.triggered = False
+        self._previous = {}
+        self._installed = False
+
+    def _handle(self, signum, frame):
+        if self.triggered:
+            # second signal: the operator means it — restore and re-raise
+            self.uninstall()
+            os.kill(os.getpid(), signum)
+            return
+        self.triggered = True
+        self.logger.warning(
+            "PreemptionHandler: received signal %d — will checkpoint and "
+            "exit (code %d) at the next step boundary; send again to kill "
+            "immediately", signum, PREEMPT_EXIT_CODE)
+
+    def trigger(self):
+        """Set the flag programmatically (in-band preemption drills)."""
+        self.triggered = True
+        return self
+
+    def install(self):
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            self.logger.warning(
+                "PreemptionHandler: not on the main thread — signal "
+                "handlers not installed (programmatic trigger() still "
+                "works)")
+            return self
+        for sig in self.signals:
+            try:
+                self._previous[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError):  # pragma: no cover — platform
+                self.logger.warning(
+                    "PreemptionHandler: could not install handler for "
+                    "signal %s", sig)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover — platform
+                pass
+        self._previous = {}
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.uninstall()
+        return False
+
+
+# ---------------------------------------------------------------------------
 # checkpoint manager
 # ---------------------------------------------------------------------------
 
@@ -306,11 +713,54 @@ class CheckpointManager(object):
         return self._path("%s-%04d.states" % (self.prefix, epoch))
 
     # -- manifest ---------------------------------------------------------
-    def _read_manifest(self):
+    def _scan_directory(self):
+        """Rebuild a manifest by scanning the directory for this prefix's
+        params files — the recovery path when ``manifest.json`` itself is
+        corrupt (torn by a dying disk, truncated by an operator cp).  The
+        params files are each atomic, so whatever the scan finds is
+        individually complete; only step_state (mid-epoch metadata) is
+        unrecoverable this way."""
+        import re as _re
+        pat = _re.compile(_re.escape(self.prefix) + r"-(\d{4,})\.params$")
+        entries = []
         try:
-            with open(self._path(self.MANIFEST)) as f:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for name in sorted(names):
+            m = pat.match(name)
+            if not m:
+                continue
+            epoch = int(m.group(1))
+            states = os.path.basename(self.states_path(epoch))
+            entries.append({"epoch": epoch, "params": name,
+                            "states": states if os.path.exists(
+                                self._path(states)) else None})
+        return {"prefix": self.prefix, "checkpoints": entries}
+
+    def _read_manifest(self):
+        path = self._path(self.MANIFEST)
+        try:
+            with open(path) as f:
                 return json.load(f)
-        except (OSError, ValueError):
+        except ValueError:
+            # corrupt manifest: fall back to the (atomic, individually
+            # complete) params files on disk instead of reporting an
+            # empty checkpoint directory
+            _LOG.warning("CheckpointManager: manifest %r is corrupt — "
+                         "recovering checkpoint list from a directory "
+                         "scan", path)
+            manifest = self._scan_directory()
+            # repair in place (rank 0, best-effort) so a restore-only run
+            # doesn't rescan + re-warn on every read and the next reader
+            # finds a healthy manifest
+            if _rank() == 0:
+                try:
+                    self._write_manifest(manifest)
+                except OSError:  # pragma: no cover — read-only dir
+                    pass
+            return manifest
+        except OSError:
             return {"prefix": self.prefix, "checkpoints": []}
 
     def _write_manifest(self, manifest):
@@ -333,13 +783,34 @@ class CheckpointManager(object):
         epochs = self.checkpoints()
         return epochs[-1] if epochs else None
 
+    def entry(self, epoch):
+        """The manifest entry (dict) for ``epoch``, or None.  Mid-epoch
+        (preemption) checkpoints carry a ``step_state`` key: epoch index,
+        batches consumed, and the RNG state to resume from."""
+        for e in self._read_manifest().get("checkpoints", []):
+            if int(e["epoch"]) == int(epoch):
+                return e
+        return None
+
+    def latest_entry(self):
+        """The newest complete checkpoint's manifest entry, or None."""
+        epoch = self.latest()
+        return None if epoch is None else self.entry(epoch)
+
     # -- save/restore -----------------------------------------------------
     def save(self, epoch, symbol=None, arg_params=None, aux_params=None,
-             optimizer_states=None):
+             optimizer_states=None, step_state=None):
         """Write one checkpoint atomically; returns the epoch.
 
         ``optimizer_states`` is the serialized blob (bytes) from
         ``Module.get_optimizer_states()`` / ``Updater.get_states()``.
+        ``step_state`` (JSON-serializable dict) marks a MID-EPOCH
+        checkpoint: ``fit`` stores ``{"epoch": epoch_index, "step":
+        batches_consumed, "rng": random.get_state()}`` so a resumed run
+        can fast-forward the iterator and continue the RNG stream; the
+        epoch-end save of the same epoch number later replaces the entry
+        (and clears the flag) — partial checkpoints never outlive the
+        complete epoch they belong to.
         On ranks != 0 this is a no-op (gather before calling — see class
         docstring).
         """
@@ -358,11 +829,14 @@ class CheckpointManager(object):
         manifest = self._read_manifest()
         entries = [e for e in manifest.get("checkpoints", [])
                    if int(e["epoch"]) != epoch]
-        entries.append({"epoch": epoch,
-                        "params": os.path.basename(self.params_path(epoch)),
-                        "states": (os.path.basename(self.states_path(epoch))
-                                   if has_states else None),
-                        "time": time.time()})
+        entry = {"epoch": epoch,
+                 "params": os.path.basename(self.params_path(epoch)),
+                 "states": (os.path.basename(self.states_path(epoch))
+                            if has_states else None),
+                 "time": time.time()}
+        if step_state is not None:
+            entry["step_state"] = dict(step_state)
+        entries.append(entry)
         entries.sort(key=lambda e: int(e["epoch"]))
         if self.keep_last is not None and len(entries) > self.keep_last:
             for stale in entries[:-self.keep_last]:
@@ -384,15 +858,34 @@ class CheckpointManager(object):
         """Load (symbol, arg_params, aux_params, optimizer_states, epoch)
         for ``epoch`` (default: latest).  ``symbol`` is None when no
         symbol file was saved; ``optimizer_states`` is the bytes blob or
-        None.  Raises MXNetError when nothing restorable exists."""
-        from . import ndarray as nd
-        from . import symbol as sym_mod
-        if epoch is None:
-            epoch = self.latest()
-        if epoch is None:
+        None.  With no explicit epoch, a checkpoint whose files turn out
+        corrupt (bit rot, torn by a non-atomic copy) is skipped with a
+        warning and the previous intact one loads instead — a damaged
+        newest checkpoint must degrade the resume by one epoch, not kill
+        it.  Raises MXNetError when nothing restorable exists."""
+        if epoch is not None:
+            return self._restore_epoch(int(epoch))
+        epochs = self.checkpoints()
+        if not epochs:
             raise MXNetError("CheckpointManager: no checkpoint in %r"
                              % self.directory)
-        epoch = int(epoch)
+        last_err = None
+        for e in reversed(epochs):
+            try:
+                return self._restore_epoch(e)
+            except Exception as err:  # noqa: BLE001 — walk back past rot
+                last_err = err
+                _LOG.warning(
+                    "CheckpointManager: checkpoint epoch %d is unreadable "
+                    "(%s: %s) — falling back to the previous one",
+                    e, type(err).__name__, err)
+        raise MXNetError("CheckpointManager: every checkpoint in %r is "
+                         "unreadable (last: %s)"
+                         % (self.directory, last_err)) from last_err
+
+    def _restore_epoch(self, epoch):
+        from . import ndarray as nd
+        from . import symbol as sym_mod
         params_file = self.params_path(epoch)
         if not os.path.exists(params_file):
             raise MXNetError("CheckpointManager: epoch %d has no params "

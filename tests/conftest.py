@@ -44,6 +44,10 @@ def pytest_configure(config):
         "markers", "slow: long-running test excluded from the tier-1 run")
     config.addinivalue_line(
         "markers", "resilience: fault-injection / recovery test")
+    config.addinivalue_line(
+        "markers", "chaos: kill-and-resume drill (spawns subprocesses, "
+        "sends real signals; runs in tier-1, combinable with slow for "
+        "pod-scale variants)")
 
 
 @pytest.fixture

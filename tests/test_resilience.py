@@ -156,6 +156,75 @@ def test_checkpoint_retention_keep_last(tmp_path):
     assert np.allclose(args["w"].asnumpy(), 4.0)
 
 
+def test_manifest_corruption_falls_back_to_directory_scan(tmp_path):
+    """A corrupt manifest.json (torn by a dying disk / non-atomic copy)
+    must not make the directory look empty: latest() recovers the intact
+    params files by scanning."""
+    man = CheckpointManager(str(tmp_path), keep_last=5)
+    for epoch in (1, 2):
+        man.save(epoch, mlp_sym(),
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {},
+                 optimizer_states=b"state-%d" % epoch)
+    # truncate the newest manifest mid-JSON
+    mpath = tmp_path / "manifest.json"
+    mpath.write_bytes(mpath.read_bytes()[: len(mpath.read_bytes()) // 2])
+    man2 = CheckpointManager(str(tmp_path))
+    assert man2.checkpoints() == [1, 2]
+    # the first fallback read repaired the manifest in place (atomic),
+    # so later reads don't rescan-and-warn forever
+    assert [e["epoch"] for e in
+            json.loads(mpath.read_text())["checkpoints"]] == [1, 2]
+    assert man2.latest() == 2
+    _, args, _, states, epoch = man2.restore()
+    assert epoch == 2 and states == b"state-2"
+    assert np.allclose(args["w"].asnumpy(), 2.0)
+    # the next save rewrites a healthy manifest
+    man2.save(3, None, {"w": mx.nd.array(np.full((2,), 3, "f"))}, {})
+    assert json.loads(mpath.read_text())["checkpoints"][-1]["epoch"] == 3
+
+
+def test_restore_walks_back_past_corrupt_params(tmp_path):
+    """Bit rot in the NEWEST checkpoint's params file degrades restore()
+    by one epoch (with a warning) instead of killing the resume."""
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2, 3):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    # truncate epoch 3's params to half its bytes
+    p3 = tmp_path / "checkpoint-0003.params"
+    p3.write_bytes(p3.read_bytes()[: len(p3.read_bytes()) // 2])
+    _, args, _, _, epoch = man.restore()
+    assert epoch == 2
+    assert np.allclose(args["w"].asnumpy(), 2.0)
+    # an explicitly requested corrupt epoch still raises (the caller
+    # asked for THAT checkpoint; silently substituting would be worse)
+    with pytest.raises(Exception):
+        man.restore(3)
+
+
+def test_restore_raises_when_everything_is_corrupt(tmp_path):
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    p1 = tmp_path / "checkpoint-0001.params"
+    p1.write_bytes(b"\x00" * 16)
+    with pytest.raises(MXNetError, match="unreadable"):
+        man.restore()
+
+
+def test_step_state_round_trip_and_replacement(tmp_path):
+    """step_state (mid-epoch metadata) rides the manifest entry and is
+    dropped when the complete epoch-end save of the same number lands."""
+    man = CheckpointManager(str(tmp_path))
+    st = {"epoch": 1, "step": 3, "rng": {"key": [0, 7], "seed": 21}}
+    man.save(2, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {},
+             step_state=st)
+    entry = man.latest_entry()
+    assert entry["epoch"] == 2 and entry["step_state"] == st
+    man.save(2, None, {"w": mx.nd.array(np.full((2,), 5, "f"))}, {})
+    entry = man.latest_entry()
+    assert entry["epoch"] == 2 and "step_state" not in entry
+
+
 def test_do_checkpoint_accepts_manager(tmp_path):
     man = CheckpointManager(str(tmp_path), keep_last=2)
     cb = mx.callback.do_checkpoint(man, period=2)

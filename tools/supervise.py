@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Supervised launcher: relaunch-and-resume for preemptible training.
+
+The runtime side of the survival story lives in ``mxnet_tpu.resilience``:
+graceful preemption saves a mid-epoch checkpoint and exits with code 85,
+the hung-step watchdog dumps stacks and aborts with code 87.  This is the
+matching driver — the reference had nothing like it (a dead ps-lite
+worker was an operator page); cloud schedulers restart the *container*,
+but something inside still has to turn "restarted" into "resumed".
+
+::
+
+    python tools/supervise.py [--max-restarts N] [--backoff S]
+        [--retry-any] -- python train.py ...
+
+Policy (exit-code-aware):
+
+- 0: training finished — exit 0.
+- 85 (preempt: a checkpoint was just saved) or 87 (watchdog: the run
+  hung and aborted): relaunch the command with ``MXTPU_RESUME=1`` in its
+  environment, which ``fit(checkpoint=...)`` reads as ``resume=True`` —
+  until the restart budget is spent.
+- anything else (real crash, OOM-kill, assertion): propagate the exit
+  code immediately, unless ``--retry-any`` opts those into the same
+  relaunch budget (for flaky infra where any death is worth one retry).
+
+A SIGTERM/SIGINT delivered to the SUPERVISOR is forwarded to the child
+(giving its preemption handler the chance to checkpoint), the child's
+exit is awaited, and the supervisor exits with the child's code — when
+the whole allocation is being preempted there is nobody left to relaunch
+for.
+
+The exit codes are duplicated here rather than imported: the supervisor
+must stay import-light (importing mxnet_tpu spins up a JAX client, which
+on single-chip hosts would steal the device from the child it is about
+to spawn).  ``tests/test_chaos.py`` asserts they match
+``mxnet_tpu.resilience``.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# keep in sync with mxnet_tpu/resilience.py (asserted by test_chaos.py)
+PREEMPT_EXIT_CODE = 85
+WATCHDOG_EXIT_CODE = 87
+
+RESUME_ENV = "MXTPU_RESUME"
+
+
+def supervise(command, max_restarts=3, backoff=1.0, retry_any=False,
+              env=None, log=None):
+    """Run ``command`` under the relaunch policy; returns the final exit
+    code.  ``env`` overrides the child environment base (default:
+    ``os.environ``); ``log`` is a ``print``-like callable."""
+    log = log or (lambda msg: sys.stderr.write(msg + "\n"))
+    base_env = dict(os.environ if env is None else env)
+    restarts = 0
+    forwarded = {"sig": None}
+    child = {"proc": None}
+
+    def _forward(signum, frame):
+        # the supervisor itself is being preempted: hand the signal to
+        # the child so its PreemptionHandler checkpoints, then stop
+        # relaunching
+        forwarded["sig"] = signum
+        proc = child["proc"]
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signum)
+            except OSError:  # pragma: no cover — child just died
+                pass
+
+    old = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            old[sig] = signal.signal(sig, _forward)
+        except ValueError:  # pragma: no cover — not on the main thread
+            pass
+    try:
+        while True:
+            run_env = dict(base_env)
+            if restarts > 0:
+                run_env[RESUME_ENV] = "1"
+            proc = subprocess.Popen(command, env=run_env)
+            child["proc"] = proc
+            rc = proc.wait()
+            child["proc"] = None
+            if forwarded["sig"] is not None:
+                log("supervise: forwarded signal %d; child exited %d — "
+                    "not relaunching" % (forwarded["sig"], rc))
+                return rc
+            if rc == 0:
+                if restarts:
+                    log("supervise: run completed after %d relaunch(es)"
+                        % restarts)
+                return 0
+            resumable = rc in (PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE)
+            if not resumable and not retry_any:
+                log("supervise: child exited %d (not a preempt/watchdog "
+                    "code) — propagating" % rc)
+                return rc
+            if restarts >= max_restarts:
+                log("supervise: restart budget (%d) exhausted; last exit "
+                    "code %d" % (max_restarts, rc))
+                return rc
+            restarts += 1
+            why = {PREEMPT_EXIT_CODE: "graceful preemption",
+                   WATCHDOG_EXIT_CODE: "watchdog abort (hung step)"}.get(
+                       rc, "exit code %d (--retry-any)" % rc)
+            log("supervise: %s — relaunch %d/%d with %s=1 in %.1fs"
+                % (why, restarts, max_restarts, RESUME_ENV, backoff))
+            if backoff > 0:
+                time.sleep(backoff)
+    finally:
+        for sig, handler in old.items():
+            try:
+                signal.signal(sig, handler)
+            except ValueError:  # pragma: no cover
+                pass
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="relaunch-and-resume supervisor for preemptible "
+                    "training (see docs/how_to/fault_tolerance.md)")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="relaunch budget for resumable exits "
+                             "(default 3)")
+    parser.add_argument("--backoff", type=float, default=1.0,
+                        help="seconds between relaunches (default 1.0)")
+    parser.add_argument("--retry-any", action="store_true",
+                        help="spend the restart budget on ANY nonzero "
+                             "exit, not just preempt/watchdog codes")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command (prefix with -- to "
+                             "separate)")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (usage: supervise.py [opts] -- "
+                     "python train.py ...)")
+    return supervise(command, max_restarts=args.max_restarts,
+                     backoff=args.backoff, retry_any=args.retry_any)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
