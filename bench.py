@@ -718,6 +718,44 @@ def _train_flops(sym_name):
     return 3 * model_flops(sym, data=(1, 3, 224, 224))
 
 
+def _analyze_bench():
+    """Static-analysis metrics (docs/how_to/static_analysis.md):
+    per-step collective count + bytes from the mxlint graph audit for
+    the standard MLP (dp 'allreduce' — expect all-reduce only) and the
+    same model under grad_sync='zero' (expect all-gather +
+    reduce-scatter by design), plus mxlint wall time over the package
+    against its < 10 s budget.  All host/CPU work."""
+    import subprocess as _sp
+    import time as _time
+
+    out = {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = _time.monotonic()
+    res = _sp.run([sys.executable, os.path.join(here, "tools",
+                                                "mxlint.py"), "-q"],
+                  capture_output=True, text=True, timeout=120)
+    out["mxlint_wall_s"] = round(_time.monotonic() - t0, 2)
+    out["mxlint_rc"] = res.returncode
+    out["mxlint_budget_ok"] = bool(
+        res.returncode == 0 and out["mxlint_wall_s"] < 10.0)
+
+    from mxnet_tpu.analysis import fixtures
+
+    X, y = fixtures.standard_mlp_batch()
+    findings = 0
+    for key, grad_sync in (("analyze_mlp", "allreduce"),
+                           ("analyze_zero", "zero")):
+        trainer = fixtures.standard_mlp_trainer(grad_sync=grad_sync)
+        try:
+            rep = trainer.analyze(X, y)
+            findings += len(rep.findings)
+            out[key + "_collectives"] = rep.stats.get("collectives", {})
+        finally:
+            trainer.close()
+    out["analyze_findings"] = findings
+    return out
+
+
 def _run_mode(mode):
     """One metric, current process.  Prints a partial-JSON line."""
     batch = _env_int("BENCH_BATCH", 32)
@@ -727,13 +765,22 @@ def _run_mode(mode):
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume"):
+                "resume", "analyze"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
+        if mode == "analyze":
+            # the graph audit lints the dp=8 fused step on a virtual mesh
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if mode == "decode":
+    if mode == "analyze":
+        out.update(_analyze_bench())
+    elif mode == "decode":
         out.update(_decode_bench())
     elif mode == "fed-cpu":
         out.update(_fed_cpu_bench())
@@ -850,6 +897,7 @@ def main():
             parts["compile_warm_s"] = warm["compile_bringup_s"]
         parts.update(_collect("resume"))
         parts.update(_collect("fed"))
+    parts.update(_collect("analyze", timeout=240))
     parts.update(_collect("compute"))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
         parts.update(_collect("compute-large"))
@@ -899,7 +947,10 @@ def main():
               "compile_cold_s", "compile_warm_s",
               "resume_save_s", "resume_restore_s", "resume_refit_s",
               "resume_baseline_s", "resume_overhead_s", "resume_parity",
-              "resume_parity_note"):
+              "resume_parity_note",
+              "mxlint_wall_s", "mxlint_rc", "mxlint_budget_ok",
+              "analyze_mlp_collectives", "analyze_zero_collectives",
+              "analyze_findings"):
         if k in parts:
             result[k] = parts[k]
     if compute is not None:

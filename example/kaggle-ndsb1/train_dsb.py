@@ -131,6 +131,11 @@ def main(argv=None):
         step=max(int(epoch_size * args.lr_factor_epoch), 1),
         factor=args.lr_factor)
 
+    # deterministic init: this tiny 4-epoch run is sensitive to the
+    # Xavier draw (observed val acc 0.21..0.58 across ambient RNG
+    # states — a bad draw collapses early ReLUs), so the example must
+    # not inherit whatever stream position the process happens to be in
+    mx.random.seed(2016)
     mod = mx.mod.Module(get_symbol(num_classes))
     mod.fit(train_it, eval_data=val_it,
             initializer=mx.initializer.Xavier(),

@@ -21,7 +21,9 @@ from .resilience import CheckpointManager, PreemptionHandler, StepWatchdog
 # Configured BEFORE anything can trigger a compile; thresholds are zeroed
 # so even small CPU-test programs land in the cache.
 import os as _os
-_compile_cache = _os.environ.get("MXTPU_COMPILE_CACHE")
+from .base import ENV_COMPILE_CACHE as _ENV_COMPILE_CACHE
+from .base import get_env as _get_env
+_compile_cache = _get_env(_ENV_COMPILE_CACHE)
 if _compile_cache:
     import jax as _jax
     _jax.config.update("jax_compilation_cache_dir",
@@ -33,7 +35,7 @@ if _compile_cache:
         except Exception:  # noqa: BLE001 — older jax without the knob
             pass
     del _jax
-del _os, _compile_cache
+del _os, _compile_cache, _get_env, _ENV_COMPILE_CACHE
 
 # Join the process group BEFORE anything can touch a JAX backend: under
 # tools/launch.py the MXTPU_* envs are set, and jax.distributed.initialize
@@ -114,3 +116,4 @@ from . import module
 from . import module as mod
 from . import predict
 from . import test_utils
+from . import analysis

@@ -1,0 +1,36 @@
+"""mxlint — static analysis for the fused step graph and the repo's own
+concurrency/knob invariants.
+
+Two levels (the NNVM-graph-pass analog for this codebase):
+
+- :mod:`~mxnet_tpu.analysis.graph_lint` — lint one jitted step program
+  (donation coverage, host callbacks, a collective audit, dtype drift).
+  Runs automatically at the first compile inside ``SPMDTrainer`` when
+  ``MXTPU_ANALYZE=1`` (warn) or ``strict`` (raise), and on demand via
+  ``SPMDTrainer.analyze`` / :func:`graph_lint.lint_jit`.
+- :mod:`~mxnet_tpu.analysis.ast_lint` — AST rules over the source tree
+  (traced-host calls in jitted fns, lock-order cycles, bare excepts,
+  env-registry discipline).  ``tools/mxlint.py`` is the CLI.
+
+See docs/how_to/static_analysis.md for the rule catalog and suppression
+syntax (``# mxlint: disable=<rule>``).
+"""
+from __future__ import annotations
+
+from ..base import register_env
+from .report import Finding, Report, REPORT_VERSION
+from . import ast_lint
+from . import fixtures
+from . import graph_lint
+
+__all__ = ["Finding", "Report", "REPORT_VERSION", "ast_lint", "fixtures",
+           "graph_lint", "ENV_ANALYZE", "ENV_ANALYZE_REPORT"]
+
+ENV_ANALYZE = register_env(
+    "MXTPU_ANALYZE",
+    doc="1 runs the graph lint at the first compile inside SPMDTrainer "
+        "and warns on findings; 'strict' raises MXNetError instead")
+ENV_ANALYZE_REPORT = register_env(
+    "MXTPU_ANALYZE_REPORT", scope="tools",
+    doc="Path for the machine-readable JSON report written by "
+        "tools/mxlint.py (same as --json)")

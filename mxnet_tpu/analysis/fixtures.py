@@ -1,0 +1,56 @@
+"""The ONE definition of the "standard MLP fused step" fixture.
+
+Three consumers assert the same claim — "the standard MLP step lints
+clean" — and must lint the same program: ``tools/mxlint.py --graph``
+(the CLI gate), ``bench.py``'s ``analyze`` metric (collective
+count/bytes per step), and ``tests/test_analysis.py`` (the tier-1
+regression gate).  A hand-copied fixture drifting in any of them would
+quietly turn one claim into three different ones.
+
+Imports are function-local: the analysis package stays stdlib-only at
+import time (the CLI's AST level must run without jax).
+"""
+from __future__ import annotations
+
+__all__ = ["standard_mlp_sym", "standard_mlp_trainer",
+           "standard_mlp_batch"]
+
+#: the canonical dimensions/seed of the fixture — change them HERE only
+BATCH, IN_DIM, HIDDEN, NUM_CLASSES, SEED = 64, 32, 64, 10, 7
+
+
+def standard_mlp_sym(num_classes=NUM_CLASSES, nh=HIDDEN):
+    """fc(64) -> relu -> fc(10) -> softmax, the tier-1 pinned model."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def standard_mlp_batch():
+    """The deterministic example batch every consumer lints against."""
+    import numpy as np
+    rs = np.random.RandomState(0)
+    return (rs.randn(BATCH, IN_DIM).astype("f"),
+            rs.randint(0, NUM_CLASSES, BATCH).astype("f"))
+
+
+def standard_mlp_trainer(cls=None, grad_sync=None, **kwargs):
+    """A bound + initialized SPMDTrainer of the standard MLP on the dp
+    mesh.  ``cls`` lets tests substitute violation-seeding fixture
+    subclasses; extra kwargs (compute_dtype, input_transforms, ...) pass
+    through to the trainer."""
+    import mxnet_tpu as mx
+    from ..parallel import SPMDTrainer, local_mesh
+    cls = cls or SPMDTrainer
+    if grad_sync is not None:
+        kwargs["grad_sync"] = grad_sync
+    trainer = cls(standard_mlp_sym(), "sgd", {"learning_rate": 0.1},
+                  mesh=local_mesh("dp"), **kwargs)
+    trainer.bind([("data", (BATCH, IN_DIM))],
+                 [("softmax_label", (BATCH,))])
+    mx.random.seed(SEED)
+    trainer.init_params(mx.initializer.Xavier())
+    return trainer

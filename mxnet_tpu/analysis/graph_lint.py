@@ -1,0 +1,370 @@
+"""Level-1 static analysis: lint one jitted step program.
+
+The reference got its graph-level guarantees from NNVM passes
+(infer_shape, plan_memory); the TPU-native analog inspects the three
+artifacts every jitted step already produces — the jaxpr (host-callback
+and dtype rules), the lowering's arg/out metadata (donation rules) and
+the compiled HLO module (the collective audit) — and reports violations
+of the invariants the runtime relies on:
+
+- ``graph-donation-missing``: a large array argument whose shape/dtype
+  matches an output (a carry: params, optimizer state, metric/guard
+  accumulators) is not covered by ``donate_argnums`` — each step then
+  pays an extra HBM copy and doubles the buffer's footprint.
+- ``graph-donation-unused``: a donated argument matches NO output, so
+  XLA cannot alias it anywhere — the donation is silently wasted and the
+  caller's array is still invalidated (a likely bug at the call site).
+- ``graph-callback``: a ``pure_callback``/``io_callback``/
+  ``debug_callback`` equation inside the step — a host sync point that
+  serializes the device pipeline every single step.
+- ``graph-collective-allgather``: all-gather traffic in a step whose
+  declared sharding should not need it (replicated params under plain dp
+  'allreduce'), at or above a meaningful fraction of the parameter
+  bytes — the GSPMD signature of an accidental full-parameter regather.
+- ``graph-dtype-drift``: dot/conv equations computing in a wider float
+  than the declared ``compute_dtype`` — silent f32 math inside a bf16
+  step costs ~2x FLOP time on the MXU.
+
+All jax imports are function-local so importing this module costs
+nothing in host-only contexts (the AST level and the CLI).
+"""
+from __future__ import annotations
+
+import re
+
+from .report import Finding, Report
+
+__all__ = ["iter_eqns", "find_callbacks", "audit_dtype", "audit_donation",
+           "collective_stats", "audit_collectives", "lint_lowered",
+           "lint_jit", "CALLBACK_PRIMITIVES", "COLLECTIVE_OPS"]
+
+#: jaxpr primitives that re-enter the host mid-step
+CALLBACK_PRIMITIVES = frozenset((
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call",
+))
+
+#: primitives whose dtype decides where the MXU/VPU math happens
+_COMPUTE_PRIMITIVES = frozenset(("dot_general", "conv_general_dilated"))
+
+#: HLO instruction names of cross-device traffic (the ``-start`` async
+#: forms count once; ``-done`` carries no payload of its own)
+COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                  "all-to-all", "collective-permute")
+
+_WIDER_THAN = {
+    "bfloat16": ("float32", "float64"),
+    "float16": ("float32", "float64"),
+    "float32": ("float64",),
+}
+
+# f32[128,64]{1,0} / bf16[8]{0} / pred[] ... inside an HLO result type
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `%name = <result type> <collective>(` — the result type is everything
+# between '= ' and the op name; matching on the instruction form keeps
+# op_name metadata strings from false-matching
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<type>(?:\([^)]*\)|[a-z][a-z0-9]*\[[^\]]*\][^\s]*))\s*"
+    r"(?P<op>" + "|".join(re.escape(o) for o in COLLECTIVE_OPS) + r")"
+    r"(?P<suffix>-start|-done)?\(")
+
+
+def _eqn_location(eqn):
+    """(file, line) of the traced user code for one equation, best
+    effort (source info is jax-internal; absent on synthesized eqns)."""
+    try:
+        frame = eqn.source_info.traceback.frames[0]
+        return frame.file_name, frame.start_line
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return None, None
+
+
+def iter_eqns(jaxpr):
+    """Yield every equation in ``jaxpr`` including nested sub-jaxprs
+    (pjit bodies, scan/while bodies, cond branches, remat, custom_vjp)."""
+    import jax
+
+    def _walk(jxp):
+        for eqn in jxp.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                items = v if isinstance(v, (list, tuple)) else (v,)
+                for item in items:
+                    if isinstance(item, jax.core.ClosedJaxpr):
+                        yield from _walk(item.jaxpr)
+                    elif isinstance(item, jax.core.Jaxpr):
+                        yield from _walk(item)
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    return _walk(inner)
+
+
+def find_callbacks(closed_jaxpr):
+    """``graph-callback`` findings for every host-callback equation."""
+    out = []
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES:
+            fname, line = _eqn_location(eqn)
+            out.append(Finding(
+                "graph-callback",
+                "host callback %r inside the jitted step — a per-step "
+                "host sync point (move it out of the step or behind a "
+                "deferred metric/guard carry)" % name,
+                file=fname, line=line))
+    return out
+
+
+def audit_dtype(closed_jaxpr, compute_dtype):
+    """``graph-dtype-drift``: dot/conv eqns whose inputs are wider floats
+    than the declared compute dtype.  Returns (findings, tally) where
+    tally maps primitive name -> {dtype_name: count} for reporting."""
+    import numpy as np
+    tally = {}
+    offenders = []
+    compute_dtype = np.dtype(compute_dtype) if compute_dtype else None
+    wider = _WIDER_THAN.get(compute_dtype.name, ()) if compute_dtype \
+        else ()
+    for eqn in iter_eqns(closed_jaxpr):
+        name = eqn.primitive.name
+        if name not in _COMPUTE_PRIMITIVES:
+            continue
+        in_dtypes = sorted({str(v.aval.dtype) for v in eqn.invars
+                            if hasattr(v, "aval")
+                            and hasattr(v.aval, "dtype")})
+        slot = tally.setdefault(name, {})
+        for d in in_dtypes:
+            slot[d] = slot.get(d, 0) + 1
+        if wider and any(d in wider for d in in_dtypes):
+            offenders.append((eqn, in_dtypes))
+    findings = []
+    if offenders:
+        fname, line = _eqn_location(offenders[0][0])
+        findings.append(Finding(
+            "graph-dtype-drift",
+            "%d dot/conv equation(s) compute in %s inside a "
+            "compute_dtype=%s step (first at the reported location) — "
+            "a widening cast upstream is defeating the mixed-precision "
+            "path" % (len(offenders),
+                      "/".join(sorted({d for _, ds in offenders
+                                       for d in ds if d in wider})),
+                      compute_dtype.name),
+            file=fname, line=line,
+            data={"offending_eqns": len(offenders)}))
+    return findings, tally
+
+
+def _leaf_bytes(shape, dtype):
+    import numpy as np
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def _leading_argnum(path):
+    """Positional index of the top-level argument a leaf path belongs
+    to.  ``args_info`` is the ``(args, kwargs)`` pair, so a positional
+    leaf's path is ``[0][argnum]...`` — the argnum is the SECOND key;
+    kwargs leaves (path ``[1][name]...``) have no argnum."""
+    try:
+        if getattr(path[0], "idx", None) != 0:
+            return None
+        return getattr(path[1], "idx", None)
+    except Exception:  # noqa: BLE001 — unexpected path shape
+        return None
+
+
+def audit_donation(lowered, min_bytes=1 << 20, carry_argnums=None):
+    """Donation findings from a ``jax.stages.Lowered``'s arg/out info.
+
+    An argument leaf is a *carry* when some output leaf has its exact
+    (shape, dtype) — params vs updated params, accumulators vs updated
+    accumulators.  Carries at or above ``min_bytes`` must be donated
+    (``graph-donation-missing``); donated leaves that match no output
+    cannot alias anywhere and are flagged ``graph-donation-unused``.
+    Output slots are consumed greedily by donated args first, so a
+    non-donated copy of an already-claimed output does not double-count.
+
+    ``carry_argnums``: when the caller knows which positional arguments
+    hold the step's carries (SPMDTrainer: params/aux/opt_state/extras),
+    the missing-donation check is restricted to leaves under them — a
+    DATA batch that happens to share an output's shape/dtype (an
+    autoencoder's reconstruction, a per-example loss matching the label
+    vector) must not be flagged as an un-donated carry.
+    """
+    import jax.tree_util as jtu
+
+    arg_leaves = [(jtu.keystr(path), _leading_argnum(path), info)
+                  for path, info in
+                  jtu.tree_flatten_with_path(lowered.args_info)[0]]
+    out_slots = {}
+    for info in jtu.tree_leaves(lowered.out_info):
+        key = (tuple(info.shape), str(info.dtype))
+        out_slots[key] = out_slots.get(key, 0) + 1
+
+    findings = []
+    donated = [(p, i) for p, n, i in arg_leaves if i.donated]
+    undonated = [(p, n, i) for p, n, i in arg_leaves if not i.donated]
+    for path, info in donated:
+        key = (tuple(info.shape), str(info.dtype))
+        if out_slots.get(key, 0) > 0:
+            out_slots[key] -= 1
+        else:
+            findings.append(Finding(
+                "graph-donation-unused",
+                "argument %s (%s%s, %d bytes) is donated but matches no "
+                "output — XLA cannot alias it, the donation is wasted "
+                "and the caller's buffer is invalidated anyway"
+                % (path, info.dtype, list(info.shape),
+                   _leaf_bytes(info.shape, info.dtype))))
+    for path, argnum, info in undonated:
+        if carry_argnums is not None and argnum not in carry_argnums:
+            continue
+        nbytes = _leaf_bytes(info.shape, info.dtype)
+        if nbytes < min_bytes:
+            continue
+        key = (tuple(info.shape), str(info.dtype))
+        if out_slots.get(key, 0) > 0:
+            out_slots[key] -= 1
+            findings.append(Finding(
+                "graph-donation-missing",
+                "argument %s (%s%s, %d bytes) looks like a carry (an "
+                "output has the same shape/dtype) but is not donated — "
+                "the step pays an avoidable HBM copy and holds two "
+                "copies live" % (path, info.dtype, list(info.shape),
+                                 nbytes)))
+    return findings
+
+
+def collective_stats(hlo_text):
+    """Tally cross-device traffic in compiled (post-SPMD) HLO text.
+
+    Returns ``{op: {"count": n, "bytes": b}}`` where ``bytes`` sums each
+    instruction's per-device OUTPUT bytes (the shard this device
+    materializes; async ``-start`` forms count once, ``-done`` not at
+    all).  A sync instruction with a tuple result is a fused multi-tensor
+    collective, so its shapes SUM.  An async ``-start`` result tuple is
+    ``(operand-alias, result, context...)``: the payload is the RESULT —
+    the largest shape for gathers (result = N x operand), the
+    second-largest for reduce-scatter (result = operand / N; the tiny
+    context buffers rank below both), and either of the two for the
+    size-preserving ops.  A byte figure of 0 with nonzero count means
+    shapes were unparseable (report still useful for counts).
+    """
+    stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        stats[op]["count"] += 1
+        sizes = []
+        for dtype, dims in _SHAPE_RE.findall(m.group("type")):
+            if dtype not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in filter(None, dims.split(",")):
+                n *= int(d)
+            sizes.append(n * _DTYPE_BYTES[dtype])
+        if sizes:
+            if m.group("suffix") != "-start":
+                nbytes = sum(sizes)
+            else:
+                ranked = sorted(sizes, reverse=True)
+                if op == "reduce-scatter" and len(ranked) > 1:
+                    nbytes = ranked[1]
+                else:
+                    nbytes = ranked[0]
+            stats[op]["bytes"] += nbytes
+    return {op: s for op, s in stats.items() if s["count"]}
+
+
+def audit_collectives(stats, param_bytes=None, expect_allgather=False,
+                      allgather_fraction=0.5):
+    """``graph-collective-allgather``: all-gather traffic in a step that
+    declared replicated parameters (plain dp 'allreduce') — GSPMD only
+    emits one when something un-replicated sneaks into the param path.
+    With ``param_bytes`` given, only traffic >= ``allgather_fraction`` of
+    it flags (an incidental small gather is not a regather storm);
+    without it, any all-gather flags."""
+    if expect_allgather:
+        return []
+    ag = stats.get("all-gather", {"count": 0, "bytes": 0})
+    if not ag["count"]:
+        return []
+    if param_bytes and ag["bytes"] < allgather_fraction * param_bytes:
+        return []
+    detail = "%d all-gather(s), %d bytes/step per device" \
+        % (ag["count"], ag["bytes"])
+    if param_bytes:
+        detail += " (params total %d bytes)" % param_bytes
+    return [Finding(
+        "graph-collective-allgather",
+        "unexpected all-gather under a sharding that declares replicated "
+        "parameters: %s — a full-parameter regather erases the point of "
+        "dp sharding (check param_shardings / with_sharding_constraint "
+        "placement)" % detail,
+        data={"all_gather": ag, "param_bytes": param_bytes})]
+
+
+def lint_lowered(lowered, closed_jaxpr=None, compute_dtype=None,
+                 param_bytes=None, expect_allgather=True,
+                 min_donate_bytes=1 << 20, carry_argnums=None,
+                 compiled_text=None):
+    """Run every graph rule against one lowered step.
+
+    ``lowered`` is a ``jax.stages.Lowered``;  ``closed_jaxpr`` enables
+    the callback/dtype rules (pass ``jax.make_jaxpr(fn)(*args)``);
+    ``compiled_text`` skips the internal ``lowered.compile()`` when the
+    caller already has the executable.  Returns a :class:`Report` whose
+    ``stats["collectives"]`` always carries the audit tally (bench reads
+    it even when nothing flags).
+    """
+    rep = Report(tool="mxlint.graph")
+    rep.extend(audit_donation(lowered, min_bytes=min_donate_bytes,
+                              carry_argnums=carry_argnums))
+    if closed_jaxpr is not None:
+        rep.extend(find_callbacks(closed_jaxpr))
+        if compute_dtype is not None:
+            findings, tally = audit_dtype(closed_jaxpr, compute_dtype)
+            rep.extend(findings)
+            rep.stats["compute_eqn_dtypes"] = tally
+    if compiled_text is None:
+        compiled_text = lowered.compile().as_text()
+    stats = collective_stats(compiled_text)
+    rep.stats["collectives"] = stats
+    rep.extend(audit_collectives(stats, param_bytes=param_bytes,
+                                 expect_allgather=expect_allgather))
+    return rep
+
+
+def lint_jit(fn, *args, donate_argnums=(), compute_dtype=None,
+             param_bytes=None, expect_allgather=True,
+             min_donate_bytes=1 << 20, **kwargs):
+    """Convenience wrapper: jit + lower + trace ``fn`` and lint it.
+
+    ``fn`` may already be jitted (then ``donate_argnums`` is ignored —
+    the jit's own settings win).  Example::
+
+        report = lint_jit(step, params, batch, donate_argnums=(0,),
+                          expect_allgather=False)
+        assert report.ok, report.format_text()
+    """
+    import jax
+    jf = fn if hasattr(fn, "lower") else \
+        jax.jit(fn, donate_argnums=donate_argnums)
+    lowered = jf.lower(*args, **kwargs)
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return lint_lowered(lowered, closed_jaxpr=closed,
+                        compute_dtype=compute_dtype,
+                        param_bytes=param_bytes,
+                        expect_allgather=expect_allgather,
+                        min_donate_bytes=min_donate_bytes)

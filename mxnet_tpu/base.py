@@ -13,13 +13,18 @@ components (see mxnet_tpu/native/).
 from __future__ import annotations
 
 import ast
+import logging
 import os
 import threading
+from collections import namedtuple
 
 __all__ = [
     "MXNetError", "MXTPUError", "get_env", "Registry", "parse_attr_value",
     "string_types", "numeric_types", "classproperty",
+    "EnvSpec", "ENV_REGISTRY", "register_env", "registered_env_names",
 ]
+
+_LOG = logging.getLogger(__name__)
 
 string_types = (str,)
 numeric_types = (int, float)
@@ -38,13 +43,68 @@ _TRUE_STRINGS = frozenset(("1", "true", "yes", "on"))
 _FALSE_STRINGS = frozenset(("0", "false", "no", "off"))
 
 
+class EnvSpec(namedtuple("EnvSpec", ["name", "default", "doc", "scope"])):
+    """One registered runtime knob.  ``scope`` records who reads it:
+    ``runtime`` (the package), ``test`` (the test harness), ``tools``
+    (launch/supervise/mxlint CLIs) — documentation metadata, not an
+    access control."""
+
+
+#: The single catalog of every ``MXTPU_*``/``MXNET_*`` knob this codebase
+#: reads.  All env access goes through :func:`get_env` (enforced by
+#: ``tools/mxlint.py``'s ``env-unregistered``/``env-direct-read`` rules),
+#: and every registered MXTPU_* name must have a row in
+#: ``docs/env_vars.md`` (asserted by tests/test_analysis.py) — so a knob
+#: cannot be added, typo'd, or dropped without the analyzer noticing.
+ENV_REGISTRY = {}
+
+
+def register_env(name, default=None, doc="", scope="runtime"):
+    """Register one env knob; returns ``name`` so call sites can do
+    ``ENV_FOO = register_env("MXTPU_FOO", ...)``.
+
+    Default precedence: a ``get_env`` call that passes its own default
+    wins (sites do this deliberately — a STRING default keeps garbage
+    values like ``MXTPU_STEP_GUARD=maybe`` readable instead of raising
+    in ``int()``); the default registered here applies only when the
+    site passes none, and otherwise serves as the documented value the
+    docs table mirrors."""
+    ENV_REGISTRY[name] = EnvSpec(name, default, doc, scope)
+    return name
+
+
+def registered_env_names(prefix=None, scope=None):
+    """Registered knob names, optionally filtered by prefix/scope."""
+    return sorted(
+        n for n, s in ENV_REGISTRY.items()
+        if (prefix is None or n.startswith(prefix))
+        and (scope is None or s.scope == scope))
+
+
+_WARNED_UNREGISTERED = set()
+
+
 def get_env(name, default=None, typ=None):
     """Read a runtime config env var (dmlc::GetEnv analog).
 
     Supported vars follow the reference's catalog (docs/how_to/env_var.md)
     with an ``MXNET_`` prefix, e.g. ``MXNET_ENGINE_TYPE``,
-    ``MXNET_EXEC_BULK_EXEC_TRAIN``.
+    ``MXNET_EXEC_BULK_EXEC_TRAIN``; TPU-era knobs use ``MXTPU_``.  Every
+    framework-prefixed name must be in :data:`ENV_REGISTRY` — an
+    unregistered read warns once (and is a static-analysis finding, see
+    tools/mxlint.py), because a typo'd knob silently reading its default
+    is exactly the failure mode the registry exists to catch.
     """
+    if name.startswith(("MXTPU_", "MXNET_")) and name not in ENV_REGISTRY \
+            and name not in _WARNED_UNREGISTERED:
+        _WARNED_UNREGISTERED.add(name)
+        _LOG.warning("env var %s is not registered in base.ENV_REGISTRY — "
+                     "typo, or a knob missing from the catalog "
+                     "(docs/env_vars.md)?", name)
+    if default is None and name in ENV_REGISTRY:
+        # the registered default is authoritative when the call site
+        # doesn't override it — one place to change a knob's default
+        default = ENV_REGISTRY[name].default
     raw = os.environ.get(name)
     if raw is None:
         return default
@@ -152,3 +212,15 @@ class _ThreadLocalStack(threading.local):
 def check_call(ret):  # pragma: no cover - API-parity shim
     """No-op kept for source compatibility with reference-style code."""
     return ret
+
+
+# -- knobs owned by the package root / the test harness (modules register
+# their own next to the code that reads them; see ENV_REGISTRY)
+ENV_COMPILE_CACHE = register_env(
+    "MXTPU_COMPILE_CACHE",
+    doc="Directory for XLA's persistent compilation cache (wired to "
+        "jax_compilation_cache_dir at package import)")
+ENV_TEST_PLATFORM = register_env(
+    "MXTPU_TEST_PLATFORM", default="cpu", scope="test",
+    doc="Test-suite platform: cpu = 8-device virtual mesh, tpu = real "
+        "chip (read by tests/conftest.py and bench tooling)")

@@ -25,21 +25,26 @@ TPU pods they ride ICI/DCN.  Either way the graph is the same jitted HLO.
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env, register_env
 
 __all__ = ["initialize", "is_initialized", "rank", "num_workers",
            "Collective", "barrier", "agree_flag"]
 
 _INITIALIZED = False
 
-ENV_COORDINATOR = "MXTPU_COORDINATOR"
-ENV_NUM_WORKERS = "MXTPU_NUM_WORKERS"
-ENV_RANK = "MXTPU_WORKER_RANK"
-ENV_PLATFORM = "MXTPU_PLATFORM"
+ENV_COORDINATOR = register_env(
+    "MXTPU_COORDINATOR", scope="tools",
+    doc="host:port of the jax.distributed coordinator (set by "
+        "tools/launch.py)")
+ENV_NUM_WORKERS = register_env(
+    "MXTPU_NUM_WORKERS", scope="tools", doc="Process count")
+ENV_RANK = register_env(
+    "MXTPU_WORKER_RANK", scope="tools", doc="This process's rank")
+ENV_PLATFORM = register_env(
+    "MXTPU_PLATFORM", scope="tools",
+    doc="Force a JAX platform in workers (cpu for the virtual cluster)")
 
 
 def is_initialized():
@@ -100,12 +105,12 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         import multiprocessing
         if multiprocessing.current_process().name != "MainProcess":
             return
-    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    coordinator_address = coordinator_address or get_env(ENV_COORDINATOR)
     if num_processes is None:
-        num_processes = int(os.environ.get(ENV_NUM_WORKERS, "0") or 0)
+        num_processes = int(get_env(ENV_NUM_WORKERS, "0") or 0)
     if process_id is None:
-        process_id = int(os.environ.get(ENV_RANK, "-1") or -1)
-    platform = platform or os.environ.get(ENV_PLATFORM)
+        process_id = int(get_env(ENV_RANK, "-1") or -1)
+    platform = platform or get_env(ENV_PLATFORM)
     if not coordinator_address or num_processes <= 1:
         return  # single-process; nothing to join
     if process_id < 0:
@@ -128,7 +133,6 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     # / MXTPU_INIT_TIMEOUT (per-attempt coordination-service timeout),
     # logging every attempt — the elastic-bring-up discipline the ps-lite
     # tracker got from its own van retries.
-    from .base import get_env
     from .resilience import retry, ENV_INIT_RETRIES, ENV_INIT_TIMEOUT, \
         ENV_INIT_BACKOFF
     attempts = int(get_env(ENV_INIT_RETRIES, "3"))
@@ -332,6 +336,61 @@ def _hb_observed(client):
     return _HB_OBSERVED
 
 
+#: non-blocking KV read surfaces across jax builds, best first: some
+#: DistributedRuntimeClient builds expose ``key_value_try_get``, others
+#: only a prefix scan (``key_value_dir_get``) or the blocking get.  The
+#: heartbeat OBSERVER must work on all of them — on a build where no
+#: surface exists, liveness reads honestly report "unknown" and
+#: ``heartbeat_supported()`` lets callers (tests/dist drills) probe for
+#: the capability instead of mis-reading dead=0 forever.
+def _hb_stamps(client):
+    """rank -> raw stamp for every rank currently published, or None
+    when this client exposes no usable read surface."""
+    if hasattr(client, "key_value_try_get"):
+        out = {}
+        for r in range(num_workers()):
+            try:
+                out[r] = client.key_value_try_get(_HB_PREFIX + str(r))
+            except Exception:  # noqa: BLE001 — not yet written
+                pass
+        return out
+    if hasattr(client, "key_value_dir_get"):
+        out = {}
+        try:
+            items = client.key_value_dir_get(_HB_PREFIX)
+        except Exception:  # noqa: BLE001 — nothing published yet
+            return out
+        for key, value in items:
+            tail = str(key).rsplit("/", 1)[-1]
+            if tail.isdigit():
+                out[int(tail)] = value
+        return out
+    if hasattr(client, "blocking_key_value_get"):
+        out = {}
+        for r in range(num_workers()):
+            try:
+                out[r] = client.blocking_key_value_get(
+                    _HB_PREFIX + str(r), 50)
+            except Exception:  # noqa: BLE001 — missing key times out
+                pass
+        return out
+    return None
+
+
+def heartbeat_supported():
+    """True when this process can both publish and OBSERVE heartbeats
+    (jax builds vary in which coordinator-KV read methods the client
+    exposes; without any, ``num_dead_nodes`` can never see a stale
+    stamp).  False outside a joined process group."""
+    client = _kv_client()
+    if client is None:
+        return False
+    return hasattr(client, "key_value_set") and any(
+        hasattr(client, m) for m in
+        ("key_value_try_get", "key_value_dir_get",
+         "blocking_key_value_get"))
+
+
 def heartbeat_ages():
     """rank -> seconds since its heartbeat value was last seen to change,
     measured on the local monotonic clock.  None = unknown: either never
@@ -344,13 +403,15 @@ def heartbeat_ages():
         return {}
     obs = _hb_observed(client)
     now = _time.monotonic()
+    stamps = _hb_stamps(client)
+    if stamps is None:
+        return {r: None for r in range(num_workers())}
     ages = {}
     for r in range(num_workers()):
-        try:
-            stamp = client.key_value_try_get(_HB_PREFIX + str(r))
-        except Exception:  # noqa: BLE001 — not yet written
+        if r not in stamps:
             ages[r] = None
             continue
+        stamp = stamps[r]
         prev = obs.get(r)
         if prev is None:
             obs[r] = (stamp, now, True)

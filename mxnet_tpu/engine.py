@@ -31,7 +31,12 @@ import traceback
 from collections import deque
 
 from . import native
-from .base import MXNetError, get_env
+from .base import MXNetError, get_env, register_env
+
+ENV_ENGINE_TYPE = register_env(
+    "MXNET_ENGINE_TYPE", default="ThreadedEngine",
+    doc="Host dependency engine; NaiveEngine serializes every op on the "
+        "caller thread for debugging")
 
 __all__ = ["Engine", "get", "set_engine_type", "EngineVar"]
 
@@ -354,7 +359,7 @@ class Engine(object):
 
     def __new__(cls, engine_type=None, num_workers=0, force_python=False):
         if engine_type is None:
-            engine_type = get_env("MXNET_ENGINE_TYPE", "ThreadedEngine")
+            engine_type = get_env(ENV_ENGINE_TYPE, "ThreadedEngine")
         naive = "naive" in engine_type.lower()
         if not force_python and native.get_lib() is not None:
             inst = _NativeEngine(naive=naive, num_workers=num_workers)

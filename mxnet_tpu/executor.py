@@ -28,7 +28,15 @@ import jax
 import jax.numpy as jnp
 
 from . import random as _random
-from .base import MXNetError
+from .base import MXNetError, register_env
+
+ENV_BACKWARD_DO_MIRROR = register_env(
+    "MXNET_BACKWARD_DO_MIRROR", default=0,
+    doc="1 = memory mirror mode: the backward rematerializes activations "
+        "per checkpoint segment instead of storing them")
+ENV_MIRROR_SEGMENTS = register_env(
+    "MXNET_MIRROR_SEGMENTS",
+    doc="Segment count for mirror mode (default sqrt of op count)")
 from .context import Context, current_context
 from .ndarray import NDArray, zeros as nd_zeros
 from .ops.registry import OpDef
@@ -151,10 +159,10 @@ def mirror_segments_for(symbol, force=False):
     param); MXNET_MIRROR_SEGMENTS overrides the sqrt-of-op-count
     default."""
     from .base import get_env
-    if not force and str(get_env("MXNET_BACKWARD_DO_MIRROR", "0")) != "1":
+    if not force and str(get_env(ENV_BACKWARD_DO_MIRROR, "0")) != "1":
         return 0
     n_ops = sum(1 for nd_ in symbol._nodes() if nd_.op is not None)
-    return max(2, int(get_env("MXNET_MIRROR_SEGMENTS",
+    return max(2, int(get_env(ENV_MIRROR_SEGMENTS,
                               int(np.sqrt(max(1, n_ops))))))
 
 

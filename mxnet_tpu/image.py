@@ -25,7 +25,22 @@ import numpy as np
 from . import io as mxio
 from . import ndarray as nd
 from . import recordio
-from .base import MXNetError, get_env
+from .base import MXNetError, get_env, register_env
+
+ENV_UPLOAD_THREADS = register_env(
+    "MXNET_UPLOAD_THREADS", default=4,
+    doc="Device-upload thread-pool size for batched host->device copies")
+ENV_JPEG_DECODE_FAST = register_env(
+    "MXNET_JPEG_DECODE_FAST", default=1,
+    doc="0 switches the native training decode from the fast SIMD IDCT "
+        "to exact byte-parity with cv2")
+ENV_RECORDITER_NATIVE = register_env(
+    "MXNET_RECORDITER_NATIVE", default=1,
+    doc="0 disables the native libjpeg decode pipeline in ImageRecordIter")
+ENV_RECORDITER_PROCS = register_env(
+    "MXNET_RECORDITER_PROCS", default=1,
+    doc="0 disables the process-parallel decode pipeline in "
+        "ImageRecordIter")
 
 
 # ---------------------------------------------------------------------------
@@ -860,7 +875,7 @@ class _NativePipeline(_AsyncPipeline):
     #: transfer latency at fine batch sizes even when bandwidth is ample),
     #: so uploads run on a small pool with order-preserving delivery.
     #: MXNET_UPLOAD_THREADS overrides (1 = serial uploads on the pool).
-    UPLOAD_THREADS = int(get_env("MXNET_UPLOAD_THREADS", "4"))
+    UPLOAD_THREADS = int(get_env(ENV_UPLOAD_THREADS, "4"))
 
     def __init__(self, it, data_shape, batch_size, label_width, aug_kwargs,
                  num_workers, prefetch, dtype, layout="NCHW", seed=0,
@@ -956,7 +971,7 @@ class _NativePipeline(_AsyncPipeline):
         # throughput, within +-2 of the exact output — augmentation noise
         # dwarfs it); MXNET_JPEG_DECODE_FAST=0 restores byte parity with
         # cv2 (the mx.nd.imdecode op is always exact)
-        fast_dct = get_env("MXNET_JPEG_DECODE_FAST", "1") != "0"
+        fast_dct = get_env(ENV_JPEG_DECODE_FAST, "1") != "0"
         self._pipe = lib.MXTPUImgPipeCreate(
             nthreads, h, w, int(aug_kwargs.get("resize", 0) or 0),
             1 if aug_kwargs.get("rand_crop") else 0,
@@ -1186,7 +1201,7 @@ class ImageRecordIter(mxio.DataIter):
         # first record looks like JPEG (PNG/BMP .rec files take the cv2
         # paths — libjpeg cannot decode them).
         if (not has_custom_augs
-                and get_env("MXNET_RECORDITER_NATIVE", "1") != "0"
+                and get_env(ENV_RECORDITER_NATIVE, "1") != "0"
                 and set(aug_kwargs) <= _NativePipeline.SUPPORTED
                 and _rec_looks_jpeg(path_imgrec)):
             try:
@@ -1222,7 +1237,7 @@ class ImageRecordIter(mxio.DataIter):
         # REPL/stdin only the inline reader-thread mode is available
         spawnable_main = main_file is not None and os.path.exists(main_file)
         use_pipeline = (not has_custom_augs
-                        and get_env("MXNET_RECORDITER_PROCS", "1") != "0")
+                        and get_env(ENV_RECORDITER_PROCS, "1") != "0")
         if self._pipeline is None and use_pipeline:
             self._pipeline = _ProcessPipeline(
                 self._it, tuple(data_shape), batch_size, label_width,

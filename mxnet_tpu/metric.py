@@ -6,6 +6,7 @@ import math
 import numpy as _numpy
 
 from .base import MXNetError, Registry
+from .base import register_env as _register_env
 from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
@@ -434,10 +435,16 @@ class SkippedSteps(EvalMetric):
 
 #: fold the device-side accumulators into the host metric every N
 #: ``update_metric`` calls; 0 (default) folds only at epoch end / on get()
-ENV_METRIC_INTERVAL = "MXTPU_METRIC_INTERVAL"
+ENV_METRIC_INTERVAL = _register_env(
+    "MXTPU_METRIC_INTERVAL", default=0,
+    doc="Fold deferred in-graph train-metric accumulators into the host "
+        "metric every N update_metric calls (0 = on reads only)")
 #: "1" disables deferred metrics entirely — every step updates the host
 #: metric from fetched outputs (the exact-parity blocking mode for tests)
-ENV_METRIC_BLOCKING = "MXTPU_METRIC_BLOCKING"
+ENV_METRIC_BLOCKING = _register_env(
+    "MXTPU_METRIC_BLOCKING", default=0,
+    doc="1 disables deferred metrics: every step updates the host metric "
+        "from fetched outputs (exact-parity mode for tests)")
 
 
 def try_install_deferred(trainer, metric):

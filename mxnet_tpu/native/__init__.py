@@ -149,6 +149,12 @@ def _configure(lib):
 
 ENGINE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
+from ..base import get_env, register_env  # noqa: E402 — after ctypes setup
+
+ENV_NO_NATIVE = register_env(
+    "MXNET_NO_NATIVE", default=0,
+    doc="1 disables the native C runtime entirely (pure-Python fallbacks)")
+
 
 def get_lib():
     """Return the configured ctypes library, or None if unavailable."""
@@ -159,7 +165,7 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("MXNET_NO_NATIVE", "0") == "1":
+        if str(get_env(ENV_NO_NATIVE, "0")) == "1":
             return None
         if _stale() and not _build():
             return None

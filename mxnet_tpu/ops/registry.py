@@ -24,7 +24,12 @@ from __future__ import annotations
 
 import functools
 
-from ..base import MXNetError, parse_attr_value
+from ..base import MXNetError, parse_attr_value, register_env
+
+ENV_CUSTOM_UNDER_JIT = register_env(
+    "MXNET_CUSTOM_UNDER_JIT", default=0,
+    doc="1 lets graphs with Custom (host-callback) ops be whole-graph "
+        "jitted; default runs them eagerly per-op")
 
 __all__ = ["OpDef", "register", "get_op", "list_ops", "OP_REGISTRY", "apply_op"]
 
@@ -254,7 +259,7 @@ def callbacks_under_jit_supported():
     The env var is read per call (only the backend probe is cached), so
     toggling it mid-process takes effect at the next bind."""
     from ..base import get_env
-    if str(get_env("MXNET_CUSTOM_UNDER_JIT", "0")) != "1":
+    if str(get_env(ENV_CUSTOM_UNDER_JIT, "0")) != "1":
         return False
     return _callback_probe()
 

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import optimizer as opt_mod
-from ..base import MXNetError
+from ..base import MXNetError, register_env
 from ..executor import _build_eval
 from ..ndarray import NDArray
 from ..io import DataDesc
@@ -36,6 +36,11 @@ __all__ = ["SPMDTrainer", "SUPPORTED_OPTIMIZERS",
 # optimizers with an in-graph update rule (_apply_update); Module's fused
 # path consults this before engaging
 SUPPORTED_OPTIMIZERS = ("sgd", "ccsgd", "adam", "rmsprop")
+
+ENV_GRAD_SYNC = register_env(
+    "MXNET_GRAD_SYNC", default="allreduce",
+    doc="Gradient sync for the fused dp step: allreduce (replicated "
+        "params) or zero (ZeRO/FSDP weight-sharded data parallelism)")
 
 #: guard-counter flush cadence when deferred metrics are installed with no
 #: explicit MXTPU_METRIC_INTERVAL (interval 0 = fold metrics on reads
@@ -71,6 +76,13 @@ def _spec_for(name, shape, rules):
 class SPMDTrainer(object):
     """Fused sharded training step for a Symbol + Optimizer."""
 
+    #: argnums of ``step(params, aux, opt_state, extras, ...)`` donated
+    #: to XLA so the whole carry updates in place in HBM.  A class
+    #: attribute so the static analyzer's fixture trainers can seed a
+    #: donation violation (tests/test_analysis.py) — production code
+    #: must not override it.
+    DONATE_ARGNUMS = (0, 1, 2, 3)
+
     def __init__(self, symbol, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
                  compute_dtype=None, remat=None, input_transforms=None,
@@ -100,7 +112,7 @@ class SPMDTrainer(object):
         #     replicated values are read locally) would deadlock; gather
         #     on every rank, then write from rank 0 only.
         if grad_sync is None:
-            grad_sync = get_env("MXNET_GRAD_SYNC", "allreduce")
+            grad_sync = get_env(ENV_GRAD_SYNC, "allreduce")
         if grad_sync not in ("allreduce", "zero"):
             raise MXNetError("grad_sync must be 'allreduce' or 'zero', "
                              "got %r" % (grad_sync,))
@@ -110,7 +122,8 @@ class SPMDTrainer(object):
         # remat/mirror: rematerialize the forward inside the backward
         # (reference MXNET_BACKWARD_DO_MIRROR memory mode)
         if remat is None:
-            remat = str(get_env("MXNET_BACKWARD_DO_MIRROR", "0")) == "1"
+            from ..executor import ENV_BACKWARD_DO_MIRROR
+            remat = str(get_env(ENV_BACKWARD_DO_MIRROR, "0")) == "1"
         self.remat = bool(remat)
         # a mesh spanning several processes (multi-host cluster joined via
         # distributed.initialize) switches placement to the global-array
@@ -531,8 +544,17 @@ class SPMDTrainer(object):
         # _shard_batch) — GSPMD partitions the step and inserts collectives.
         # Donation lets params/opt-state (and the guard/metric carries in
         # ``extras``) update in place in HBM.
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._step_raw = step  # analyzers make_jaxpr the unjitted step
+        self._step_fn = jax.jit(step, donate_argnums=self.DONATE_ARGNUMS)
         self._eval_fn = jax.jit(eval_step, static_argnums=(4,))
+        # MXTPU_ANALYZE bookkeeping: jit compiles one program PER input
+        # shape signature (a partial final batch retraces), and every
+        # compiled program gets its own lint — keyed by signature, not a
+        # single bool, so strict mode cannot be bypassed by a shape
+        # variant.  _analyze_off caches "env says no" after the first
+        # look so the steady-state step pays one attribute check.
+        self._analyzed_keys = set()
+        self._analyze_off = False
 
     # -- public API --------------------------------------------------------
     def stage_batch(self, *batch_arrays):
@@ -663,12 +685,23 @@ class SPMDTrainer(object):
                 self._metric_acc = (self._scalar_acc(0.0, np.float32),
                                     self._scalar_acc(0.0, np.float32))
             extras["metric"] = self._metric_acc
-        self.params, self.aux, self.opt_state, extras, outs = \
-            self._step_fn(
-                self.params, self.aux, self.opt_state, extras, data, key,
+        args = (self.params, self.aux, self.opt_state, extras, data, key,
                 jnp.asarray(lr, jnp.float32),
                 jnp.asarray(self.optimizer.wd, jnp.float32),
                 self._num_update)
+        if not self._analyze_off:
+            # MXTPU_ANALYZE: lint each newly compiled program (one per
+            # input-shape signature) BEFORE its first dispatch — strict
+            # mode must refuse to run a step that violates the graph
+            # invariants, including a retraced partial-batch variant
+            sig = tuple(sorted(
+                (k, tuple(v.shape), str(getattr(v, "dtype", "")))
+                for k, v in data.items()))
+            if sig not in self._analyzed_keys:
+                self._analyzed_keys.add(sig)
+                self._maybe_env_analyze(args)
+        self.params, self.aux, self.opt_state, extras, outs = \
+            self._step_fn(*args)
         if self.step_guard:
             self._guard_acc = extras["guard"]
             self._guard_pending = True
@@ -964,6 +997,105 @@ class SPMDTrainer(object):
             self.set_states(states)
         return epoch
 
+    # -- static analysis (mxlint graph level) ------------------------------
+    def _expects_allgather(self):
+        """Whether the declared sharding legitimately all-gathers: under
+        grad_sync='zero' (or any non-replicated param, e.g. tp rules)
+        the step gathers params by design; under plain dp 'allreduce'
+        every all-gather is a regression."""
+        if self.mesh is None:
+            return False
+        if self._zero:
+            return True
+        return any(
+            self._param_spec(n, self.arg_shapes[n]) != P()
+            for n in self.param_names)
+
+    def _lint_args(self, args, min_donate_bytes=0):
+        """Run the graph lint against this trainer's compiled step with
+        the given (fully assembled) argument tuple."""
+        import jax
+        from ..analysis import graph_lint
+        lowered = self._step_fn.lower(*args)
+        closed = jax.make_jaxpr(self._step_raw)(*args)
+        param_bytes = sum(
+            int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+            for v in self.params.values())
+        return graph_lint.lint_lowered(
+            lowered, closed_jaxpr=closed,
+            compute_dtype=self.compute_dtype,
+            param_bytes=param_bytes,
+            expect_allgather=self._expects_allgather(),
+            min_donate_bytes=min_donate_bytes,
+            # the step's carries live in args 0-3 (params/aux/opt_state/
+            # extras) BY SIGNATURE — restricting the missing-donation
+            # check to them keeps a data batch that happens to share an
+            # output's shape/dtype (autoencoder reconstructions,
+            # per-example losses) from being flagged as a carry
+            carry_argnums=(0, 1, 2, 3))
+
+    def analyze(self, *batch_arrays, min_donate_bytes=0):
+        """Lint the fused step against one example batch (raw arrays in
+        ``input_names`` order, or a StagedBatch) and return the
+        :class:`~mxnet_tpu.analysis.report.Report`.
+
+        Checks: every param/opt-state/guard/metric carry is donated
+        (``min_donate_bytes=0`` — in THIS step's signature every carry
+        should be donated regardless of size), no host callbacks, the
+        collective audit (``report.stats['collectives']`` carries
+        count+bytes even when nothing flags — bench.py's ``analyze``
+        metric reads it), and dtype drift under ``compute_dtype``.
+        Traces and compiles the step once; with a warm persistent
+        compile cache (MXTPU_COMPILE_CACHE) the XLA work is reused."""
+        from .. import random as _random
+        if self._step_fn is None or self.params is None:
+            raise MXNetError(
+                "SPMDTrainer.analyze: bind() and init_params() first")
+        data = self._eval_batch(batch_arrays)
+        extras = {}
+        if self.step_guard:
+            extras["guard"] = self._guard_acc or (
+                self._scalar_acc(0, np.int32),
+                self._scalar_acc(0, np.int32),
+                self._scalar_acc(0, np.int32))
+        if self._metric_fn is not None:
+            extras["metric"] = self._metric_acc or (
+                self._scalar_acc(0.0, np.float32),
+                self._scalar_acc(0.0, np.float32))
+        args = (self.params, self.aux, self.opt_state, extras, data,
+                _random.peek_key(),
+                jnp.asarray(self.optimizer.lr, jnp.float32),
+                jnp.asarray(self.optimizer.wd, jnp.float32),
+                self._num_update + 1)
+        return self._lint_args(args, min_donate_bytes=min_donate_bytes)
+
+    def _maybe_env_analyze(self, args):
+        """MXTPU_ANALYZE=1|strict: graph-lint the program the first
+        dispatch is about to run.  Findings log as warnings; ``strict``
+        raises instead, refusing to train on a step that leaks a host
+        sync or an HBM copy into every iteration."""
+        from ..base import get_env
+        from ..analysis import ENV_ANALYZE
+        mode = str(get_env(ENV_ANALYZE, "") or "").strip().lower()
+        if mode in ("", "0", "off", "false", "no"):
+            # cache the "off" answer: the per-step signature hashing and
+            # env read are not worth paying when analysis is disabled
+            self._analyze_off = True
+            return
+        import logging
+        log = logging.getLogger(__name__)
+        report = self._lint_args(args)
+        if report.ok:
+            log.info("MXTPU_ANALYZE: fused step is clean (%s)",
+                     report.stats.get("collectives") or "no collectives")
+            return
+        if mode == "strict":
+            raise MXNetError(
+                "MXTPU_ANALYZE=strict: the fused step violates graph "
+                "invariants:\n%s" % report.format_text())
+        log.warning("MXTPU_ANALYZE: fused step has %d finding(s):\n%s",
+                    len(report.findings), report.format_text())
+
     def install_watchdog(self, watchdog):
         """Arm ``watchdog`` (resilience.StepWatchdog) around every fused
         step, and give its hang report this trainer's mesh/step context.
@@ -1006,6 +1138,7 @@ class SPMDTrainer(object):
             setattr(self, attr, None)
         self._guard_pending = False
         # drop the jitted callables (each owns its executable + caches)
+        self._step_raw = None
         for attr in ("_step_fn", "_eval_fn", "_rep_fn"):
             fn = getattr(self, attr, None)
             if fn is not None and hasattr(fn, "clear_cache"):

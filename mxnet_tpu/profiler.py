@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 
-from .base import MXNetError
+from .base import MXNetError, get_env, register_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "dumps", "get_op_stats", "State", "Mode", "StepTraceCapture",
@@ -24,7 +24,13 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
 
 #: when set, fit() captures a jax.profiler trace of steps 10-15 of the
 #: first epoch into this directory (viewable in TensorBoard/Perfetto)
-ENV_PROFILE_DIR = "MXTPU_PROFILE_DIR"
+ENV_PROFILE_DIR = register_env(
+    "MXTPU_PROFILE_DIR",
+    doc="fit() captures a jax.profiler trace of steps 10-15 of the first "
+        "epoch into this directory")
+ENV_PROFILER_AUTOSTART = register_env(
+    "MXNET_PROFILER_AUTOSTART", default=0,
+    doc="1 starts the host profiler at import (reference parity)")
 
 
 class StepTraceCapture(object):
@@ -46,7 +52,7 @@ class StepTraceCapture(object):
     @classmethod
     def from_env(cls):
         """A capture configured from MXTPU_PROFILE_DIR, or None."""
-        directory = os.environ.get(ENV_PROFILE_DIR)
+        directory = get_env(ENV_PROFILE_DIR)
         return cls(directory) if directory else None
 
     def on_batch(self, nbatch):
@@ -233,5 +239,5 @@ def dumps(reset=False, trace_dir=None):
     return "\n".join(lines) + "\n"
 
 
-if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+if str(get_env(ENV_PROFILER_AUTOSTART, "0")) == "1":
     profiler_set_state(State.RUN)

@@ -42,7 +42,7 @@ import time
 import traceback
 from contextlib import contextmanager
 
-from .base import MXNetError
+from .base import MXNetError, register_env
 
 __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
            "CheckpointManager", "StepWatchdog", "PreemptionHandler",
@@ -56,18 +56,47 @@ __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
 
 _LOG = logging.getLogger(__name__)
 
-ENV_INIT_RETRIES = "MXTPU_INIT_RETRIES"
-ENV_INIT_TIMEOUT = "MXTPU_INIT_TIMEOUT"
-ENV_INIT_BACKOFF = "MXTPU_INIT_BACKOFF"
-ENV_DATA_RETRIES = "MXTPU_DATA_RETRIES"
-ENV_DATA_BACKOFF = "MXTPU_DATA_RETRY_BACKOFF"
-ENV_MAX_BAD_STEPS = "MXTPU_MAX_BAD_STEPS"
-ENV_STEP_GUARD = "MXTPU_STEP_GUARD"
-ENV_FAULTS = "MXTPU_FAULTS"
-ENV_STEP_TIMEOUT = "MXTPU_STEP_TIMEOUT"
-ENV_ON_PREEMPT = "MXTPU_ON_PREEMPT"
-ENV_DEBUG_DIR = "MXTPU_DEBUG_DIR"
-ENV_RESUME = "MXTPU_RESUME"
+ENV_INIT_RETRIES = register_env(
+    "MXTPU_INIT_RETRIES", default=3,
+    doc="distributed.initialize attempts before giving up")
+ENV_INIT_TIMEOUT = register_env(
+    "MXTPU_INIT_TIMEOUT",
+    doc="Per-attempt coordination-service timeout (seconds) for "
+        "distributed.initialize")
+ENV_INIT_BACKOFF = register_env(
+    "MXTPU_INIT_BACKOFF", default=1.0,
+    doc="Initial backoff (seconds, doubles per attempt) between "
+        "distributed.initialize retries")
+ENV_DATA_RETRIES = register_env(
+    "MXTPU_DATA_RETRIES", default=3,
+    doc="Attempts per data-iterator next() through the shared retry "
+        "ladder (prefetchers)")
+ENV_DATA_BACKOFF = register_env(
+    "MXTPU_DATA_RETRY_BACKOFF", default=0.05,
+    doc="Initial backoff (seconds) between data-iterator retries")
+ENV_MAX_BAD_STEPS = register_env(
+    "MXTPU_MAX_BAD_STEPS", default=10,
+    doc="Consecutive guard-skipped steps before the divergence abort")
+ENV_STEP_GUARD = register_env(
+    "MXTPU_STEP_GUARD", default=1,
+    doc="0 disables the in-graph NaN/Inf gradient guard")
+ENV_FAULTS = register_env(
+    "MXTPU_FAULTS",
+    doc="Deterministic fault arming, point:times[@after] comma-list")
+ENV_STEP_TIMEOUT = register_env(
+    "MXTPU_STEP_TIMEOUT",
+    doc="Hung-step watchdog budget in seconds, or 'auto' to calibrate")
+ENV_ON_PREEMPT = register_env(
+    "MXTPU_ON_PREEMPT",
+    doc="'save' = checkpoint at the next step boundary on SIGTERM/SIGINT "
+        "and exit with PREEMPT_EXIT_CODE")
+ENV_DEBUG_DIR = register_env(
+    "MXTPU_DEBUG_DIR",
+    doc="Directory for watchdog hang reports")
+ENV_RESUME = register_env(
+    "MXTPU_RESUME",
+    doc="1 = fit(checkpoint=...) behaves as resume=True (set by "
+        "tools/supervise.py relaunches)")
 
 #: process exit code of a watchdog abort (hung step): the supervisor
 #: relaunches with resume.  Distinct from signal codes (128+N) and from
@@ -125,8 +154,9 @@ class FaultInjector(object):
     """
 
     def __init__(self):
+        from .base import get_env
         self._armed = {}
-        env = os.environ.get(ENV_FAULTS, "")
+        env = get_env(ENV_FAULTS, "")
         for part in filter(None, (p.strip() for p in env.split(","))):
             point, _, times = part.partition(":")
             times, _, after = (times or "1").partition("@")

@@ -7,12 +7,15 @@ Provides the reference's three numeric oracles:
 """
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
+from .base import get_env, register_env
 from .context import Context, cpu, current_context
 from .ndarray import NDArray, array as nd_array
+
+ENV_TEST_DEVICE = register_env(
+    "MXNET_TEST_DEVICE", scope="test",
+    doc="Overrides test_utils.default_context() (e.g. cpu:0)")
 
 __all__ = [
     "default_context", "assert_almost_equal", "rand_ndarray", "rand_shape_nd",
@@ -24,7 +27,7 @@ __all__ = [
 def default_context():
     """Context under test — switchable via MXNET_TEST_DEVICE (reference
     test_utils.py default_context via env)."""
-    dev = os.environ.get("MXNET_TEST_DEVICE")
+    dev = get_env(ENV_TEST_DEVICE)
     if dev:
         name, _, idx = dev.partition(":")
         return Context(name, int(idx or 0))

@@ -24,6 +24,16 @@ def main():
     kv = mx.kv.create("tpu")
     rank, nworker = kv.rank, kv.num_workers
     assert nworker == 3
+    # capability probe: some jax builds expose NO coordinator-KV read
+    # surface (no key_value_try_get / key_value_dir_get /
+    # blocking_key_value_get on the client), so a liveness observer is
+    # impossible there by construction — report SKIP instead of a bogus
+    # dead=0 failure; the pytest wrapper translates this into a skip
+    if not distributed.heartbeat_supported():
+        distributed.barrier("hb_probe")
+        print("dist_dead_node rank %d/3: SKIP (no coordinator KV read "
+              "surface on this jax build)" % rank)
+        return
     # everyone heartbeats at least once and syncs
     time.sleep(0.6)
     distributed.barrier("hb_started")

@@ -75,6 +75,13 @@ def test_dist_dead_node_detection():
          sys.executable, worker],
         env=_clean_env(), capture_output=True, text=True, timeout=600)
     sys.stdout.write(res.stdout[-4000:])
+    if "SKIP (no coordinator KV read surface" in res.stdout:
+        # the worker's capability probe found a jax build whose
+        # DistributedRuntimeClient exposes no KV read method — a
+        # liveness observer cannot exist there (see
+        # distributed.heartbeat_supported)
+        pytest.skip("jax distributed client has no coordinator KV read "
+                    "surface — heartbeat observation unsupported")
     assert res.returncode == 0, res.stdout[-4000:]
     assert "dist_dead_node rank 0/3: OK" in res.stdout
     assert "rank 2/3: OK (went silent)" in res.stdout

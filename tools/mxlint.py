@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""mxlint — static analyzer for the mxnet_tpu tree.
+
+Level 2 (AST) runs always: traced-host calls in jitted functions,
+lock-order cycles, bare excepts, and env-registry discipline over the
+given paths (default: the ``mxnet_tpu`` package next to this script).
+Level 1 (graph) is opt-in via ``--graph``: builds the standard MLP fused
+step on a dp mesh (8 virtual CPU devices) and lints its program —
+donation coverage, host callbacks, the collective audit, dtype drift.
+
+Exit codes: 0 = clean, 1 = findings, 2 = internal/usage error.
+
+Reports: human lines on stdout; ``--json PATH`` (or the
+``MXTPU_ANALYZE_REPORT`` env var) writes the stable machine-readable
+report CI/bench diff across commits (see
+docs/how_to/static_analysis.md).  Suppress a finding inline with
+``# mxlint: disable=<rule>`` on (or above) the offending line.
+
+    tools/mxlint.py                      # lint the package
+    tools/mxlint.py --self               # lint the linter + the package
+    tools/mxlint.py --graph --json r.json mxnet_tpu
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ANALYSIS_DIR = os.path.join(_REPO, "mxnet_tpu", "analysis")
+
+
+def _load_ast_level():
+    """Load report.py + ast_lint.py by file path under a synthetic
+    package, WITHOUT importing mxnet_tpu — the AST level is stdlib-only
+    by design, and this CLI must work (and stay side-effect-free) in
+    containers with no jax/accelerator runtime and in launch-configured
+    environments where importing the package would auto-join a
+    distributed process group."""
+    pkg = types.ModuleType("_mxlint_analysis")
+    pkg.__path__ = [_ANALYSIS_DIR]
+    sys.modules.setdefault("_mxlint_analysis", pkg)
+
+    def load(modname):
+        fullname = "_mxlint_analysis." + modname
+        if fullname in sys.modules:
+            return sys.modules[fullname]
+        spec = importlib.util.spec_from_file_location(
+            fullname, os.path.join(_ANALYSIS_DIR, modname + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[fullname] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+    load("report")
+    return load("ast_lint")
+
+
+def _graph_lint_mlp():
+    """Build the standard 2-layer MLP fused step on a dp mesh and lint
+    it (the same model tier-1 regression tests pin) — proving the
+    shipped trainer's program donates its carries, syncs nothing to the
+    host, and emits only the expected dp all-reduces.  The ONLY mode
+    that imports the package (and jax)."""
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from mxnet_tpu.analysis import fixtures
+
+    trainer = fixtures.standard_mlp_trainer()
+    try:
+        return trainer.analyze(*fixtures.standard_mlp_batch())
+    finally:
+        trainer.close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "mxnet_tpu package)")
+    parser.add_argument("--self", dest="lint_self", action="store_true",
+                        help="lint the linter (tools/mxlint.py + the "
+                             "analysis package) along with the package")
+    parser.add_argument("--graph", action="store_true",
+                        help="also graph-lint the standard MLP fused "
+                             "step (compiles a small program)")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="write the machine-readable report here "
+                             "(default: $MXTPU_ANALYZE_REPORT if set)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset to run")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the human report (exit code and "
+                             "--json only)")
+    args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    try:
+        ast_lint = _load_ast_level()
+    except Exception as e:  # noqa: BLE001 — report, don't traceback
+        sys.stderr.write("mxlint: cannot load the analysis modules: %s\n"
+                         % (e,))
+        return 2
+
+    paths = list(args.paths)
+    if not paths:
+        paths = [os.path.join(_REPO, "mxnet_tpu")]
+    if args.lint_self:
+        paths.append(os.path.abspath(__file__))
+    # the registry, collected STATICALLY from the package (register_env
+    # call literals) so linting paths outside it — this file, example
+    # scripts — still knows every declared knob without importing
+    # anything
+    registry = ast_lint.collect_registered(
+        [os.path.join(_REPO, "mxnet_tpu")])
+
+    select = None
+    if args.rules:
+        select = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = set(select) - set(ast_lint.RULES)
+        if unknown:
+            sys.stderr.write("mxlint: unknown rule(s) %s (known: %s)\n"
+                             % (sorted(unknown),
+                                ", ".join(ast_lint.RULES)))
+            return 2
+
+    report = ast_lint.lint_paths(paths, env_registry=registry,
+                                 select=select)
+    if args.graph:
+        try:
+            report.merge(_graph_lint_mlp())
+        except Exception as e:  # noqa: BLE001 — device bring-up varies
+            sys.stderr.write("mxlint: graph level failed to run: %s\n"
+                             % (e,))
+            return 2
+    elapsed = time.monotonic() - t0
+
+    # read directly: this CLI must not import the package for get_env
+    json_path = args.json_path or \
+        os.environ.get("MXTPU_ANALYZE_REPORT")  # mxlint: disable=env-direct-read
+    if json_path:
+        payload = report.to_dict()
+        # timing lives OUTSIDE the diffable findings/summary contract
+        payload["elapsed_s"] = round(elapsed, 3)
+        with open(json_path, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if not args.quiet:
+        print(report.format_text())
+        print("mxlint: %.2fs" % elapsed)
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
