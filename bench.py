@@ -673,6 +673,103 @@ def _resume_bench(steps=60, batch=64):
     return out
 
 
+def _checkpoint_bench(saves=5, steps_between=3, batch=64, hidden=1024):
+    """The price of a checkpoint, measured where it hurts: the STEP-LOOP
+    STALL per save — how long ``save_checkpoint`` blocks the training
+    loop — for the blocking path (serialize + atomic write + fsync +
+    checksum + manifest, all inline) vs the async path (host snapshot
+    only; the CheckpointWriter does the rest off-thread).  Also measures
+    the integrity tax: a verified restore vs the file read alone, and a
+    full ``tools/ckpt_fsck.py`` audit of the directory.  The async run's
+    restored params are asserted byte-identical to the blocking run's
+    (``ckpt_parity``) — a fast save that loses bits is not a feature.
+    CPU/host work only."""
+    import shutil
+    import subprocess as _sp
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer
+    from mxnet_tpu.resilience import CheckpointManager, checksum_file
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(0)
+    X = rs.rand(batch, 1024).astype("f")
+    y = rs.randint(0, 10, batch).astype("f")
+
+    def run(blocking):
+        tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+        man = CheckpointManager(tmp, keep_last=saves + 1)
+        t = SPMDTrainer(net, "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9,
+                         "rescale_grad": 1.0 / batch}, mesh=None)
+        t.bind([("data", (batch, 1024))], [("softmax_label", (batch,))])
+        mx.random.seed(11)
+        t.init_params(mx.initializer.Xavier())
+        stalls = []
+        for i in range(1, saves + 1):
+            for _ in range(steps_between):
+                t.step(X, y)
+            t.flush_step_guard()
+            # production checkpoints are minutes apart — by the next save
+            # the writer is long idle.  This bench's saves are a few fast
+            # CPU steps apart, so drain OUTSIDE the timed window; without
+            # this the measured "stall" is mostly the previous write's
+            # back-pressure, a regime no sane checkpoint cadence hits.
+            man.wait()
+            tic = time.perf_counter()
+            t.save_checkpoint(man, i, blocking=blocking)
+            stalls.append(time.perf_counter() - tic)
+        man.wait()
+        t.close()
+        stalls.sort()
+        return stalls[len(stalls) // 2], man, tmp
+
+    out = {}
+    try:
+        block_stall, man_b, dir_b = run(blocking=True)
+        async_stall, man_a, dir_a = run(blocking=False)
+        out["ckpt_stall_blocking_s"] = round(block_stall, 5)
+        out["ckpt_stall_async_s"] = round(async_stall, 5)
+        out["ckpt_stall_ratio"] = round(block_stall / max(async_stall,
+                                                          1e-9), 1)
+        # identical training streams => the two directories' newest
+        # checkpoints must restore byte-identically
+        _, pa, _, sa, _ = man_b.restore()
+        _, pb, _, sb, _ = man_a.restore()
+        out["ckpt_parity"] = bool(
+            sa == sb and set(pa) == set(pb) and all(
+                np.array_equal(pa[k].asnumpy(), pb[k].asnumpy())
+                for k in pa))
+        # integrity tax: verified restore vs raw params read, plus the
+        # offline fsck audit of the whole directory
+        params_path = man_b.params_path(man_b.latest())
+        tic = time.perf_counter()
+        man_b.restore()
+        out["ckpt_restore_verified_s"] = round(time.perf_counter() - tic,
+                                               5)
+        tic = time.perf_counter()
+        checksum_file(params_path, "sha256")
+        out["ckpt_verify_s"] = round(time.perf_counter() - tic, 5)
+        here = os.path.dirname(os.path.abspath(__file__))
+        tic = time.perf_counter()
+        res = _sp.run([sys.executable,
+                       os.path.join(here, "tools", "ckpt_fsck.py"),
+                       dir_b, "-q"], capture_output=True, text=True,
+                      timeout=120)
+        out["ckpt_fsck_s"] = round(time.perf_counter() - tic, 3)
+        out["ckpt_fsck_rc"] = res.returncode
+    finally:
+        for d in (locals().get("dir_b"), locals().get("dir_a")):
+            if d:
+                shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def _lstm_bench(batch, seq_len, steps, warmup, trials):
     """2-layer LSTM LM (lstm_bucketing workload, one bucket) tokens/sec."""
     import jax
@@ -765,7 +862,7 @@ def _run_mode(mode):
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume", "analyze"):
+                "resume", "checkpoint", "analyze"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -790,6 +887,8 @@ def _run_mode(mode):
         out.update(_compile_probe())
     elif mode == "resume":
         out.update(_resume_bench())
+    elif mode == "checkpoint":
+        out.update(_checkpoint_bench())
     elif mode == "fed":
         out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
         out["fed_roofline"] = _roofline(out["fed"],
@@ -896,6 +995,7 @@ def main():
         if "compile_bringup_s" in warm:
             parts["compile_warm_s"] = warm["compile_bringup_s"]
         parts.update(_collect("resume"))
+        parts.update(_collect("checkpoint"))
         parts.update(_collect("fed"))
     parts.update(_collect("analyze", timeout=240))
     parts.update(_collect("compute"))
@@ -948,6 +1048,10 @@ def main():
               "resume_save_s", "resume_restore_s", "resume_refit_s",
               "resume_baseline_s", "resume_overhead_s", "resume_parity",
               "resume_parity_note",
+              "ckpt_stall_blocking_s", "ckpt_stall_async_s",
+              "ckpt_stall_ratio", "ckpt_parity",
+              "ckpt_restore_verified_s", "ckpt_verify_s",
+              "ckpt_fsck_s", "ckpt_fsck_rc",
               "mxlint_wall_s", "mxlint_rc", "mxlint_budget_ok",
               "analyze_mlp_collectives", "analyze_zero_collectives",
               "analyze_findings"):

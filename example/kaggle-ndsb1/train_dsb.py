@@ -98,6 +98,16 @@ def main(argv=None):
     ap.add_argument("--save-model-prefix", default=None)
     args = ap.parse_args(argv)
 
+    # pin EVERY stream up front, not just mx.random before the Xavier
+    # draw (pinned further down): this tiny 4-epoch run's final accuracy
+    # is seed-sensitive (observed 0.21..0.58 across seeds — a bad
+    # Dropout/Xavier draw collapses early ReLUs), so nothing here may
+    # inherit whatever stream position the process happens to be in
+    import random as _pyrandom
+    _pyrandom.seed(7)
+    np.random.seed(7)
+    mx.random.seed(7)
+
     work = tempfile.mkdtemp(prefix="ndsb1_")
     train_dir = args.data_dir or make_synthetic_dataset(work)
 
@@ -135,7 +145,7 @@ def main(argv=None):
     # Xavier draw (observed val acc 0.21..0.58 across ambient RNG
     # states — a bad draw collapses early ReLUs), so the example must
     # not inherit whatever stream position the process happens to be in
-    mx.random.seed(2016)
+    mx.random.seed(7)
     mod = mx.mod.Module(get_symbol(num_classes))
     mod.fit(train_it, eval_data=val_it,
             initializer=mx.initializer.Xavier(),

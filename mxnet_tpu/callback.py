@@ -63,7 +63,10 @@ def do_checkpoint(prefix, period=1):
     """Epoch-end callback saving prefix-symbol.json + prefix-%04d.params
     (reference callback.py:do_checkpoint).  Writes are atomic; ``prefix``
     may also be a :class:`~mxnet_tpu.resilience.CheckpointManager`, which
-    adds manifest discovery + keep_last retention."""
+    adds manifest discovery, per-file checksums and keep_last retention.
+    Under ``MXTPU_CKPT_ASYNC=1`` both forms return after the host
+    snapshot and a background writer does the file IO — drain with
+    ``manager.wait()`` / ``resilience.wait_checkpoints()``."""
     from .model import save_checkpoint
     period = int(max(1, period))
     managed = hasattr(prefix, "save") and hasattr(prefix, "latest")

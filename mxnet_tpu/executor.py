@@ -56,11 +56,17 @@ def _filter_attrs(op, attrs):
 
 
 def _node_plan(symbol):
-    """Precompute the per-node execution plan for the trace."""
+    """Precompute the per-node execution plan for the trace.  The last
+    slot is the node's position in this graph's topological order — the
+    per-node RNG fold constant.  It must be a pure function of the GRAPH
+    (never of process history): folding the old process-global Symbol
+    uid meant the same seeded program drew different Dropout masks
+    depending on how many symbols the process had ever created, so a
+    test suite's earlier tests silently changed later seeded runs."""
     plan = []
-    for node in symbol._nodes():
+    for ix, node in enumerate(symbol._nodes()):
         if node.is_variable:
-            plan.append((node, None, None, None, None))
+            plan.append((node, None, None, None, ix))
             continue
         attrs = node.op.normalize_attrs(node.op_attrs())
         call_attrs = _filter_attrs(node.op, attrs)
@@ -72,7 +78,7 @@ def _node_plan(symbol):
             if n_in + k < len(node.inputs):
                 src, _ = node.inputs[n_in + k]
                 aux_var_names.append(src.name if src.is_variable else None)
-        plan.append((node, call_attrs, n_out, aux_var_names, None))
+        plan.append((node, call_attrs, n_out, aux_var_names, ix))
     return plan
 
 
@@ -116,7 +122,7 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
     def eval_fn(args, aux, rng, is_train, monitor=None):
         env = {}
         aux_updates = {}
-        for node, call_attrs, n_out, aux_var_names, _ in plan:
+        for node, call_attrs, n_out, aux_var_names, rng_ix in plan:
             dev = placement.get(id(node))
             if node.op is None:
                 if node.name in args:
@@ -136,7 +142,7 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
             if node.op.needs_is_train:
                 kw["is_train"] = is_train
             if node.op.needs_rng:
-                kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
+                kw["rng"] = jax.random.fold_in(rng, rng_ix)
             with jax.named_scope(node.name):
                 out = node.op.fn(*ins, **call_attrs, **kw)
             if not isinstance(out, (tuple, list)):
@@ -170,7 +176,7 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
                     monitor=None):
     """Interpret a slice of the node plan against ``env`` (id -> outputs
     tuple).  Shared by the plain and segmented eval builders."""
-    for node, call_attrs, n_out, aux_var_names, _ in chunk:
+    for node, call_attrs, n_out, aux_var_names, rng_ix in chunk:
         if node.op is None:
             if node.name in args:
                 val = args[node.name]
@@ -185,7 +191,7 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
         if node.op.needs_is_train:
             kw["is_train"] = is_train
         if node.op.needs_rng:
-            kw["rng"] = jax.random.fold_in(rng, node._uid % (1 << 30))
+            kw["rng"] = jax.random.fold_in(rng, rng_ix)
         # named_scope stamps the symbol node name into HLO op_name
         # metadata, so device profiles attribute fused-program time back
         # to graph nodes (reference per-op profiler semantics,

@@ -84,20 +84,43 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    blocking=None):
     """Save prefix-symbol.json + prefix-%04d.params (reference
     model.py:save_checkpoint; format matches the reference byte-for-byte
     via ndarray.save).  Files land via temp + fsync + rename so a crash
-    mid-save can never tear an existing checkpoint."""
-    from .resilience import atomic_path, atomic_write
-    if symbol is not None:
-        atomic_write("%s-symbol.json" % prefix, symbol.tojson())
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    with atomic_path(param_name) as tmp:
-        nd.save(tmp, save_dict)
-    logging.info("Saved checkpoint to \"%s\"", param_name)
+    mid-save can never tear an existing checkpoint.
+
+    ``blocking=False`` (default: the ``MXTPU_CKPT_ASYNC`` env) returns
+    after snapshotting the params to host copies; the shared background
+    :class:`~mxnet_tpu.resilience.CheckpointWriter` then serializes and
+    writes — drain with ``resilience.wait_checkpoints()``.  ``symbol``
+    may be a Symbol or an already-serialized JSON string (what async
+    snapshots and CheckpointManager's writer hand in)."""
+    from .resilience import (atomic_path, atomic_write, checkpoint_async,
+                             snapshot_params, submit_checkpoint)
+    sym_json = symbol if isinstance(symbol, str) or symbol is None \
+        else symbol.tojson()
+    if blocking is None:
+        blocking = not checkpoint_async()
+    if not blocking:
+        arg_params = snapshot_params(arg_params)
+        aux_params = snapshot_params(aux_params)
+
+    def _write():
+        if sym_json is not None:
+            atomic_write("%s-symbol.json" % prefix, sym_json)
+        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        with atomic_path(param_name) as tmp:
+            nd.save(tmp, save_dict)
+        logging.info("Saved checkpoint to \"%s\"", param_name)
+
+    if blocking:
+        _write()
+    else:
+        submit_checkpoint(_write, "%s epoch %d" % (prefix, epoch))
 
 
 def load_checkpoint(prefix, epoch):

@@ -422,6 +422,17 @@ class BaseModule(object):
 
                 # end of 1 epoch, reset the data-iter for another epoch
                 train_data.reset()
+            # drain the async checkpoint writers so every epoch's save is
+            # durable (and any background write failure surfaces here)
+            # before fit() reports success — the manager's own writer AND
+            # the shared default writer behind prefix-based saves
+            # (epoch_end_callback=do_checkpoint(prefix) queues there; the
+            # writer thread is a daemon, so an undrained write could be
+            # killed mid-flight at interpreter exit)
+            if checkpoint is not None and hasattr(checkpoint, "wait"):
+                checkpoint.wait()
+            from ..resilience import wait_checkpoints
+            wait_checkpoints()
         finally:
             if trace is not None:
                 trace.stop()
@@ -439,15 +450,35 @@ class BaseModule(object):
         (epoch + 1), plus a ``step_state`` manifest record — epoch index,
         batches consumed, RNG stream — that ``fit(resume=True)`` uses to
         fast-forward.  The later epoch-end save of the same number
-        replaces the partial entry."""
+        replaces the partial entry.
+
+        The exit-85 contract requires the checkpoint to be ON DISK when
+        the process exits: any in-flight async save is drained first
+        (best-effort — this blocking save supersedes whatever the failed
+        write would have published) and the preemption save itself is
+        always blocking, MXTPU_CKPT_ASYNC notwithstanding."""
         from .. import random as _random
+        from ..resilience import CheckpointManager, wait_checkpoints
+        # BOUNDED drain of the shared default writer (prefix-based async
+        # saves): a wedged — not failed — background write must not eat
+        # the whole preemption grace period; a timeout surfaces as the
+        # same MXNetError a failed write would.  The manager's own
+        # writer is drained inside save(blocking=True) below, equally
+        # bounded; the blocking save supersedes whatever was in flight.
+        try:
+            wait_checkpoints(timeout=CheckpointManager.DRAIN_TIMEOUT / 2)
+        except Exception as e:  # noqa: BLE001 — superseded below
+            self.logger.warning(
+                "preemption: in-flight async checkpoint write failed "
+                "(%s: %s) — the blocking preemption save below "
+                "supersedes it", type(e).__name__, e)
         arg_params_, aux_params_ = self.get_params()
         try:
             states = self.get_optimizer_states()
         except NotImplementedError:
             states = None
         checkpoint.save(epoch + 1, self.symbol, arg_params_, aux_params_,
-                        optimizer_states=states,
+                        optimizer_states=states, blocking=True,
                         step_state={"epoch": int(epoch), "step": int(step),
                                     "rng": _random.get_state()})
         from ..resilience import PREEMPT_EXIT_CODE

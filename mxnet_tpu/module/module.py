@@ -96,19 +96,52 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        blocking=None):
         """Save symbol+params(+optimizer states) (reference module.py:135).
         Every file lands atomically (temp + fsync + rename) so a crash
-        mid-save leaves any prior checkpoint intact."""
-        from ..resilience import atomic_write
-        atomic_write("%s-symbol.json" % prefix, self._symbol.tojson())
-        param_name = "%s-%04d.params" % (prefix, epoch)
-        self.save_params(param_name)
-        logging.info("Saved checkpoint to \"%s\"", param_name)
-        if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
-            logging.info("Saved optimizer state to \"%s\"", state_name)
+        mid-save leaves any prior checkpoint intact.
+
+        ``blocking=False`` (default: the ``MXTPU_CKPT_ASYNC`` env)
+        returns after snapshotting params (+ serialized optimizer state)
+        to host copies; the background writer does the file IO — drain
+        with ``resilience.wait_checkpoints()``."""
+        from ..model import save_checkpoint as _model_save
+        from ..resilience import (atomic_write, checkpoint_async,
+                                  snapshot_params, submit_checkpoint)
+        if blocking is None:
+            blocking = not checkpoint_async()
+        states = self.get_optimizer_states() if save_optimizer_states \
+            else None
+        arg_params, aux_params = self.get_params()
+        sym_json = self._symbol.tojson()
+        state_name = "%s-%04d.states" % (prefix, epoch)
+
+        def _write_states():
+            if states is not None:
+                atomic_write(state_name, states)
+                logging.info("Saved optimizer state to \"%s\"", state_name)
+
+        if blocking:
+            _model_save(prefix, epoch, sym_json, arg_params, aux_params,
+                        blocking=True)
+            _write_states()
+        else:
+            # ONE submitted job for params + states: the writer is
+            # single-slot, so two submits would block this caller for
+            # the first job's full serialize+write+fsync — the stall
+            # async mode exists to remove.  Snapshot here (the only
+            # synchronous cost); sym_json and the states bytes are
+            # immutable already.
+            arg_params = snapshot_params(arg_params)
+            aux_params = snapshot_params(aux_params)
+
+            def _write_all():
+                _model_save(prefix, epoch, sym_json, arg_params,
+                            aux_params, blocking=True)
+                _write_states()
+
+            submit_checkpoint(_write_all, "%s epoch %d" % (prefix, epoch))
 
     # -- properties --------------------------------------------------------
     @property

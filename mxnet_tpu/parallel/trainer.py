@@ -977,15 +977,21 @@ class SPMDTrainer(object):
             placed[name] = tuple(self._place(x, spec) for x in s)
         self.opt_state = placed
 
-    def save_checkpoint(self, manager, step):
+    def save_checkpoint(self, manager, step, blocking=None):
         """Checkpoint params + optimizer state through a
         :class:`~mxnet_tpu.resilience.CheckpointManager`.  The gathers run
         on EVERY rank (collective under sharded params — see _gather's
-        note); the manager then writes atomically on rank 0 only."""
+        note); the manager then writes atomically on rank 0 (plus this
+        rank's replica shards under MXTPU_CKPT_REPLICAS).
+
+        ``blocking=None`` follows ``MXTPU_CKPT_ASYNC``: the async path
+        stalls the step loop only for the gather + host snapshot, the
+        background writer does serialize + fsync + manifest — drain with
+        ``manager.wait()``."""
         arg_params, aux_params = self.get_params()
         states = self.get_states()
         return manager.save(step, self.symbol, arg_params, aux_params,
-                            optimizer_states=states)
+                            optimizer_states=states, blocking=blocking)
 
     def restore(self, manager, epoch=None):
         """Resume params + optimizer state (+ step counter, inside the

@@ -13,6 +13,16 @@ guarded.  This module owns the pieces:
 - :class:`CheckpointManager` — a checkpoint directory with a JSON
   manifest, ``keep_last`` retention, ``latest()``/``restore()`` discovery
   and rank-0-guarded multi-process writes (the Orbax-style discipline).
+  Saves can be ASYNCHRONOUS (``blocking=False`` / ``MXTPU_CKPT_ASYNC=1``):
+  the caller pays only for the host snapshot, and a
+  :class:`CheckpointWriter` thread does serialize + atomic write + fsync
+  while training continues (the Check-N-Run decoupling).  The manifest
+  records every file's size + checksum, ``restore()`` verifies before
+  deserializing and walks back past bit rot, and in multi-process runs
+  each rank also writes its ring neighbor's checkpoint shard
+  (``MXTPU_CKPT_REPLICAS``) so a rank's state can be rebuilt from a peer
+  replica when the primary is missing or corrupt (the Gemini-style
+  redundancy).  ``tools/ckpt_fsck.py`` audits a directory offline.
 - :func:`retry` — bounded retry with backoff and structured logging,
   applied to ``distributed.initialize`` and the prefetcher's ``next()``.
 - :data:`faults` — deterministic fault-injection points (env- or
@@ -45,14 +55,17 @@ from contextlib import contextmanager
 from .base import MXNetError, register_env
 
 __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
-           "CheckpointManager", "StepWatchdog", "PreemptionHandler",
-           "preempted_exit",
+           "CheckpointManager", "CheckpointWriter", "StepWatchdog",
+           "PreemptionHandler", "preempted_exit",
+           "checksum_file", "checksum_bytes", "checkpoint_async",
+           "snapshot_params", "submit_checkpoint", "wait_checkpoints",
            "TransientError", "FaultInjector", "faults",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
            "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
            "ENV_STEP_GUARD", "ENV_FAULTS", "ENV_STEP_TIMEOUT",
-           "ENV_ON_PREEMPT", "ENV_DEBUG_DIR", "ENV_RESUME"]
+           "ENV_ON_PREEMPT", "ENV_DEBUG_DIR", "ENV_RESUME",
+           "ENV_CKPT_ASYNC", "ENV_CKPT_REPLICAS", "ENV_CKPT_CHECKSUM"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -97,6 +110,21 @@ ENV_RESUME = register_env(
     "MXTPU_RESUME",
     doc="1 = fit(checkpoint=...) behaves as resume=True (set by "
         "tools/supervise.py relaunches)")
+ENV_CKPT_ASYNC = register_env(
+    "MXTPU_CKPT_ASYNC", default=0,
+    doc="1 = managed checkpoint saves return after the host snapshot; "
+        "a background CheckpointWriter does serialize + atomic write + "
+        "fsync while training continues")
+ENV_CKPT_REPLICAS = register_env(
+    "MXTPU_CKPT_REPLICAS", default=0,
+    doc="Peer replicas per checkpoint shard in multi-process runs: each "
+        "rank also writes its ring neighbors' shards (offsets 1..N) so "
+        "restore survives a missing/corrupt primary")
+ENV_CKPT_CHECKSUM = register_env(
+    "MXTPU_CKPT_CHECKSUM", default="sha256",
+    doc="Checksum recorded per checkpoint file in the manifest and "
+        "verified on restore: sha256 (default, C-speed), crc32 (zlib), "
+        "crc32c (pure-python, TFRecord-style), off")
 
 #: process exit code of a watchdog abort (hung step): the supervisor
 #: relaunches with resume.  Distinct from signal codes (128+N) and from
@@ -180,6 +208,10 @@ class FaultInjector(object):
             self._armed[point + "/after"] = int(after)
         else:
             self._armed.pop(point + "/after", None)
+        # a leftover hang duration must not survive a plain re-arm, or
+        # maybe_trip would stall where the new arming expects a raise
+        # (arm_hang re-adds it after delegating here)
+        self._armed.pop(point + "/secs", None)
         return self
 
     def arm_hang(self, point, seconds, times=1, after=0):
@@ -220,6 +252,16 @@ class FaultInjector(object):
         if self.consume(point):
             exc = self._armed.get(point + "/exc", TransientError)
             raise exc(message or "injected fault at %r" % point)
+
+    def maybe_trip(self, point, message=None):
+        """Hang (when armed via :meth:`arm_hang`) or raise (any other
+        arming) at ``point`` — one name for sites where a drill needs
+        either flavor, e.g. the checkpoint writer's ``ckpt_write`` point
+        (a raise = failing disk; a hang = the SIGKILL-mid-save window)."""
+        if self._armed.get(point + "/secs") is not None:
+            self.maybe_hang(point)
+        else:
+            self.maybe_fail(point, message)
 
     #: default stall length of an armed hang point — far beyond any step
     #: budget, so the watchdog (or the supervisor's own timeout) is what
@@ -305,6 +347,290 @@ def atomic_write(path, data, fault_point="checkpoint_write"):
     with atomic_path(path, fault_point=fault_point) as tmp:
         with open(tmp, mode) as f:
             f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# checksums (end-to-end checkpoint integrity)
+# ---------------------------------------------------------------------------
+
+#: algorithms the manifest may record.  ``sha256``/``crc32`` run at C
+#: speed (hashlib/zlib); ``crc32c`` (Castagnoli, the TFRecord/GCS
+#: polynomial) is a pure-python table implementation — correct anywhere,
+#: but ~MB/ms, so prefer it only where CRC32C compatibility matters.
+CHECKSUM_ALGOS = ("sha256", "crc32", "crc32c", "off")
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE = None
+
+
+def _crc32c_table():
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    return _CRC32C_TABLE
+
+
+def _crc32c_update(crc, data):
+    table = _crc32c_table()
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc
+
+
+class _ChecksumStream(object):
+    """Incremental digest over one of :data:`CHECKSUM_ALGOS`."""
+
+    def __init__(self, algo):
+        if algo not in CHECKSUM_ALGOS:
+            raise MXNetError("unknown checksum algo %r (one of %s)"
+                             % (algo, ", ".join(CHECKSUM_ALGOS)))
+        self.algo = algo
+        self.size = 0
+        if algo == "sha256":
+            import hashlib
+            self._h = hashlib.sha256()
+        elif algo == "crc32":
+            self._crc = 0
+        elif algo == "crc32c":
+            self._crc = 0xFFFFFFFF
+
+    def update(self, data):
+        self.size += len(data)
+        if self.algo == "sha256":
+            self._h.update(data)
+        elif self.algo == "crc32":
+            import zlib
+            self._crc = zlib.crc32(data, self._crc)
+        elif self.algo == "crc32c":
+            self._crc = _crc32c_update(self._crc, data)
+
+    def hexdigest(self):
+        if self.algo == "off":
+            return None
+        if self.algo == "sha256":
+            return self._h.hexdigest()
+        crc = self._crc ^ (0xFFFFFFFF if self.algo == "crc32c" else 0)
+        return "%08x" % (crc & 0xFFFFFFFF)
+
+
+def checksum_bytes(data, algo="sha256"):
+    """(size, hexdigest) of ``data``; digest is None under ``off``."""
+    s = _ChecksumStream(algo)
+    s.update(data)
+    return s.size, s.hexdigest()
+
+
+def checksum_file(path, algo="sha256", chunk=1 << 20):
+    """(size, hexdigest) of the file at ``path``, streamed."""
+    s = _ChecksumStream(algo)
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            s.update(block)
+    return s.size, s.hexdigest()
+
+
+def _checksum_algo():
+    """The configured manifest checksum algorithm (MXTPU_CKPT_CHECKSUM);
+    unknown values warn once and fall back to sha256 — an operator typo
+    must degrade to the safe default, not disable integrity."""
+    from .base import get_env
+    algo = str(get_env(ENV_CKPT_CHECKSUM, "sha256") or "sha256").lower()
+    if algo in ("0", "none", "disabled"):
+        algo = "off"
+    if algo not in CHECKSUM_ALGOS:
+        _LOG.warning("%s=%r is not one of %s — using sha256",
+                     ENV_CKPT_CHECKSUM, algo, ", ".join(CHECKSUM_ALGOS))
+        algo = "sha256"
+    return algo
+
+
+# ---------------------------------------------------------------------------
+# the background checkpoint writer (async saves)
+# ---------------------------------------------------------------------------
+
+def checkpoint_async():
+    """True when MXTPU_CKPT_ASYNC asks managed saves to go through the
+    background writer."""
+    from .base import get_env
+    return str(get_env(ENV_CKPT_ASYNC, "0")).strip().lower() in \
+        ("1", "true", "yes", "on")
+
+
+class _HostSnapshot(object):
+    """A host numpy copy duck-typed as an NDArray for serialization
+    (``nd.save`` needs only ``shape``/``dtype``/``asnumpy``).  Snapshots
+    are plain numpy ON PURPOSE: the writer thread never touches jax, so
+    a wedged device cannot block checkpoint IO and the write contends
+    with the step loop only for disk."""
+
+    __slots__ = ("_np",)
+
+    def __init__(self, arr):
+        self._np = arr
+
+    @property
+    def shape(self):
+        return self._np.shape
+
+    @property
+    def dtype(self):
+        return self._np.dtype
+
+    def asnumpy(self):
+        return self._np
+
+
+def _host_value(v):
+    """The host numpy view of an NDArray / jax array / numpy array."""
+    import numpy as np
+    if hasattr(v, "asnumpy"):
+        return v.asnumpy()
+    return np.asarray(v)
+
+
+def snapshot_params(params):
+    """Deep host copies of a ``{name: array-like}`` dict, wrapped for the
+    writer thread.  This copy is the ONLY part of an async save the step
+    loop pays for: the values handed to the writer must stay frozen while
+    training mutates (donated) device buffers and in-place host params."""
+    import numpy as np
+    return {k: _HostSnapshot(np.array(_host_value(v), copy=True))
+            for k, v in (params or {}).items()}
+
+
+class CheckpointWriter(object):
+    """Single-slot background writer: at most one checkpoint write in
+    flight (double-buffered — the snapshot being written plus the one
+    the caller is preparing).  ``submit`` blocks only while a previous
+    write is still running; a failed background write is re-raised at
+    the NEXT ``submit``/``wait`` so a dying disk surfaces one save late
+    instead of silently dropping every epoch."""
+
+    def __init__(self, name="mxtpu-ckpt-writer"):
+        self._name = name
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._job = None        # pending (fn, label)
+        self._busy = False      # a job is executing right now
+        self._error = None      # first unreported failure
+        self._last = None       # {"label","error","elapsed_s"} of last job
+        self._thread = None
+
+    # -- worker ------------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._run,
+                                            name=self._name, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while self._job is None:
+                    self._cv.wait()
+                fn, label = self._job
+                self._job = None
+                self._busy = True
+            t0 = time.monotonic()
+            error = None
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — reported via wait()
+                error = e
+                _LOG.warning("CheckpointWriter: background write %r "
+                             "failed: %s: %s", label, type(e).__name__, e)
+            with self._lock:
+                self._busy = False
+                self._last = {"label": label, "error": error,
+                              "elapsed_s": time.monotonic() - t0}
+                if error is not None:
+                    self._error = error
+                self._cv.notify_all()
+
+    # -- caller surface ----------------------------------------------------
+    def submit(self, fn, label="checkpoint"):
+        """Queue ``fn`` on the writer; blocks only while the previous
+        write is in flight.  Raises the previous write's error, if any
+        (the new job is then NOT queued — the caller sees the failure at
+        the same point a blocking save would have raised)."""
+        with self._lock:
+            self._ensure_thread()
+            while self._busy or self._job is not None:
+                self._cv.wait()
+            err, self._error = self._error, None
+            if err is None:
+                self._job = (fn, label)
+                self._cv.notify_all()
+        if err is not None:
+            raise MXNetError("CheckpointWriter: a previous background "
+                             "write failed: %s: %s"
+                             % (type(err).__name__, err)) from err
+        return self
+
+    def idle(self):
+        with self._lock:
+            return not self._busy and self._job is None
+
+    def wait(self, timeout=None):
+        """Drain: block until no write is queued or running, then raise
+        any unreported failure.  Returns :meth:`last_result`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._busy or self._job is not None:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise MXNetError(
+                        "CheckpointWriter: write still in flight after "
+                        "%.1fs" % timeout)
+                self._cv.wait(left)
+            err, self._error = self._error, None
+            last = dict(self._last) if self._last is not None else None
+        if err is not None:
+            raise MXNetError("CheckpointWriter: background write failed: "
+                             "%s: %s" % (type(err).__name__, err)) from err
+        return last
+
+    def last_result(self):
+        """{"label", "error", "elapsed_s"} of the most recently finished
+        write, or None (does not block, does not clear pending errors)."""
+        with self._lock:
+            return dict(self._last) if self._last is not None else None
+
+
+_DEFAULT_WRITER = None
+
+
+def _default_writer():
+    """The shared writer behind prefix-based (manager-less) async saves:
+    ``model.save_checkpoint`` and ``Module.save_checkpoint`` under
+    MXTPU_CKPT_ASYNC=1."""
+    global _DEFAULT_WRITER
+    if _DEFAULT_WRITER is None:
+        _DEFAULT_WRITER = CheckpointWriter()
+    return _DEFAULT_WRITER
+
+
+def submit_checkpoint(fn, label="checkpoint"):
+    """Queue one checkpoint-write closure on the shared default writer."""
+    return _default_writer().submit(fn, label)
+
+
+def wait_checkpoints(timeout=None):
+    """Drain the shared default writer (prefix-based async saves); no-op
+    when nothing was ever submitted.  Re-raises a failed write."""
+    if _DEFAULT_WRITER is None:
+        return None
+    return _DEFAULT_WRITER.wait(timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -700,34 +1026,76 @@ def _rank():
     return distributed.rank()
 
 
+def _world():
+    """Process count without forcing a backend init: 1 unless joined."""
+    from . import distributed
+    if not distributed.is_initialized():
+        return 1
+    return distributed.num_workers()
+
+
 class CheckpointManager(object):
-    """Atomic, discoverable, retention-managed checkpoints in a directory.
+    """Atomic, discoverable, verified, retention-managed checkpoints.
 
     Layout (``prefix`` defaults to "checkpoint")::
 
-        dir/prefix-symbol.json      the network (written once per save)
-        dir/prefix-0007.params      epoch 7 parameters (reference format)
-        dir/prefix-0007.states      epoch 7 optimizer state (optional)
-        dir/manifest.json           {"checkpoints": [...], "prefix": ...}
+        dir/prefix-symbol.json        the network (written once per save)
+        dir/prefix-0007.params        epoch 7 parameters (reference format)
+        dir/prefix-0007.states        epoch 7 optimizer state (optional)
+        dir/prefix-0007.shard002      key-partition shard 2 (replication)
+        dir/prefix-0007.shard002.rep1 shard 2's ring-offset-1 peer replica
+        dir/prefix-0007.pruning       retention tombstone (transient)
+        dir/manifest.json             {"checkpoints": [...], "prefix": ...}
 
     Every file lands via temp + fsync + ``os.replace``; the manifest is
     updated LAST, so a checkpoint only becomes visible to ``latest()``
     once all of its files are complete.  A crash mid-save leaves the
     previous checkpoint untouched and discoverable.
 
-    Multi-process: only rank 0 writes (callers must gather params on ALL
-    ranks first when they are sharded — see SPMDTrainer.get_params's
-    collective note); other ranks no-op and return the same epoch.
+    INTEGRITY: each manifest entry records every file's size + checksum
+    (``MXTPU_CKPT_CHECKSUM``: sha256 default).  ``restore()`` verifies
+    before deserializing, so bit rot that still unpickles cleanly is
+    caught, and the default restore walks back to the previous intact
+    epoch.  ``tools/ckpt_fsck.py`` runs the same audit offline.
+
+    ASYNC: ``save(..., blocking=False)`` (or ``MXTPU_CKPT_ASYNC=1``)
+    returns after taking a host snapshot; a per-manager
+    :class:`CheckpointWriter` thread does serialize + atomic write +
+    fsync + manifest while training continues.  ``wait()`` drains;
+    a failed background write re-raises at the next save/wait.
+
+    REPLICATION (``MXTPU_CKPT_REPLICAS=N`` in multi-process runs): the
+    gathered state is partitioned into ``world`` key-range shards, and
+    rank r writes shard r plus replicas of its ring neighbors' shards
+    (offsets 1..N) — so when the primary params file or a shard is
+    missing/corrupt, ``restore()`` rebuilds the state from peer-written
+    replicas before falling back an epoch.  Shard bytes are a
+    deterministic function of the (replicated) gathered state, so rank 0
+    records every shard's digest in the manifest without reading the
+    peers' disks.
+
+    Multi-process: only rank 0 writes the full checkpoint + manifest
+    (callers must gather params on ALL ranks first when they are sharded
+    — see SPMDTrainer.get_params's collective note); other ranks write
+    only their replica shards (nothing at all when replication is off)
+    and return the same epoch.
     """
 
     MANIFEST = "manifest.json"
+
+    #: bound on draining an in-flight async write before a blocking save
+    #: (or the preemption path) proceeds anyway — wedged storage must
+    #: not turn a durable save into an indefinite hang
+    DRAIN_TIMEOUT = 60.0
 
     def __init__(self, directory, prefix="checkpoint", keep_last=5):
         self.directory = os.fspath(directory)
         self.prefix = prefix
         self.keep_last = None if keep_last is None else max(1, int(keep_last))
-        if _rank() == 0:
-            os.makedirs(self.directory, exist_ok=True)
+        self._writer = None
+        # every rank may write (replica shards), so every rank needs the
+        # directory — on per-host disks each rank creates its own
+        os.makedirs(self.directory, exist_ok=True)
 
     # -- paths ------------------------------------------------------------
     def _path(self, name):
@@ -742,14 +1110,27 @@ class CheckpointManager(object):
     def states_path(self, epoch):
         return self._path("%s-%04d.states" % (self.prefix, epoch))
 
+    def shard_name(self, epoch, part, offset=0):
+        """Basename of shard ``part``'s file for ``epoch`` — the primary
+        (offset 0, written by rank ``part``) or the ring-offset replica
+        (written by rank ``(part - offset) % world``)."""
+        name = "%s-%04d.shard%03d" % (self.prefix, epoch, part)
+        return name if offset == 0 else "%s.rep%d" % (name, offset)
+
+    def _tombstone_path(self, epoch):
+        return self._path("%s-%04d.pruning" % (self.prefix, int(epoch)))
+
     # -- manifest ---------------------------------------------------------
     def _scan_directory(self):
         """Rebuild a manifest by scanning the directory for this prefix's
         params files — the recovery path when ``manifest.json`` itself is
         corrupt (torn by a dying disk, truncated by an operator cp).  The
         params files are each atomic, so whatever the scan finds is
-        individually complete; only step_state (mid-epoch metadata) is
-        unrecoverable this way."""
+        individually complete; only step_state (mid-epoch metadata) and
+        the per-file checksums are unrecoverable this way.  Epochs with a
+        ``.pruning`` tombstone are IGNORED: retention had already
+        committed to deleting them (the pruned manifest was written
+        first), so a crash mid-prune must not resurrect them here."""
         import re as _re
         pat = _re.compile(_re.escape(self.prefix) + r"-(\d{4,})\.params$")
         entries = []
@@ -762,6 +1143,11 @@ class CheckpointManager(object):
             if not m:
                 continue
             epoch = int(m.group(1))
+            if os.path.exists(self._tombstone_path(epoch)):
+                _LOG.warning(
+                    "CheckpointManager: directory scan ignoring epoch %d "
+                    "— a retention tombstone marks it half-deleted", epoch)
+                continue
             states = os.path.basename(self.states_path(epoch))
             entries.append({"epoch": epoch, "params": name,
                             "states": states if os.path.exists(
@@ -799,12 +1185,14 @@ class CheckpointManager(object):
                      fault_point="manifest_write")
 
     def checkpoints(self):
-        """Epochs recorded in the manifest whose params file exists,
-        ascending."""
+        """Epochs recorded in the manifest whose params file exists (or
+        that carry shard records — a missing primary can still be rebuilt
+        from peer replicas), ascending."""
         out = []
         for entry in self._read_manifest().get("checkpoints", []):
             epoch = int(entry["epoch"])
-            if os.path.exists(self.params_path(epoch)):
+            if os.path.exists(self.params_path(epoch)) or \
+                    entry.get("shards"):
                 out.append(epoch)
         return sorted(out)
 
@@ -827,9 +1215,10 @@ class CheckpointManager(object):
         epoch = self.latest()
         return None if epoch is None else self.entry(epoch)
 
-    # -- save/restore -----------------------------------------------------
+    # -- save -------------------------------------------------------------
     def save(self, epoch, symbol=None, arg_params=None, aux_params=None,
-             optimizer_states=None, step_state=None):
+             optimizer_states=None, step_state=None, blocking=None,
+             rank=None, world=None):
         """Write one checkpoint atomically; returns the epoch.
 
         ``optimizer_states`` is the serialized blob (bytes) from
@@ -841,49 +1230,344 @@ class CheckpointManager(object):
         epoch-end save of the same epoch number later replaces the entry
         (and clears the flag) — partial checkpoints never outlive the
         complete epoch they belong to.
-        On ranks != 0 this is a no-op (gather before calling — see class
-        docstring).
+
+        ``blocking=False`` (default: ``MXTPU_CKPT_ASYNC``) returns after
+        snapshotting the values to host numpy copies; this manager's
+        :class:`CheckpointWriter` then serializes, writes atomically and
+        updates the manifest in the background — call :meth:`wait` to
+        drain (``fit`` drains at the end of training and before a
+        preemption exit).
+
+        On ranks != 0 this writes only replica shards (nothing when
+        ``MXTPU_CKPT_REPLICAS`` is 0) — gather on every rank before
+        calling (see class docstring).  ``rank``/``world`` are
+        injectable for single-process replication tests.
         """
+        from .base import get_env
         epoch = int(epoch)
-        if _rank() != 0:
+        rank = _rank() if rank is None else int(rank)
+        world = _world() if world is None else int(world)
+        raw_replicas = get_env(ENV_CKPT_REPLICAS, "0")
+        try:
+            replicas = int(raw_replicas or 0)
+        except (TypeError, ValueError):
+            # an operator typo must degrade (like MXTPU_CKPT_CHECKSUM's
+            # fallback), not crash every epoch-end save
+            _LOG.warning("%s=%r is not an integer — replication disabled",
+                         ENV_CKPT_REPLICAS, raw_replicas)
+            replicas = 0
+        replicas = min(max(0, replicas), max(0, world - 1))
+        if rank != 0 and replicas <= 0:
             return epoch
+        if blocking is None:
+            blocking = not checkpoint_async()
+        sym_json = symbol if isinstance(symbol, str) or symbol is None \
+            else symbol.tojson()
+        if not blocking:
+            # the ONLY synchronous cost of an async save: freeze the
+            # values while training keeps mutating device/host params
+            arg_params = snapshot_params(arg_params)
+            aux_params = snapshot_params(aux_params)
+        step_state = dict(step_state) if step_state is not None else None
+
+        def job():
+            self._write_checkpoint(epoch, sym_json, arg_params or {},
+                                   aux_params or {}, optimizer_states,
+                                   step_state, rank, world, replicas)
+
+        if blocking:
+            if self._writer is not None:
+                # an in-flight async write and this caller-thread write
+                # would both read-modify-write manifest.json (one
+                # epoch's entry silently lost, and racing prunes could
+                # delete files the other just recorded) — drain first.
+                # Bounded: on wedged storage a durable save degrades to
+                # the pre-drain behavior instead of hanging forever
+                # (the wedged writer is stalled pre-manifest anyway).
+                try:
+                    self._writer.wait(timeout=self.DRAIN_TIMEOUT)
+                except MXNetError as e:
+                    _LOG.warning(
+                        "CheckpointManager: draining the async writer "
+                        "before a blocking save: %s — proceeding (this "
+                        "blocking save supersedes it)", e)
+            job()
+        else:
+            if self._writer is None:
+                self._writer = CheckpointWriter(
+                    name="mxtpu-ckpt-writer[%s]" % self.prefix)
+            self._writer.submit(job, "epoch %d" % epoch)
+        return epoch
+
+    def wait(self, timeout=None):
+        """Drain this manager's background writer (no-op when every save
+        so far was blocking).  Re-raises a failed background write."""
+        if self._writer is None:
+            return None
+        return self._writer.wait(timeout)
+
+    def last_result(self):
+        """{"label", "error", "elapsed_s"} of the most recently finished
+        background write, or None."""
+        if self._writer is None:
+            return None
+        return self._writer.last_result()
+
+    def _write_checkpoint(self, epoch, sym_json, arg_params, aux_params,
+                          optimizer_states, step_state, rank, world,
+                          replicas):
+        """The write pipeline (caller thread when blocking, writer thread
+        when async): files -> ``ckpt_write`` fault point -> manifest."""
+        algo = _checksum_algo()
+        # a stale tombstone from an interrupted prune must not hide the
+        # epoch this save is about to (re)write
+        try:
+            os.remove(self._tombstone_path(epoch))
+        except OSError:
+            pass
+        parts = None
+        if world > 1 and replicas > 0:
+            need = None if rank == 0 else \
+                {(rank + o) % world for o in range(replicas + 1)}
+            parts = self._shard_parts(epoch, arg_params, aux_params,
+                                      optimizer_states, world, need=need)
+        if rank != 0:
+            self._write_shards(epoch, parts, rank, world, replicas)
+            # rank 0's manifest-driven retention never touches THIS
+            # host's directory on per-host disks, so every shard writer
+            # prunes its own view (harmless on a shared disk: it
+            # removes the same files rank 0 would)
+            self._prune_local_shards()
+            return
+        files = {}
         # one serialization contract: the classic prefix-based writer (made
         # atomic in this same subsystem) produces exactly this manager's
         # params/symbol layout, so files stay loadable by load_checkpoint
         from .model import save_checkpoint as _save_checkpoint
         _save_checkpoint(os.path.join(self.directory, self.prefix), epoch,
-                         symbol, arg_params or {}, aux_params or {})
+                         sym_json, arg_params, aux_params, blocking=True)
+        params_name = os.path.basename(self.params_path(epoch))
+        files[params_name] = self._file_record(self.params_path(epoch),
+                                               algo)
+        if sym_json is not None:
+            sym_name = os.path.basename(self.symbol_path())
+            files[sym_name] = self._file_record(self.symbol_path(), algo)
         has_states = optimizer_states is not None
         if has_states:
             atomic_write(self.states_path(epoch), optimizer_states)
-        manifest = self._read_manifest()
-        entries = [e for e in manifest.get("checkpoints", [])
-                   if int(e["epoch"]) != epoch]
+            states_name = os.path.basename(self.states_path(epoch))
+            files[states_name] = self._file_record(self.states_path(epoch),
+                                                   algo)
+        shard_meta = None
+        if parts is not None:
+            self._write_shards(epoch, parts, 0, world, replicas)
+            shard_meta = {"world": world, "replicas": replicas,
+                          "parts": []}
+            for p in range(world):
+                size, digest = checksum_bytes(parts[p], algo)
+                shard_meta["parts"].append({
+                    "shard": p,
+                    "file": self.shard_name(epoch, p),
+                    "size": size, "digest": digest,
+                    "replicas": [self.shard_name(epoch, p, o)
+                                 for o in range(1, replicas + 1)]})
+        # the SIGKILL-mid-save window: all data files are on disk, the
+        # manifest is not — a kill here must leave the previous epoch as
+        # the newest RESTORABLE checkpoint (chaos drill)
+        faults.maybe_trip("ckpt_write",
+                          "injected checkpoint-writer failure before "
+                          "publishing epoch %d" % epoch)
         entry = {"epoch": epoch,
-                 "params": os.path.basename(self.params_path(epoch)),
+                 "params": params_name,
                  "states": (os.path.basename(self.states_path(epoch))
                             if has_states else None),
-                 "time": time.time()}
+                 "time": time.time(),
+                 "checksum": algo,
+                 "files": files}
+        if shard_meta is not None:
+            entry["shards"] = shard_meta
         if step_state is not None:
-            entry["step_state"] = dict(step_state)
+            entry["step_state"] = step_state
+        self._update_manifest(entry)
+        _LOG.info("CheckpointManager: saved epoch %d to %s", epoch,
+                  self.params_path(epoch))
+
+    @staticmethod
+    def _file_record(path, algo):
+        size, digest = checksum_file(path, algo)
+        return {"size": size, "digest": digest}
+
+    def _update_manifest(self, entry):
+        """Publish ``entry`` and apply ``keep_last`` retention, hardened
+        against a crash mid-prune: tombstones mark the condemned epochs,
+        the PRUNED manifest is written before any file is deleted, and
+        the directory entry is fsynced after the deletes — so no crash
+        window can resurrect a pruned epoch (via the manifest, which no
+        longer lists it, or via the corrupt-manifest directory scan,
+        which skips tombstoned epochs)."""
+        manifest = self._read_manifest()
+        entries = [e for e in manifest.get("checkpoints", [])
+                   if int(e["epoch"]) != int(entry["epoch"])]
+        sym_name = os.path.basename(self.symbol_path())
+        if sym_name in (entry.get("files") or {}):
+            # the symbol file is SHARED and rewritten by every save —
+            # this save's record is the only one that describes the
+            # bytes now on disk, so older entries must stop vouching
+            # for it (an equivalent re-created Symbol can serialize
+            # with different auto-generated names)
+            for e in entries:
+                (e.get("files") or {}).pop(sym_name, None)
         entries.append(entry)
         entries.sort(key=lambda e: int(e["epoch"]))
+        stale = []
         if self.keep_last is not None and len(entries) > self.keep_last:
-            for stale in entries[:-self.keep_last]:
-                for path in (self.params_path(int(stale["epoch"])),
-                             self.states_path(int(stale["epoch"]))):
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
+            stale = entries[:-self.keep_last]
             entries = entries[-self.keep_last:]
+        for e in stale:
+            atomic_write(self._tombstone_path(e["epoch"]),
+                         json.dumps({"epoch": int(e["epoch"])}),
+                         fault_point="tombstone_write")
         manifest["prefix"] = self.prefix
         manifest["checkpoints"] = entries
         self._write_manifest(manifest)
-        _LOG.info("CheckpointManager: saved epoch %d to %s", epoch,
-                  self.params_path(epoch))
-        return epoch
+        # crash window for the retention regression test: manifest is
+        # already pruned, tombstones exist, files not yet deleted
+        faults.maybe_fail("ckpt_prune",
+                          "injected crash between manifest prune and "
+                          "file deletion")
+        for e in stale:
+            self._delete_entry_files(e)
+        self._finish_pending_prunes({int(e["epoch"]) for e in entries})
+        _fsync_dir(self._path(self.MANIFEST))
 
+    def _delete_entry_files(self, entry):
+        """Remove one pruned epoch's files, then its tombstone."""
+        epoch = int(entry["epoch"])
+        paths = [self.params_path(epoch), self.states_path(epoch)]
+        shards = entry.get("shards") or {}
+        for part in shards.get("parts", []):
+            paths.append(self._path(part["file"]))
+            paths.extend(self._path(f) for f in part.get("replicas", []))
+        for path in paths:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        try:
+            os.remove(self._tombstone_path(epoch))
+        except OSError:
+            pass
+
+    def _finish_pending_prunes(self, live_epochs):
+        """Complete prunes an earlier crash interrupted: any lingering
+        tombstone for a non-live epoch gets its files deleted now; a
+        tombstone for a live epoch (a prune that never committed its
+        manifest) is simply cleared."""
+        import re as _re
+        pat = _re.compile(_re.escape(self.prefix) + r"-(\d{4,})\.pruning$")
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            m = pat.match(name)
+            if not m:
+                continue
+            epoch = int(m.group(1))
+            if epoch in live_epochs:
+                try:
+                    os.remove(self._path(name))
+                except OSError:
+                    pass
+                continue
+            _LOG.info("CheckpointManager: completing interrupted prune of "
+                      "epoch %d", epoch)
+            entry = self.entry(epoch) or {"epoch": epoch}
+            self._delete_entry_files(entry)
+            # shard files an old manifest no longer names
+            stem = "%s-%04d.shard" % (self.prefix, epoch)
+            for other in names:
+                if other.startswith(stem):
+                    try:
+                        os.remove(self._path(other))
+                    except OSError:
+                        pass
+
+    def _prune_local_shards(self):
+        """``keep_last`` retention over the shard files in THIS host's
+        directory — the counterpart of rank 0's manifest-driven pruning
+        for ranks that write only replica shards: keep the newest
+        ``keep_last`` shard-bearing epochs, delete everything older."""
+        if self.keep_last is None:
+            return
+        import re as _re
+        pat = _re.compile(_re.escape(self.prefix) +
+                          r"-(\d{4,})\.shard\d{3}(\.rep\d+)?$")
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        by_epoch = {}
+        for name in names:
+            m = pat.match(name)
+            if m:
+                by_epoch.setdefault(int(m.group(1)), []).append(name)
+        live = set(sorted(by_epoch)[-self.keep_last:])
+        for ep, files in by_epoch.items():
+            if ep in live:
+                continue
+            for name in files:
+                try:
+                    os.remove(self._path(name))
+                except OSError:
+                    pass
+
+    # -- replication shards ------------------------------------------------
+    def _shard_parts(self, epoch, arg_params, aux_params, states, world,
+                     need=None):
+        """Serialize the gathered state into deterministic key-partition
+        shards (round-robin over sorted names; the states blob is split
+        into contiguous byte ranges) -> ``{part_index: bytes}``.
+        Deterministic by construction — every rank computes
+        byte-identical parts from its replicated copy, so rank 0 can
+        record all digests without reading peer disks.  ``need`` limits
+        which partitions are built (a non-zero rank writes only its own
+        shard + ``replicas`` neighbors; pickling all ``world`` parts
+        there would be O(world) redundant CPU per save); None = all."""
+        import pickle
+        import numpy as np
+        merged = {}
+        for k, v in (arg_params or {}).items():
+            merged["arg:%s" % k] = np.ascontiguousarray(_host_value(v))
+        for k, v in (aux_params or {}).items():
+            merged["aux:%s" % k] = np.ascontiguousarray(_host_value(v))
+        keys = sorted(merged)
+        parts = {}
+        for p in range(world) if need is None else sorted(need):
+            part_keys = {k: merged[k] for i, k in enumerate(keys)
+                         if i % world == p}
+            chunk = None
+            if states is not None:
+                n = len(states)
+                chunk = states[p * n // world:(p + 1) * n // world]
+            parts[p] = pickle.dumps(
+                {"epoch": int(epoch), "shard": p, "world": world,
+                 "keys": part_keys, "states_chunk": chunk},
+                protocol=4)
+        return parts
+
+    def _write_shards(self, epoch, parts, rank, world, replicas):
+        """Rank ``rank``'s shard writes: its own partition (offset 0)
+        plus its ring neighbors' partitions at offsets 1..replicas —
+        shard p's offset-o replica is written by rank (p - o) % world,
+        so losing any one rank's disk leaves every partition
+        recoverable."""
+        for o in range(0, replicas + 1):
+            p = (rank + o) % world
+            atomic_write(self._path(self.shard_name(epoch, p, o)),
+                         parts[p], fault_point="shard_write")
+
+    # -- restore -----------------------------------------------------------
     def restore(self, epoch=None):
         """Load (symbol, arg_params, aux_params, optimizer_states, epoch)
         for ``epoch`` (default: latest).  ``symbol`` is None when no
@@ -913,16 +1597,149 @@ class CheckpointManager(object):
                          "unreadable (last: %s)"
                          % (self.directory, last_err)) from last_err
 
+    def _verify_files(self, entry, names):
+        """Check size + checksum of ``names`` (basenames with records in
+        the entry) BEFORE any deserialization — bit rot that would still
+        unpickle cleanly must be caught here, not restored silently.
+        Raises MXNetError naming the first damaged file."""
+        algo = entry.get("checksum")
+        files = entry.get("files") or {}
+        for name in names:
+            rec = files.get(name)
+            if rec is None:
+                continue  # legacy entry without integrity records
+            path = self._path(name)
+            if not os.path.exists(path):
+                raise MXNetError("checkpoint file %r is missing" % name)
+            if not algo or algo == "off" or not rec.get("digest"):
+                if os.path.getsize(path) != rec["size"]:
+                    raise MXNetError(
+                        "checkpoint file %r is %d bytes, manifest "
+                        "recorded %d" % (name, os.path.getsize(path),
+                                         rec["size"]))
+                continue
+            size, digest = checksum_file(path, algo)
+            if size != rec["size"] or digest != rec["digest"]:
+                raise MXNetError(
+                    "checkpoint file %r fails verification (%s: got "
+                    "%s/%d bytes, manifest recorded %s/%d bytes)"
+                    % (name, algo, digest, size, rec["digest"],
+                       rec["size"]))
+
+    def _restore_from_shards(self, epoch, entry):
+        """Rebuild (arg_params, aux_params, states) from the replicated
+        key-partition shards — each partition from its primary file, or
+        from the first intact peer replica when the primary is missing
+        or fails its checksum.  Raises when any partition has no intact
+        copy (the walk-back then degrades to the previous epoch)."""
+        import pickle
+        from . import ndarray as nd
+        algo = entry.get("checksum")
+        shards = entry["shards"]
+        merged, chunks = {}, {}
+        for part in shards.get("parts", []):
+            payload = None
+            for fname in [part["file"]] + list(part.get("replicas", [])):
+                path = self._path(fname)
+                if not os.path.exists(path):
+                    continue
+                if algo and algo != "off" and part.get("digest"):
+                    size, digest = checksum_file(path, algo)
+                    if size != part["size"] or digest != part["digest"]:
+                        _LOG.warning(
+                            "CheckpointManager: shard copy %r fails "
+                            "verification — trying the next replica",
+                            fname)
+                        continue
+                # deserialization must also fall through to the next
+                # replica: with checksums off (or a legacy record with
+                # no digest) a truncated/corrupt copy surfaces HERE,
+                # and an intact peer replica may still hold the shard
+                try:
+                    with open(path, "rb") as f:
+                        candidate = pickle.loads(f.read())
+                    if not isinstance(candidate.get("keys"), dict):
+                        raise ValueError("not a shard payload")
+                except Exception as e:  # noqa: BLE001 — any rot flavor
+                    _LOG.warning(
+                        "CheckpointManager: shard copy %r is unreadable "
+                        "(%s: %s) — trying the next replica",
+                        fname, type(e).__name__, e)
+                    continue
+                payload = candidate
+                if fname != part["file"]:
+                    _LOG.warning(
+                        "CheckpointManager: shard %d of epoch %d "
+                        "recovered from peer replica %r",
+                        part["shard"], epoch, fname)
+                break
+            if payload is None:
+                raise MXNetError(
+                    "shard %d of epoch %d has no intact copy (primary "
+                    "or replica)" % (part["shard"], epoch))
+            merged.update(payload["keys"])
+            if payload.get("states_chunk") is not None:
+                chunks[payload["shard"]] = payload["states_chunk"]
+        arg_params, aux_params = {}, {}
+        for k, v in merged.items():
+            tp, name = k.split(":", 1)
+            if tp == "arg":
+                arg_params[name] = nd.array(v, dtype=v.dtype)
+            elif tp == "aux":
+                aux_params[name] = nd.array(v, dtype=v.dtype)
+        states = b"".join(chunks[i] for i in sorted(chunks)) \
+            if chunks else None
+        return arg_params, aux_params, states
+
+    def _symbol_entry(self):
+        """The newest manifest entry carrying the shared symbol file's
+        integrity record — the only entry that describes the bytes now
+        on disk (every save rewrites the file, and _update_manifest
+        moves the record to the writing entry)."""
+        sym_name = os.path.basename(self.symbol_path())
+        for e in reversed(self._read_manifest().get("checkpoints", [])):
+            if sym_name in (e.get("files") or {}):
+                return e
+        return None
+
     def _restore_epoch(self, epoch):
         from . import ndarray as nd
         from . import symbol as sym_mod
-        params_file = self.params_path(epoch)
-        if not os.path.exists(params_file):
-            raise MXNetError("CheckpointManager: epoch %d has no params "
-                             "file %r" % (epoch, params_file))
+        entry = self.entry(epoch) or {}
+        # the symbol file is SHARED and has no shard redundancy, so it
+        # is verified against the newest record REGARDLESS of which
+        # epoch is being restored (older entries stopped vouching for
+        # it) — a damaged symbol must fail every epoch and surface,
+        # never ride a walk-back into an epoch with no record
+        if os.path.exists(self.symbol_path()):
+            sym_entry = self._symbol_entry()
+            if sym_entry is not None:
+                self._verify_files(
+                    sym_entry, [os.path.basename(self.symbol_path())])
         symbol = None
         if os.path.exists(self.symbol_path()):
             symbol = sym_mod.load(self.symbol_path())
+        params_file = self.params_path(epoch)
+        use_shards = False
+        try:
+            if not os.path.exists(params_file):
+                raise MXNetError("CheckpointManager: epoch %d has no "
+                                 "params file %r" % (epoch, params_file))
+            self._verify_files(
+                entry, [os.path.basename(params_file),
+                        os.path.basename(self.states_path(epoch))])
+        except MXNetError as e:
+            if not entry.get("shards"):
+                raise
+            _LOG.warning(
+                "CheckpointManager: epoch %d primary files failed "
+                "verification (%s) — rebuilding from shard replicas",
+                epoch, e)
+            use_shards = True
+        if use_shards:
+            arg_params, aux_params, states = \
+                self._restore_from_shards(epoch, entry)
+            return symbol, arg_params, aux_params, states, epoch
         arg_params, aux_params = {}, {}
         for k, v in nd.load(params_file).items():
             tp, name = k.split(":", 1)

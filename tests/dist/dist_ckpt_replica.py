@@ -1,0 +1,121 @@
+"""Replicated-checkpoint drill worker (run under tools/launch.py).
+
+The Gemini-style redundancy story, end to end on the virtual CPU
+cluster: every rank trains through the fused SPMD path with managed
+checkpointing and ``MXTPU_CKPT_REPLICAS=1``, so each rank writes its own
+key-partition shard PLUS its ring neighbor's.  Rank 0 then simulates the
+double fault — the full params file AND one rank's primary shard both
+rot (flipped bytes, still valid formats) — and EVERY rank must still
+restore the newest epoch bit-identical, rebuilding the damaged partition
+from the peer-written replica.
+
+Launch:  python tools/launch.py -n 3 --platform cpu \
+             python tests/dist/dist_ckpt_replica.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+# armed before any manager exists; read via base.get_env at save time
+os.environ["MXTPU_CKPT_REPLICAS"] = "1"
+
+from mxnet_tpu import distributed
+
+distributed.initialize()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.symbol as sym  # noqa: E402
+from mxnet_tpu.resilience import CheckpointManager  # noqa: E402
+
+
+def build_net():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    act = sym.Activation(data=fc1, act_type="relu")
+    fc2 = sym.FullyConnected(data=act, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _flip_float_byte(path, value):
+    """Rot one mantissa bit of ``value``'s float32 payload — the file
+    still parses; only the checksum knows.  ``value`` is a trained
+    weight, so its 4 bytes are effectively unique in the file."""
+    import struct
+    pat = struct.pack("<f", float(value))
+    blob = bytearray(open(path, "rb").read())
+    i = bytes(blob).find(pat)
+    assert i >= 0, "float payload %r not found in %s" % (value, path)
+    blob[i] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+
+def main():
+    ckpt_dir = os.environ["DIST_CKPT_DIR"]
+    kv = mx.kv.create("tpu")
+    rank, nworker = kv.rank, kv.num_workers
+
+    rs = np.random.RandomState(0)  # same dataset on every worker
+    N, D = 768, 20
+    X = rs.randn(N, D).astype("f")
+    w = rs.randn(D, 3).astype("f")
+    y = X.dot(w).argmax(axis=1).astype("f")
+    Xs, ys = X[rank::nworker], y[rank::nworker]
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=64, shuffle=False)
+
+    mod = mx.mod.Module(build_net())
+    mx.random.seed(7)
+    mod.fit(it, num_epoch=2, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(),
+            checkpoint=ckpt_dir)
+    assert mod._fused is not None, "fused SPMD path did not engage"
+    want = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+
+    # rank 0 publishes the manifest; peers must not inspect it before
+    # rank 0's epoch-2 write has landed
+    distributed.barrier("ckpt_replica_saved")
+
+    man = CheckpointManager(ckpt_dir)
+    entry = man.latest_entry()
+    assert entry["epoch"] == 2, entry
+    shards = entry["shards"]
+    assert shards["world"] == nworker and shards["replicas"] == 1, shards
+    # every rank's primary shard and its neighbor-written replica landed
+    for part in shards["parts"]:
+        for fname in [part["file"]] + part["replicas"]:
+            assert os.path.exists(os.path.join(ckpt_dir, fname)), fname
+
+    if rank == 0:
+        # the double fault: rank 0's full params file rots AND the
+        # victim rank's own shard rots — its state now exists only in
+        # the replica its ring neighbor wrote
+        victim = 1 % nworker
+        probe = float(want[sorted(want)[0]].ravel()[0])
+        _flip_float_byte(man.params_path(2), probe)
+        part = shards["parts"][victim]
+        # find a value actually inside the victim's partition
+        import pickle
+        with open(os.path.join(ckpt_dir, part["file"]), "rb") as f:
+            payload = pickle.loads(f.read())
+        val = float(next(iter(
+            v.ravel()[0] for v in payload["keys"].values()
+            if v.size and float(v.ravel()[0]) != 0.0)))
+        _flip_float_byte(os.path.join(ckpt_dir, part["file"]), val)
+    distributed.barrier("ckpt_replica_corrupted")
+
+    _, args, _, states, epoch = man.restore()
+    assert epoch == 2, epoch
+    assert states is not None
+    for name in want:
+        assert np.array_equal(want[name], args[name].asnumpy()), name
+    print("dist_ckpt_replica rank %d/%d: OK (rebuilt from peer replica)"
+          % (rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

@@ -360,6 +360,43 @@ def test_preemption_safe_requires_checkpoint():
 
 
 # ---------------------------------------------------------------------------
+# async checkpoint writer under fire (ckpt_write fault/hang point)
+# ---------------------------------------------------------------------------
+
+def test_fault_maybe_trip_hang_vs_fail(clean_faults):
+    """One point, both flavors: arm() makes maybe_trip raise (failing
+    disk), arm_hang() makes it stall (the SIGKILL-mid-save window)."""
+    clean_faults.arm("ckpt_write")
+    with pytest.raises(TransientError):
+        faults.maybe_trip("ckpt_write")
+    clean_faults.arm_hang("ckpt_write", seconds=0.15)
+    t0 = time.monotonic()
+    faults.maybe_trip("ckpt_write")
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_preemption_drains_async_writer_before_exit85(tmp_path,
+                                                      clean_faults,
+                                                      monkeypatch):
+    """MXTPU_CKPT_ASYNC=1 + SIGTERM: the preemption save drains any
+    in-flight background write and lands BLOCKING, so the exit-85
+    contract ('checkpoint is on disk') is unchanged — proved by the
+    resumed run being bit-identical."""
+    monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+    full = _run_fit(str(tmp_path / "full"), 3)
+    cut_dir = str(tmp_path / "cut")
+    assert _run_fit(cut_dir, 3, preempt_after=5) is None
+    man = CheckpointManager(cut_dir)
+    # on disk and discoverable at exit time — no pending writer state
+    entry = man.latest_entry()
+    assert entry["step_state"]["epoch"] == 1
+    assert entry["files"]  # checksummed like any save
+    resumed = _run_fit(cut_dir, 3, resume=True)
+    for name in full:
+        assert np.array_equal(full[name], resumed[name]), name
+
+
+# ---------------------------------------------------------------------------
 # staging / collective fault points (the watchdog's production targets,
 # reproducible on CPU)
 # ---------------------------------------------------------------------------
@@ -574,6 +611,110 @@ def test_chaos_drill_kill_and_resume_bit_identical(tmp_path):
     man = CheckpointManager(str(tmp_path / "cut"))
     assert man.latest() == 3
     assert "step_state" not in man.latest_entry()
+
+    full = _load_params(tmp_path / "full.params")
+    cut = _load_params(tmp_path / "cut.params")
+    assert set(full) == set(cut)
+    for name in full:
+        assert np.array_equal(full[name], cut[name]), name
+
+
+CKPT_DRILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import faults
+
+def make_blobs(n, d, c, seed=4):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+X, y = make_blobs(256, 10, 3)
+it = mx.io.NDArrayIter(X, y, batch_size=64)
+mod = mx.mod.Module(sym)
+mx.random.seed(21)
+
+if os.environ.get("CHAOS_CKPT_HANG") and \\
+        os.environ.get("MXTPU_RESUME") != "1":
+    # wedge the background writer mid-save of epoch 2: its data files
+    # are on disk, the manifest is not — then the parent SIGKILLs us
+    faults.arm_hang("ckpt_write", seconds=3600, after=1)
+
+mod.fit(it, num_epoch=3, kvstore="tpu", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        initializer=mx.initializer.Xavier(),
+        checkpoint=os.environ["CHAOS_DIR"])
+mod.save_params(os.environ["CHAOS_OUT"])
+"""
+
+
+@pytest.mark.chaos
+def test_chaos_sigkill_mid_async_save_resumes_previous_epoch(tmp_path):
+    """SIGKILL delivered while the async writer is mid-save of epoch 2
+    (epoch-2 files written, manifest not yet published): the torn save
+    must never be restorable — the relaunch resumes from epoch 1 and
+    finishes bit-identical to an uninterrupted run."""
+    script = tmp_path / "train.py"
+    script.write_text(CKPT_DRILL_SCRIPT % {"repo": REPO})
+    env = _drill_env(tmp_path, "full")
+    env["MXTPU_CKPT_ASYNC"] = "1"
+    res = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+
+    cut_dir = tmp_path / "cut"
+    env = _drill_env(tmp_path, "cut")
+    env["MXTPU_CKPT_ASYNC"] = "1"
+    env["CHAOS_CKPT_HANG"] = "1"
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        # the wedged writer has already landed epoch 2's data files
+        # (states is written last before the hang point) — kill inside
+        # the hang, before the manifest could ever be published
+        deadline = time.monotonic() + 120
+        states2 = cut_dir / "checkpoint-0002.states"
+        while time.monotonic() < deadline and not states2.exists():
+            assert proc.poll() is None, "drill process died early"
+            time.sleep(0.05)
+        assert states2.exists(), "epoch-2 save never started"
+        time.sleep(0.5)  # let the writer reach the armed hang
+        proc.kill()      # SIGKILL: no cleanup, no atexit, no finally
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # the torn epoch-2 save is not restorable: manifest still ends at 1
+    man = CheckpointManager(str(cut_dir))
+    assert man.latest() == 1
+    entry = man.latest_entry()
+    assert entry["epoch"] == 1 and entry["files"]
+
+    # relaunch-and-resume lands on epoch 1 and retrains to parity
+    env = _drill_env(tmp_path, "cut")
+    env["MXTPU_CKPT_ASYNC"] = "1"
+    env["MXTPU_RESUME"] = "1"
+    res = subprocess.run([sys.executable, str(script)], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-2000:]
+    # the resumed run re-saved epochs 2 and 3 (replacing the torn files)
+    assert man.latest() == 3
 
     full = _load_params(tmp_path / "full.params")
     cut = _load_params(tmp_path / "cut.params")

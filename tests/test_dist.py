@@ -57,6 +57,26 @@ def test_dist_module_fit_fused(nworkers):
             in res.stdout
 
 
+@pytest.mark.parametrize("nworkers", [3])
+def test_dist_ckpt_replica_recovery(tmp_path, nworkers):
+    """Replicated checkpoints (MXTPU_CKPT_REPLICAS=1): every rank writes
+    its own key-partition shard plus its ring neighbor's; after the full
+    params file AND one rank's primary shard rot, every rank restores
+    the newest epoch bit-identical from the peer-written replica."""
+    worker = os.path.join(REPO, "tests", "dist", "dist_ckpt_replica.py")
+    env = _clean_env()
+    env["DIST_CKPT_DIR"] = str(tmp_path / "ckpt")
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", str(nworkers), "--platform", "cpu",
+         sys.executable, worker],
+        env=env, capture_output=True, text=True, timeout=600)
+    sys.stdout.write(res.stdout[-4000:])
+    assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-2000:]
+    for r in range(nworkers):
+        assert ("dist_ckpt_replica rank %d/%d: OK" % (r, nworkers)
+                in res.stdout)
+
+
 def test_launcher_propagates_failure():
     res = subprocess.run(
         [sys.executable, LAUNCH, "-n", "2", "--platform", "cpu",

@@ -553,6 +553,564 @@ def test_prefetcher_surfaces_exhausted_retries(monkeypatch, clean_faults):
 
 
 # ---------------------------------------------------------------------------
+# checksummed manifests + verified restore
+# ---------------------------------------------------------------------------
+
+def _flip_payload_byte(path, value):
+    """Flip one mantissa bit inside the serialized float32 payload for
+    ``value`` — the file still parses cleanly (valid format, wrong
+    numbers): the bit rot only checksums can catch."""
+    import struct
+    pat = struct.pack("<f", float(value)) * 2
+    blob = bytearray(open(path, "rb").read())
+    i = bytes(blob).find(pat)
+    assert i >= 0, "float payload %r not found in %s" % (value, path)
+    blob[i] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+
+def test_manifest_records_file_checksums(tmp_path):
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, mlp_sym(), {"w": mx.nd.array(np.ones((3, 2), "f"))}, {},
+             optimizer_states=b"state-1")
+    entry = man.latest_entry()
+    assert entry["checksum"] == "sha256"
+    files = entry["files"]
+    assert set(files) == {"checkpoint-0001.params",
+                          "checkpoint-0001.states",
+                          "checkpoint-symbol.json"}
+    for name, rec in files.items():
+        size, digest = mx.resilience.checksum_file(
+            str(tmp_path / name), "sha256")
+        assert (size, digest) == (rec["size"], rec["digest"]), name
+
+
+def test_restore_detects_bitflip_that_parses_cleanly(tmp_path):
+    """A flipped payload byte leaves the params file loadable — the old
+    walk-back (unpickle errors only) restored it silently.  The checksum
+    verify must catch it and degrade to the previous epoch."""
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    _flip_payload_byte(str(tmp_path / "checkpoint-0002.params"), 2)
+    # the rotted file still parses — only the checksum knows
+    assert mx.nd.load(str(tmp_path / "checkpoint-0002.params"))
+    _, args, _, _, epoch = man.restore()
+    assert epoch == 1
+    assert np.allclose(args["w"].asnumpy(), 1.0)
+    # explicitly requesting the rotten epoch still raises
+    with pytest.raises(MXNetError, match="verification"):
+        man.restore(2)
+
+
+def test_corrupt_symbol_never_restored_silently(tmp_path):
+    """The shared symbol file only carries a checksum record on the
+    NEWEST manifest entry (each save rewrites the file and moves the
+    record forward), so every epoch's restore must verify it against
+    that newest record — the walk-back previously landed on an older
+    entry with no record and returned the rotted symbol silently."""
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2):
+        man.save(epoch, mlp_sym(),
+                 {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    path = tmp_path / "checkpoint-symbol.json"
+    # flip one letter inside a node-name string: still valid JSON
+    path.write_bytes(path.read_bytes().replace(b"fc1", b"fc9", 1))
+    json.loads(path.read_text())  # parses cleanly — only the checksum knows
+    with pytest.raises(MXNetError, match="verification"):
+        man.restore()  # the walk-back must NOT reach an unverified epoch
+    with pytest.raises(MXNetError, match="verification"):
+        man.restore(1)
+
+
+def test_checksum_algos(monkeypatch, tmp_path):
+    from mxnet_tpu.resilience import checksum_bytes
+    # known vectors: CRC32C("hello") = 0x9a71bb4c, zlib CRC32 = 0x3610a686
+    assert checksum_bytes(b"hello", "crc32c") == (5, "9a71bb4c")
+    assert checksum_bytes(b"hello", "crc32") == (5, "3610a686")
+    assert checksum_bytes(b"hello", "off") == (5, None)
+    assert len(checksum_bytes(b"hello", "sha256")[1]) == 64
+    # the selector routes through the manifest
+    monkeypatch.setenv("MXTPU_CKPT_CHECKSUM", "crc32c")
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    entry = man.latest_entry()
+    assert entry["checksum"] == "crc32c"
+    assert len(entry["files"]["checkpoint-0001.params"]["digest"]) == 8
+    man.restore()  # verifies under crc32c
+    # an operator typo degrades to sha256, never to no-integrity
+    monkeypatch.setenv("MXTPU_CKPT_CHECKSUM", "md5oops")
+    man.save(2, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    assert man.latest_entry()["checksum"] == "sha256"
+
+
+# ---------------------------------------------------------------------------
+# async saves (the zero-stall path)
+# ---------------------------------------------------------------------------
+
+def test_async_save_parity_and_wait(tmp_path):
+    """blocking=False returns after the snapshot; wait() drains; the
+    written checkpoint is byte-equivalent to a blocking save of the same
+    values."""
+    w = np.random.RandomState(0).randn(8, 4).astype("f")
+    mb = CheckpointManager(str(tmp_path / "block"))
+    ma = CheckpointManager(str(tmp_path / "async"))
+    mb.save(1, mlp_sym(), {"w": mx.nd.array(w)}, {},
+            optimizer_states=b"st")
+    ma.save(1, mlp_sym(), {"w": mx.nd.array(w)}, {},
+            optimizer_states=b"st", blocking=False)
+    res = ma.wait()
+    assert res["error"] is None and res["label"] == "epoch 1"
+    assert ma.last_result()["error"] is None
+    assert (tmp_path / "block" / "checkpoint-0001.params").read_bytes() \
+        == (tmp_path / "async" / "checkpoint-0001.params").read_bytes()
+    _, args, _, states, epoch = ma.restore()
+    assert epoch == 1 and states == b"st"
+    assert np.array_equal(args["w"].asnumpy(), w)
+
+
+def test_async_snapshot_isolated_from_mutation(tmp_path):
+    """The values handed to an async save are frozen at the call: the
+    caller mutating its (host) params afterwards — exactly what the
+    executor path's in-place epoch sync does — must not tear the write."""
+    from mxnet_tpu.resilience import faults as fi
+    w = mx.nd.array(np.zeros((4, 4), "f"))
+    man = CheckpointManager(str(tmp_path))
+    fi.arm_hang("ckpt_write", seconds=0.2)  # hold the writer mid-save
+    try:
+        man.save(1, None, {"w": w}, {}, blocking=False)
+        w[:] = 7.0  # the next epoch trains on
+        _ = w.asnumpy()
+        man.wait()
+    finally:
+        fi.disarm()
+    _, args, _, _, _ = man.restore()
+    assert np.array_equal(args["w"].asnumpy(), np.zeros((4, 4), "f"))
+
+
+def test_async_save_failure_surfaces_at_next_call(tmp_path, clean_faults):
+    """A failed background write re-raises at the next save/wait — one
+    epoch late, exactly where the blocking save would have raised — and
+    the previous checkpoint stays restorable."""
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    clean_faults.arm("ckpt_write")
+    man.save(2, None, {"w": mx.nd.array(np.full((2,), 2, "f"))}, {},
+             blocking=False)
+    with pytest.raises(MXNetError, match="background write"):
+        man.wait()
+    assert man.latest() == 1  # epoch 2 never published
+    assert man.last_result()["error"] is not None
+    # the writer recovers: the next save lands
+    man.save(3, None, {"w": mx.nd.array(np.full((2,), 3, "f"))}, {},
+             blocking=False)
+    man.wait()
+    assert man.latest() == 3
+
+
+@pytest.mark.parametrize("kvstore", ["local", "tpu"])
+def test_async_fit_resume_bit_identical(tmp_path, monkeypatch, kvstore):
+    """MXTPU_CKPT_ASYNC=1 routes fit's epoch-end saves through the
+    writer; a resumed run restores from an async+verified checkpoint and
+    finishes BIT-identical to the uninterrupted run — fused 'tpu' and
+    executor 'local' paths both."""
+    monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+    full = _fit_params(str(tmp_path / "full"), kvstore, epochs=4)
+    _fit_params(str(tmp_path / "cut"), kvstore, epochs=2)
+    man = CheckpointManager(str(tmp_path / "cut"))
+    assert man.latest() == 2  # fit drained the writer before returning
+    assert man.latest_entry()["files"]  # checksummed
+    resumed = _fit_params(str(tmp_path / "cut"), kvstore, epochs=4,
+                          resume=True)
+    for name in full:
+        assert np.array_equal(resumed[name], full[name]), name
+
+
+def test_module_save_checkpoint_async_prefix_path(tmp_path, monkeypatch):
+    """The manager-less prefix surface (Module.save_checkpoint /
+    callback.do_checkpoint with a plain prefix) honors MXTPU_CKPT_ASYNC
+    through the shared default writer."""
+    monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+    X, y = make_blobs(64, 10, 3)
+    mod, it = _fused_module(X, y)
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    want = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    prefix = str(tmp_path / "mod")
+    mod.save_checkpoint(prefix, 3, save_optimizer_states=True)
+    mx.resilience.wait_checkpoints()
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 3)
+    assert os.path.exists(prefix + "-0003.states")
+    for name in want:
+        assert np.array_equal(want[name], args[name].asnumpy()), name
+
+
+def test_module_async_save_submits_one_job(tmp_path, monkeypatch):
+    """params + optimizer states land via ONE writer job: a second
+    submit on the single-slot writer would block the caller for the
+    first job's entire serialize+write+fsync — exactly the stall the
+    async path exists to remove."""
+    monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+    calls = []
+    real = mx.resilience.submit_checkpoint
+
+    def counting(fn, label="checkpoint"):
+        calls.append(label)
+        return real(fn, label)
+
+    monkeypatch.setattr(mx.resilience, "submit_checkpoint", counting)
+    X, y = make_blobs(64, 10, 3)
+    mod, it = _fused_module(X, y)
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    prefix = str(tmp_path / "mod")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mx.resilience.wait_checkpoints()
+    assert len(calls) == 1, calls
+    assert os.path.exists(prefix + "-0001.params")
+    assert os.path.exists(prefix + "-0001.states")
+
+
+def test_blocking_save_drains_inflight_async_write(tmp_path):
+    """save(blocking=True) with an async write still in flight must
+    drain it first: both run _update_manifest (read-modify-write of
+    manifest.json), so racing them can silently drop one epoch's entry
+    — and racing prunes could delete files the other just recorded."""
+    import time as _time
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {},
+             blocking=False)
+    man.wait()
+    done = []
+
+    def slow():
+        _time.sleep(0.3)
+        done.append(1)
+
+    man._writer.submit(slow, "in-flight")
+    man.save(2, None, {"w": mx.nd.array(np.full((2,), 2, "f"))}, {},
+             blocking=True)
+    assert done, "blocking save did not wait for the in-flight write"
+    assert man.checkpoints() == [1, 2]
+
+
+def test_preempt_drain_is_bounded(tmp_path, monkeypatch):
+    """A WEDGED (not failed) background write must not eat the whole
+    preemption grace period: the drain times out after a bounded budget
+    and the blocking exit-85 save still lands."""
+    import time as _time
+    monkeypatch.setattr(CheckpointManager, "DRAIN_TIMEOUT", 0.4)
+    X, y = make_blobs(64, 10, 3)
+    mod, it = _fused_module(X, y)
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    mx.resilience.submit_checkpoint(lambda: _time.sleep(1.2), "wedged")
+    man = CheckpointManager(str(tmp_path))
+    t0 = _time.monotonic()
+    mod._save_preemption_checkpoint(man, 0, 4)
+    assert _time.monotonic() - t0 < 1.0, \
+        "preemption drain waited out the wedged write"
+    entry = man.latest_entry()
+    assert entry["epoch"] == 1 and entry["step_state"]["step"] == 4
+    mx.resilience.wait_checkpoints()  # clean up the sleeper
+
+
+def test_replicas_typo_degrades_not_crashes(tmp_path, monkeypatch):
+    """A non-numeric MXTPU_CKPT_REPLICAS disables replication with a
+    warning (like the checksum selector's fallback) instead of raising
+    inside every epoch-end save."""
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "one")
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {},
+             rank=0, world=3)
+    assert man.latest() == 1
+    assert "shards" not in man.latest_entry()
+
+
+def test_fit_drains_default_writer_for_prefix_callbacks(tmp_path,
+                                                        monkeypatch):
+    """fit() must drain the SHARED default writer too: prefix-based
+    epoch_end_callback saves (callback.do_checkpoint(prefix)) queue
+    there, not on a manager, and the writer thread is a daemon — an
+    undrained final save could be killed mid-write at interpreter
+    exit.  The writer is slowed so a missing drain fails, not races."""
+    import time as _time
+    monkeypatch.setenv("MXTPU_CKPT_ASYNC", "1")
+    real = mx.resilience.submit_checkpoint
+
+    def slow_submit(fn, label="checkpoint"):
+        def slow():
+            _time.sleep(0.3)
+            fn()
+        return real(slow, label)
+
+    monkeypatch.setattr(mx.resilience, "submit_checkpoint", slow_submit)
+    X, y = make_blobs(64, 10, 3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    prefix = str(tmp_path / "mod")
+    mod = mx.mod.Module(mlp_sym())
+    mx.random.seed(11)
+    mod.fit(it, num_epoch=2, kvstore="tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    # no explicit wait_checkpoints() here: fit itself must have drained
+    assert os.path.exists(prefix + "-0002.params")
+
+
+# ---------------------------------------------------------------------------
+# hardened retention
+# ---------------------------------------------------------------------------
+
+def test_prune_crash_cannot_resurrect_pruned_epoch(tmp_path, clean_faults):
+    """A crash between the (already pruned) manifest write and the file
+    deletion leaves tombstones: neither the manifest nor the
+    corrupt-manifest directory scan may resurrect the pruned epoch, and
+    the next save completes the interrupted prune."""
+    man = CheckpointManager(str(tmp_path), keep_last=2)
+    for epoch in (1, 2):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    clean_faults.arm("ckpt_prune")
+    with pytest.raises(TransientError):
+        man.save(3, None, {"w": mx.nd.array(np.full((2,), 3, "f"))}, {})
+    # the prune committed (manifest) but the files outlived the crash
+    assert (tmp_path / "checkpoint-0001.params").exists()
+    assert (tmp_path / "checkpoint-0001.pruning").exists()
+    assert man.checkpoints() == [2, 3]
+    # even with the manifest torn, the scan skips the tombstoned epoch
+    (tmp_path / "manifest.json").write_text("{torn")
+    assert CheckpointManager(str(tmp_path)).checkpoints() == [2, 3]
+    # the next save finishes the job: files and tombstone gone, fsync'd
+    man2 = CheckpointManager(str(tmp_path), keep_last=2)
+    man2.save(4, None, {"w": mx.nd.array(np.full((2,), 4, "f"))}, {})
+    assert not (tmp_path / "checkpoint-0001.params").exists()
+    assert not any(p.name.endswith(".pruning")
+                   for p in tmp_path.iterdir())
+    assert man2.checkpoints() == [3, 4]
+
+
+def test_prune_deletes_shard_files_too(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    man = CheckpointManager(str(tmp_path), keep_last=1)
+    args = {"w": mx.nd.array(np.ones((2,), "f"))}
+    for epoch in (1, 2):
+        for r in range(2):
+            man.save(epoch, None, args, {}, rank=r, world=2)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "checkpoint-0002.shard000" in names
+    assert not any(n.startswith("checkpoint-0001.shard") for n in names)
+    assert not (tmp_path / "checkpoint-0001.params").exists()
+
+
+# ---------------------------------------------------------------------------
+# ring-replicated shards (single-process simulation; the multi-process
+# drill lives in tests/dist/dist_ckpt_replica.py)
+# ---------------------------------------------------------------------------
+
+def _simulated_ring_save(tmp_path, world=3, epoch=1):
+    args = {"w%d" % i: mx.nd.array(np.full((4, 3), i + 1, "f"))
+            for i in range(5)}
+    man = CheckpointManager(str(tmp_path))
+    for r in range(world):
+        man.save(epoch, None, args, {}, optimizer_states=b"ABCDEFGHIJKL",
+                 rank=r, world=world)
+    return man, args
+
+
+def test_replication_writes_ring_shards(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    man, _ = _simulated_ring_save(tmp_path)
+    names = {p.name for p in tmp_path.iterdir()}
+    for p in range(3):
+        assert "checkpoint-0001.shard%03d" % p in names
+        assert "checkpoint-0001.shard%03d.rep1" % p in names
+    meta = man.latest_entry()["shards"]
+    assert meta["world"] == 3 and meta["replicas"] == 1
+    # rank 0 recorded every shard's digest without reading peer files
+    for part in meta["parts"]:
+        size, digest = mx.resilience.checksum_file(
+            str(tmp_path / part["file"]), "sha256")
+        assert (size, digest) == (part["size"], part["digest"])
+
+
+def test_shard_parts_need_subset_is_byte_identical(tmp_path):
+    """Non-zero ranks build only their own + neighbor partitions
+    (pickling all ``world`` parts there is O(world) redundant CPU per
+    save); the limited build must stay byte-identical to the full one —
+    rank 0's manifest digests vouch for bytes peers produce
+    independently."""
+    man = CheckpointManager(str(tmp_path))
+    args = {"w%d" % i: mx.nd.array(np.full((4, 3), i + 1, "f"))
+            for i in range(5)}
+    full = man._shard_parts(1, args, {}, b"ABCDEFGHIJKL", 3)
+    assert sorted(full) == [0, 1, 2]
+    subset = man._shard_parts(1, args, {}, b"ABCDEFGHIJKL", 3,
+                              need={1, 2})
+    assert sorted(subset) == [1, 2]
+    for p in subset:
+        assert subset[p] == full[p]
+
+
+def test_replication_recovers_from_peer_replica(tmp_path, monkeypatch):
+    """Primary params file corrupt AND one shard's primary corrupt (both
+    valid-format, flipped bytes): restore rebuilds the full state from
+    the intact shards + the peer-written replica, bit-identical."""
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    man, args = _simulated_ring_save(tmp_path)
+    _flip_payload_byte(str(tmp_path / "checkpoint-0001.params"), 3)
+    # shard 1 holds keys w1 (=2.0) and w4 (=5.0): rot its primary copy
+    _flip_payload_byte(str(tmp_path / "checkpoint-0001.shard001"), 2)
+    _, restored, _, states, epoch = man.restore()
+    assert epoch == 1 and states == b"ABCDEFGHIJKL"
+    for name in args:
+        assert np.array_equal(args[name].asnumpy(),
+                              restored[name].asnumpy()), name
+
+
+def test_replication_walks_back_when_all_copies_dead(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    man, args = _simulated_ring_save(tmp_path)
+    _simulated_ring_save(tmp_path, epoch=2)
+    for name in ("checkpoint-0002.params", "checkpoint-0002.shard001",
+                 "checkpoint-0002.shard001.rep1"):
+        _flip_payload_byte(str(tmp_path / name), 2)
+    _, restored, _, _, epoch = man.restore()
+    assert epoch == 1  # every copy of shard 1 dead: degrade one epoch
+    with pytest.raises(MXNetError, match="no intact copy"):
+        man.restore(2)
+
+
+def test_replication_recovers_with_checksums_off(tmp_path, monkeypatch):
+    """With MXTPU_CKPT_CHECKSUM=off there is no digest to flag a rotted
+    shard primary before deserializing — a truncated copy surfaces at
+    pickle.loads, which must fall through to the intact peer replica
+    instead of failing the epoch."""
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    monkeypatch.setenv("MXTPU_CKPT_CHECKSUM", "off")
+    man, args = _simulated_ring_save(tmp_path)
+    (tmp_path / "checkpoint-0001.params").write_bytes(b"torn")
+    shard = tmp_path / "checkpoint-0001.shard001"
+    shard.write_bytes(shard.read_bytes()[:len(shard.read_bytes()) // 2])
+    _, restored, _, states, epoch = man.restore()
+    assert epoch == 1 and states == b"ABCDEFGHIJKL"
+    for name in args:
+        assert np.array_equal(args[name].asnumpy(),
+                              restored[name].asnumpy()), name
+
+
+def test_shard_writer_ranks_prune_their_own_files(tmp_path, monkeypatch):
+    """keep_last retention on a rank that writes only shard files: on
+    per-host disks rank 0's manifest-driven pruning never reaches this
+    host's directory, so the shard writer prunes its own view."""
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    man = CheckpointManager(str(tmp_path), keep_last=2)
+    args = {"w": mx.nd.array(np.ones((2, 2), "f"))}
+    for epoch in (1, 2, 3):
+        man.save(epoch, None, args, {}, rank=1, world=3)
+    names = {p.name for p in tmp_path.iterdir()}
+    assert "checkpoint-0002.shard001" in names
+    assert "checkpoint-0003.shard001" in names
+    assert not any(n.startswith("checkpoint-0001.shard")
+                   for n in names), names
+
+
+# ---------------------------------------------------------------------------
+# tools/ckpt_fsck.py (offline audit)
+# ---------------------------------------------------------------------------
+
+FSCK = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "ckpt_fsck.py")
+
+
+def _run_fsck(directory, *args):
+    import subprocess
+    import sys
+    return subprocess.run([sys.executable, FSCK, str(directory), *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_fsck_clean_directory_exits_zero(tmp_path):
+    import json as _json
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2):
+        man.save(epoch, mlp_sym(),
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {},
+                 optimizer_states=b"s")
+    res = _run_fsck(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = _json.loads(res.stdout)
+    assert report["ok"] and len(report["checkpoints"]) == 2
+    assert all(e["ok"] for e in report["checkpoints"])
+
+
+def test_fsck_flags_corruption_and_exits_one(tmp_path):
+    import json as _json
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    _flip_payload_byte(str(tmp_path / "checkpoint-0002.params"), 2)
+    out = tmp_path / "report.json"
+    res = _run_fsck(tmp_path, "--json", str(out), "-q")
+    assert res.returncode == 1
+    assert "mismatch" in res.stderr
+    report = _json.loads(out.read_text())
+    assert not report["ok"]
+    by_epoch = {e["epoch"]: e for e in report["checkpoints"]}
+    assert by_epoch[1]["ok"] and not by_epoch[2]["ok"]
+    assert "checkpoint-0002.params" in by_epoch[2]["problems"][0]
+
+
+def test_fsck_degraded_replica_reports_but_exits_zero(tmp_path,
+                                                      monkeypatch):
+    """A lost replica behind an intact primary is fully restorable:
+    the audit surfaces it under ``degraded`` without failing."""
+    import json as _json
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    _simulated_ring_save(tmp_path)
+    os.remove(str(tmp_path / "checkpoint-0001.shard001.rep1"))
+    res = _run_fsck(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    entry = _json.loads(res.stdout)["checkpoints"][0]
+    assert entry["ok"] and entry["degraded"], entry
+
+
+def test_fsck_dead_shard_primary_exits_one(tmp_path, monkeypatch):
+    """A dead shard primary leaning on its last replica is one fault
+    from data loss — the audit must fail it."""
+    import json as _json
+    monkeypatch.setenv("MXTPU_CKPT_REPLICAS", "1")
+    _simulated_ring_save(tmp_path)
+    _flip_payload_byte(str(tmp_path / "checkpoint-0001.shard001"), 2)
+    res = _run_fsck(tmp_path)
+    assert res.returncode == 1
+    entry = _json.loads(res.stdout)["checkpoints"][0]
+    assert not entry["ok"]
+    assert any("primary dead" in p for p in entry["problems"]), entry
+
+
+def test_fsck_checksums_lockstep_with_resilience(tmp_path):
+    """ckpt_fsck duplicates the checksum code (it must stay import-light
+    — no jax); the two implementations must agree byte-for-byte."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("ckpt_fsck_t", FSCK)
+    fsck = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fsck)
+    sample = tmp_path / "sample.bin"
+    sample.write_bytes(bytes(range(256)) * 41)
+    for algo in ("sha256", "crc32", "crc32c"):
+        assert fsck.checksum_file(str(sample), algo) == \
+            mx.resilience.checksum_file(str(sample), algo), algo
+
+
+# ---------------------------------------------------------------------------
 # bench.py timeout handling (satellite)
 # ---------------------------------------------------------------------------
 
