@@ -807,6 +807,288 @@ def _lstm_bench(batch, seq_len, steps, warmup, trials):
                                             s1 + steps, trials)
 
 
+def _save_serving_models(tmp):
+    """Write the two bench serving checkpoints: the standard MLP
+    (models/mlp.py shape) and a resnet-shaped small-image net (cifar
+    branch of models/resnet.py) -> {name: (prefix, epoch, sample_shape)}."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.model import save_checkpoint
+
+    rs = np.random.RandomState(7)
+    out = {}
+    for name, sym, sample in (
+            ("mlp", models.get_symbol("mlp", num_classes=10), (784,)),
+            ("resnet", models.get_symbol("resnet", num_classes=10,
+                                         num_layers=20,
+                                         image_shape=(3, 32, 32)),
+             (3, 32, 32))):
+        shapes = {"data": (1,) + sample}
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+        args = {n: mx.nd.array(rs.uniform(-0.1, 0.1, s).astype("f"))
+                for n, s in zip(sym.list_arguments(), arg_shapes)
+                if n not in ("data", "softmax_label")}
+        auxs = {}
+        for n, s in zip(sym.list_auxiliary_states(), aux_shapes):
+            # BN moving stats: mean 0, var 1 — a forward through random
+            # weights stays finite
+            auxs[n] = mx.nd.array(
+                (np.ones(s) if n.endswith("var")
+                 else np.zeros(s)).astype("f"))
+        prefix = os.path.join(tmp, name)
+        save_checkpoint(prefix, 1, sym, args, auxs, blocking=True)
+        out[name] = (prefix, 1, sample)
+    return out
+
+
+def _serve_load(port, model, sample, concurrency, seconds, warmup_s=0.5):
+    """Closed-loop load: ``concurrency`` threads, each its own keep-alive
+    client, firing back-to-back requests for ``seconds`` after a warmup
+    window.  Returns (qps, p50_ms, p99_ms, shed, errors)."""
+    import threading
+
+    from mxnet_tpu.serving import ServeClient
+
+    rs = np.random.RandomState(0)
+    stop = threading.Event()
+    lats, shed, errors = [], [0], [0]
+    lock = threading.Lock()
+
+    def worker(i):
+        cli = ServeClient("127.0.0.1", port)
+        x = rs.rand(*sample).astype("f") + i  # distinct payloads
+        mine = []
+        try:
+            while not stop.is_set():
+                tic = time.perf_counter()
+                try:
+                    status, _ = cli.predict(model, x)
+                except Exception:  # noqa: BLE001 — connection-level loss
+                    status = -1
+                dt = (time.perf_counter() - tic) * 1e3
+                if status == 200:
+                    mine.append((tic, dt))
+                elif status == 429:
+                    with lock:
+                        shed[0] += 1
+                else:
+                    with lock:
+                        errors[0] += 1
+        finally:
+            cli.close()
+        with lock:
+            lats.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s + seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    cut = t0 + warmup_s
+    window = sorted(d for (tic, d) in lats if tic >= cut)
+    if not window:
+        return 0.0, None, None, shed[0], errors[0]
+    # the ONE nearest-rank percentile rule — same math /stats reports
+    from mxnet_tpu.serving.frontend import _percentile
+    return (round(len(window) / seconds, 2),
+            round(_percentile(window, 50), 3),
+            round(_percentile(window, 99), 3), shed[0], errors[0])
+
+
+def _serve_open_loop(port, model, sample, rate_qps, seconds, workers=32):
+    """Open-loop load: a paced worker pool fires at a fixed AGGREGATE
+    arrival rate on a schedule independent of completions (a worker
+    that falls behind its slots fires immediately — the standard
+    bounded-worker approximation of open-loop arrivals, without the
+    thread-per-request storm that would just fill the kernel's accept
+    backlog instead of the daemon's bounded queue).  Returns (ok, shed,
+    errors, p99_ms_of_successes)."""
+    import threading
+
+    from mxnet_tpu.serving import ServeClient
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(*sample).astype("f")
+    results = []
+    lock = threading.Lock()
+    interval = workers / float(rate_qps)
+    t0 = time.perf_counter() + 0.05
+    end = t0 + seconds
+
+    def worker(i):
+        cli = ServeClient("127.0.0.1", port, timeout=30)
+        nxt = t0 + i * (1.0 / rate_qps)
+        try:
+            while nxt < end:
+                pause = nxt - time.perf_counter()
+                if pause > 0:
+                    time.sleep(pause)
+                tic = time.perf_counter()
+                try:
+                    status, _ = cli.predict(model, x)
+                except Exception:  # noqa: BLE001 — refused/dropped conn
+                    status = -1
+                with lock:
+                    results.append(
+                        (status, (time.perf_counter() - tic) * 1e3))
+                nxt += interval
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    ok = sum(1 for s, _ in results if s == 200)
+    shed = sum(1 for s, _ in results if s in (429, 503))
+    errors = len(results) - ok - shed
+    from mxnet_tpu.serving.frontend import _percentile
+    p99 = _percentile(sorted(d for s, d in results if s == 200), 99)
+    return ok, shed, errors, round(p99, 3) if p99 is not None else None
+
+
+def _serve_bench(seconds=2.5):
+    """The ``bench.py serve`` mode: spin up the real daemon
+    (tools/serve.py) on the CPU backend, drive closed-loop load at
+    1/8/32 concurrency for the standard MLP and a resnet-shaped model,
+    verify serving output is bit-identical to the unbatched Predictor
+    forward, then overdrive it open-loop and record the shed rate.
+
+    Headline: ``serve_batch_speedup`` = QPS at concurrency 32 / QPS at
+    concurrency 1 for the MLP — continuous batching must buy >= 2x on
+    the CPU tier (acceptance criterion)."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+
+    from mxnet_tpu.serving import ServeClient
+
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    out = {}
+    proc = None
+    try:
+        specs = _save_serving_models(tmp)
+        here = os.path.dirname(os.path.abspath(__file__))
+        port_file = os.path.join(tmp, "port")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        cmd = [sys.executable, os.path.join(here, "tools", "serve.py"),
+               "--port", "0", "--port-file", port_file,
+               "--buckets", "1,2,4,8,16,32", "--max-wait-ms", "2",
+               "--max-queue", "64", "--warmup"]
+        for name, (prefix, epoch, sample) in specs.items():
+            cmd += ["--model", "%s=%s:%d" % (name, prefix, epoch),
+                    "--input-shape",
+                    "%s:data=%s" % (name, ",".join(map(str, sample)))]
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.PIPE, text=True)
+        deadline = time.monotonic() + 300
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError("serve daemon died: %s"
+                                   % proc.stderr.read()[-2000:])
+            if time.monotonic() > deadline:
+                raise RuntimeError("serve daemon never wrote its port")
+            time.sleep(0.1)
+        port = int(open(port_file).read().split(":")[1])
+        ServeClient("127.0.0.1", port).wait_ready(60)
+
+        # bit-parity: one quiet request == the unbatched (bucket-1)
+        # Predictor forward, bitwise
+        out["serve_parity"] = _serve_parity(port, specs)
+
+        for name, (_, _, sample) in specs.items():
+            for conc in (1, 8, 32):
+                qps, p50, p99, shed, errs = _serve_load(
+                    port, name, sample, conc, seconds)
+                key = "serve_%s_c%d" % (name, conc)
+                out[key + "_qps"] = qps
+                out[key + "_p50_ms"] = p50
+                out[key + "_p99_ms"] = p99
+                if shed:
+                    out[key + "_shed"] = shed
+                if errs:
+                    out[key + "_errors"] = errs
+        if out.get("serve_mlp_c1_qps"):
+            out["serve_batch_speedup"] = round(
+                out["serve_mlp_c32_qps"] / out["serve_mlp_c1_qps"], 2)
+
+        # open-loop: paced arrivals at a fixed rate just under the MLP's
+        # measured capacity — the sustained-QPS-within-SLO row
+        rate = min(400.0, max(50.0,
+                              0.8 * (out.get("serve_mlp_c8_qps") or 50.0)))
+        ok, shed, errors, p99 = _serve_open_loop(
+            port, "mlp", specs["mlp"][2], rate, 1.5)
+        out["serve_openloop_rate_qps"] = round(rate, 1)
+        out["serve_openloop_ok"] = ok
+        out["serve_openloop_shed"] = shed
+        out["serve_openloop_errors"] = errors
+        if p99 is not None:
+            out["serve_openloop_p99_ms"] = p99
+
+        # overload: closed-loop concurrency far past the queue bound —
+        # admission control must shed (429) the excess rather than
+        # queue it without bound, while the admitted work completes
+        _, _, p99o, shed_o, errs_o = _serve_load(
+            port, "resnet", specs["resnet"][2], 96, seconds)
+        out["serve_overload_shed"] = shed_o
+        out["serve_overload_errors"] = errs_o
+        if p99o is not None:
+            out["serve_overload_p99_ms"] = p99o
+        status, stats = ServeClient("127.0.0.1", port).stats()
+        if status == 200:
+            out["serve_batch_fill"] = stats["batches"].get("fill_ratio")
+            out["serve_sheds_counted"] = (
+                stats["counters"]["shed_queue"]
+                + stats["counters"]["shed_slo"])
+
+        proc.send_signal(_signal.SIGTERM)
+        out["serve_drain_rc"] = proc.wait(timeout=60)
+        proc = None
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _serve_parity(port, specs):
+    """True iff a request served through the daemon (bucket 1, quiet
+    daemon) is BIT-identical to the local unbatched Predictor forward
+    for every model."""
+    from mxnet_tpu import predict
+    from mxnet_tpu.model import load_checkpoint
+    from mxnet_tpu.serving import ServeClient
+
+    rs = np.random.RandomState(3)
+    cli = ServeClient("127.0.0.1", port)
+    try:
+        for name, (prefix, epoch, sample) in specs.items():
+            x = rs.rand(*sample).astype("f")
+            status, payload = cli.predict(name, x)
+            if status != 200:
+                return False
+            got = np.asarray(payload["outputs"][0], dtype=np.float32)
+            sym, args, auxs = load_checkpoint(prefix, epoch)
+            pred = predict.Predictor(
+                sym, {**{"arg:%s" % k: v for k, v in args.items()},
+                      **{"aux:%s" % k: v for k, v in auxs.items()}},
+                {"data": (1,) + tuple(sample)})
+            ref = pred.forward(data=x[None]).get_output(0)[0]
+            if not np.array_equal(got, ref):
+                return False
+    finally:
+        cli.close()
+    return True
+
+
 def _train_flops(sym_name):
     """Analytic training FLOPs per image (3x forward; contrib/flops.py)."""
     from mxnet_tpu import models
@@ -861,8 +1143,16 @@ def _run_mode(mode):
     trials = _env_int("BENCH_TRIALS", 2)
     sweep_steps = _env_int("BENCH_SWEEP_STEPS", 25)
     out = {}
+    if mode == "_hang-grandchild":
+        # harness self-test fixture (tests/test_bench_harness.py): hang
+        # with a grandchild holding the inherited stdout pipe — the
+        # BENCH_r05 failure shape.  Never in a real artifact.
+        import subprocess as _sp
+        _sp.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+        time.sleep(600)
+        return
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume", "checkpoint", "analyze"):
+                "resume", "checkpoint", "analyze", "serve"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -877,6 +1167,8 @@ def _run_mode(mode):
         jax.config.update("jax_platforms", "cpu")
     if mode == "analyze":
         out.update(_analyze_bench())
+    elif mode == "serve":
+        out.update(_serve_bench())
     elif mode == "decode":
         out.update(_decode_bench())
     elif mode == "fed-cpu":
@@ -928,11 +1220,17 @@ def _run_mode(mode):
         # per-token training flops at the bench seq_len
         out["lstm_roofline"] = _roofline(
             out["lstm"], 3 * model_flops(sym, data=(1, 32)) / 32.0)
+    else:
+        # an unknown mode must fail loudly (-> a "failed" status record
+        # in the artifact), not ship an empty part that looks like a
+        # metric quietly measuring nothing
+        sys.stderr.write("unknown BENCH_MODE %r\n" % mode)
+        sys.exit(2)
     print("BENCH_PART " + json.dumps(out))
 
 
 def _collect(mode, timeout=480, extra_env=None):
-    """Run one metric in a FRESH subprocess.
+    """Run one metric in a FRESH subprocess, with HARD timeout isolation.
 
     Each metric gets its own process because the tunneled device runtime
     degrades measurably when several large compiled programs share one
@@ -942,30 +1240,186 @@ def _collect(mode, timeout=480, extra_env=None):
     metric the steady-state it would have in a real training job.
     ``extra_env`` overlays the child environment (the compile-cache
     probes point both runs at one cache directory this way).
+
+    Isolation (the BENCH_r05 regression, ROADMAP item 5): a metric that
+    hits its budget must cost THAT metric, never the run.  The child is
+    its own session/process group and an overrun SIGKILLs the whole
+    group — ``subprocess.run``'s own timeout path kills only the direct
+    child and then blocks in ``communicate()`` for as long as any
+    grandchild (XLA compile workers, decode pools) holds the inherited
+    stdout pipe open, which is how one 480s model kill turned into rc=1
+    for the whole r05 run.  The final pipe scavenge is bounded too, so
+    even an unkillable (D-state) descendant cannot wedge the harness.
     """
+    import signal as _signal
     import subprocess
     env = dict(os.environ)
     env["BENCH_MODE"] = mode
     env.update(extra_env or {})
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env, start_new_session=True)
     try:
-        res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                             capture_output=True, text=True, timeout=timeout,
-                             env=env)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        # a hung metric must not kill the whole run: record it and let the
-        # remaining metrics produce a partial artifact (rc stays 0)
-        sys.stderr.write("bench mode %s timed out after %ds\n"
-                         % (mode, timeout))
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=15)
+        except (subprocess.TimeoutExpired, ValueError, OSError):
+            pass
+        sys.stderr.write("bench mode %s timed out after %ds — partial "
+                         "artifact continues\n" % (mode, timeout))
         return {mode: {"status": "timeout", "timeout_s": timeout}}
-    for line in res.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("BENCH_PART "):
             return json.loads(line[len("BENCH_PART "):])
-    sys.stderr.write("bench mode %s failed:\n%s\n"
-                     % (mode, (res.stderr or res.stdout)[-800:]))
-    return {}
+    sys.stderr.write("bench mode %s failed (rc=%s):\n%s\n"
+                     % (mode, proc.returncode, (stderr or stdout)[-800:]))
+    return {mode: {"status": "failed", "rc": proc.returncode}}
+
+
+# ---------------------------------------------------------------------------
+# regression gate (ROADMAP item 5): compare a fresh artifact against the
+# most recent BENCH_*.json and fail on >10% drops in the named keys
+# ---------------------------------------------------------------------------
+
+#: higher-is-better keys the gate guards.  Entries ending in ``*`` are
+#: prefixes (every matching key is compared).
+GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
+             "inception_bn_img_s", "resnet152_img_s", "lstm_tok_s",
+             "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
+             "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup")
+
+
+def _gate_payload(path):
+    """An artifact file -> the result dict.  Accepts both the raw
+    ``bench.py`` stdout object and the driver's ``{n, cmd, rc, parsed,
+    tail}`` wrapper; returns None when the file holds no usable run
+    (e.g. the r05 rc=1 wrapper with ``parsed: null``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "parsed" in doc and "cmd" in doc:
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or not doc:
+        return None
+    return doc
+
+
+def _latest_artifact(directory, exclude=None):
+    """Newest usable ``BENCH_*.json`` by round number (``BENCH_r05`` >
+    ``BENCH_r04``), skipping files with no payload AND ``exclude``."""
+    import re
+    best = None
+    exclude = os.path.abspath(exclude) if exclude else None
+    for name in os.listdir(directory):
+        m = re.match(r"BENCH_r?(\d+)\.json$", name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        if exclude and os.path.abspath(path) == exclude:
+            continue
+        try:
+            payload = _gate_payload(path)
+        except (OSError, ValueError):
+            continue
+        if payload is None:
+            continue
+        if best is None or int(m.group(1)) > best[0]:
+            best = (int(m.group(1)), path, payload)
+    return best
+
+
+def _match_gate_keys(payload):
+    keys = set()
+    for pat in GATE_KEYS:
+        if "*" in pat:
+            head, _, tail = pat.partition("*")
+            keys.update(k for k in payload
+                        if k.startswith(head) and k.endswith(tail)
+                        and isinstance(payload[k], (int, float)))
+        elif isinstance(payload.get(pat), (int, float)):
+            keys.add(pat)
+    return keys
+
+
+def gate(new_path, against=None, tolerance=0.10):
+    """Compare ``new_path`` against a baseline artifact; returns the
+    report dict (``pass`` False on any guarded key dropping more than
+    ``tolerance``, going missing, or timing out)."""
+    try:
+        new = _gate_payload(new_path)
+    except (OSError, ValueError) as e:
+        return {"pass": False, "error": "cannot read artifact %s: %s"
+                % (new_path, e)}
+    if new is None:
+        return {"pass": False, "error": "artifact %s holds no parsed "
+                "result" % new_path}
+    if against:
+        try:
+            base_path, base = against, _gate_payload(against)
+        except (OSError, ValueError) as e:
+            return {"pass": False, "error": "cannot read baseline %s: %s"
+                    % (against, e)}
+    else:
+        found = _latest_artifact(
+            os.path.dirname(os.path.abspath(__file__)), exclude=new_path)
+        if found is None:
+            return {"pass": True, "baseline": None,
+                    "note": "no prior BENCH_*.json — nothing to gate "
+                            "against"}
+        _, base_path, base = found
+    if base is None:
+        return {"pass": False, "error": "baseline %s holds no parsed "
+                "result" % base_path}
+    regressions, checked = [], []
+    for key in sorted(_match_gate_keys(base)):
+        old_v = base[key]
+        new_v = new.get(key)
+        if not isinstance(new_v, (int, float)):
+            # a guarded metric that vanished IS a regression — that is
+            # precisely how a timed-out model (r05's inception-bn)
+            # surfaces in a partial artifact
+            regressions.append({"key": key, "baseline": old_v,
+                                "status": "missing"})
+            continue
+        checked.append(key)
+        if old_v > 0 and new_v < old_v * (1.0 - tolerance):
+            regressions.append(
+                {"key": key, "baseline": old_v, "value": new_v,
+                 "drop": round(1.0 - new_v / old_v, 3)})
+    report = {"pass": not regressions, "baseline": base_path,
+              "tolerance": tolerance, "checked": checked,
+              "regressions": regressions}
+    if new.get("incomplete"):
+        report["incomplete_modes"] = sorted(new["incomplete"])
+    return report
+
+
+def _gate_main(argv):
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="bench.py --gate",
+        description="fail (rc 1) on >tolerance drops vs the most recent "
+                    "BENCH_*.json")
+    parser.add_argument("--gate", required=True, metavar="NEW.json",
+                        help="the fresh artifact to check")
+    parser.add_argument("--against", default=None, metavar="OLD.json",
+                        help="explicit baseline (default: newest usable "
+                             "BENCH_*.json next to bench.py)")
+    parser.add_argument("--gate-tolerance", type=float, default=0.10)
+    args = parser.parse_args(argv)
+    report = gate(args.gate, against=args.against,
+                  tolerance=args.gate_tolerance)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
 
 
 def main():
+    if any(a.startswith("--gate") for a in sys.argv[1:]):
+        sys.exit(_gate_main(sys.argv[1:]))
     mode = os.environ.get("BENCH_MODE")
     if mode:
         _run_mode(mode)
@@ -996,6 +1450,7 @@ def main():
             parts["compile_warm_s"] = warm["compile_bringup_s"]
         parts.update(_collect("resume"))
         parts.update(_collect("checkpoint"))
+        parts.update(_collect("serve"))
         parts.update(_collect("fed"))
     parts.update(_collect("analyze", timeout=240))
     parts.update(_collect("compute"))
@@ -1056,6 +1511,9 @@ def main():
               "analyze_mlp_collectives", "analyze_zero_collectives",
               "analyze_findings"):
         if k in parts:
+            result[k] = parts[k]
+    for k in sorted(parts):
+        if k.startswith("serve_"):
             result[k] = parts[k]
     if compute is not None:
         if fed is None:

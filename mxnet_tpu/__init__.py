@@ -115,5 +115,6 @@ from .model import FeedForward
 from . import module
 from . import module as mod
 from . import predict
+from . import serving
 from . import test_utils
 from . import analysis

@@ -1,0 +1,27 @@
+"""mxserve: production inference serving on the predict/resilience stack
+(docs/how_to/serving.md).
+
+The reference stopped at a predict-only ABI (``c_predict_api.h`` ->
+``mxnet_tpu/predict.py``); this package turns a trained checkpoint into
+a traffic-serving daemon:
+
+- :mod:`.batcher` — continuous request batching into padded power-of-two
+  **bucket** shapes, one cached compiled forward per bucket.
+- :mod:`.pool` — warm multi-model pool, device-resident weights,
+  optional bf16 weight-cast, checkpoint-directory loading.
+- :mod:`.frontend` — HTTP admission control: bounded queues, SLO-aware
+  load shedding (429), ``/healthz`` + ``/stats``, graceful SIGTERM
+  drain, StepWatchdog coverage of wedged forwards (exit 87 ->
+  ``tools/supervise.py`` relaunch).
+
+``tools/serve.py`` is the CLI daemon; ``bench.py``'s ``serve`` mode is
+the load generator.
+"""
+from .batcher import (BucketBatcher, Draining, QueueFull, parse_buckets,
+                      pick_bucket, pad_to_bucket)
+from .pool import ModelPool, PooledModel
+from .frontend import ServeClient, ServingFrontend, Stats
+
+__all__ = ["BucketBatcher", "Draining", "QueueFull", "parse_buckets",
+           "pick_bucket", "pad_to_bucket", "ModelPool", "PooledModel",
+           "ServeClient", "ServingFrontend", "Stats"]

@@ -1,0 +1,321 @@
+"""Request batcher: single requests in, padded bucket-shaped batches out.
+
+The serving analog of Orca's iteration-level batching / Clipper's
+adaptive batching, shaped for XLA: every dispatched batch has one of a
+fixed set of power-of-two **bucket** sizes, so each bucket hits exactly
+ONE cached AOT-compiled forward (``predict.Predictor``'s per-shape jit
+cache, persisted across relaunches by ``MXTPU_COMPILE_CACHE``) instead
+of recompiling per arrival count.
+
+Dispatch policy (continuous batching): the dispatcher takes everything
+queued the moment the previous forward finishes — under load the
+in-flight batch IS the wait window, so throughput needs no added
+latency.  Only when the queue is smaller than the largest bucket does a
+max-wait timer (``MXTPU_SERVE_MAX_WAIT_MS``, measured from the OLDEST
+queued request) hold the batch open for stragglers.
+
+BIT-EXACTNESS CONTRACT: a request's result depends only on its own
+bytes and the bucket shape it ran at — never on batch fill, its row
+position, or co-batched requests.  (XLA re-tiles reductions per batch
+shape, so results ARE shape-dependent — measured ~1e-13..1e-7 per-row
+deltas between batch-1 and batch-8 MLP forwards on CPU — which is
+exactly why buckets exist: one canonical program per bucket.  Within a
+fixed shape, rows of row-independent inference graphs are bit-stable;
+``tests/test_serving.py`` proves both halves.)  Padding replicates the
+last real row rather than injecting zeros, so padding can never create
+NaN/Inf paths the real rows didn't have.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..base import MXNetError, get_env, register_env
+from ..resilience import faults
+
+__all__ = ["BucketBatcher", "QueueFull", "Draining", "parse_buckets",
+           "pick_bucket", "pad_to_bucket", "ENV_SERVE_BUCKETS",
+           "ENV_SERVE_MAX_WAIT_MS"]
+
+ENV_SERVE_BUCKETS = register_env(
+    "MXTPU_SERVE_BUCKETS", default="1,2,4,8,16,32",
+    doc="Comma-separated ascending batch-size buckets for the serving "
+        "batcher; each bucket is one cached compiled forward")
+ENV_SERVE_MAX_WAIT_MS = register_env(
+    "MXTPU_SERVE_MAX_WAIT_MS", default=2.0,
+    doc="How long a dispatching batch may hold the queue open for "
+        "stragglers, measured from the oldest queued request (ms)")
+
+#: fault points on the batch forward: ``serve_forward`` (arm = failing
+#: model, arm_hang = a timed stall) and ``hang_serve_forward`` (a
+#: maybe_hang site, so ``MXTPU_FAULTS=hang_serve_forward:1`` wedges the
+#: dispatch for the default 3600s from the ENV alone — the watchdog
+#: drill's wedged-forward window, same plumbing as ``hang_step``)
+SERVE_FORWARD_FAULT = "serve_forward"
+SERVE_FORWARD_HANG = "hang_serve_forward"
+
+
+class QueueFull(MXNetError):
+    """Admission refused: the request queue is at its bound."""
+
+
+class Draining(MXNetError):
+    """Admission refused: the daemon is draining for shutdown."""
+
+
+def parse_buckets(spec=None):
+    """``"1,2,4,8"`` (or an int list) -> validated ascending tuple."""
+    if spec is None:
+        spec = get_env(ENV_SERVE_BUCKETS)
+    if isinstance(spec, str):
+        try:
+            buckets = tuple(int(p) for p in spec.replace(" ", "").split(",")
+                            if p)
+        except ValueError:
+            raise MXNetError("bad bucket spec %r (want e.g. '1,2,4,8')"
+                             % (spec,))
+    else:
+        buckets = tuple(int(b) for b in spec)
+    if not buckets or any(b <= 0 for b in buckets) or \
+            list(buckets) != sorted(set(buckets)):
+        raise MXNetError("buckets must be positive, ascending, unique: %r"
+                         % (buckets,))
+    return buckets
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket >= ``n`` — NEVER a truncating one.  ``n`` above
+    the largest bucket is a caller error (the batcher caps batches at
+    the largest bucket before picking)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise MXNetError("request count %d exceeds the largest bucket %d"
+                     % (n, buckets[-1]))
+
+
+def pad_to_bucket(rows, bucket):
+    """Stack per-sample rows and edge-pad (repeat the last real row) to
+    ``bucket``.  Returns the (bucket, \\*sample) array."""
+    stacked = np.stack(rows)
+    n = stacked.shape[0]
+    if n == bucket:
+        return stacked
+    pad = np.repeat(stacked[-1:], bucket - n, axis=0)
+    return np.concatenate([stacked, pad], axis=0)
+
+
+class _Future(object):
+    """Single-consumer result slot for one queued request."""
+
+    __slots__ = ("_event", "_result", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request did not complete within %ss"
+                               % timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request(object):
+    __slots__ = ("inputs", "future", "enqueued_at")
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.future = _Future()
+        self.enqueued_at = time.monotonic()
+
+
+class BucketBatcher(object):
+    """One model's queue + dispatcher thread.
+
+    ``runner(inputs, n_valid)`` receives ``{input_name: (bucket, *sample)
+    float32 array}`` and returns a list of per-output ``(bucket, ...)``
+    arrays; the batcher splits rows back out to the waiting futures.
+    All forwards for the model happen on this one dispatcher thread, so
+    the underlying ``Predictor`` needs no locking.
+    """
+
+    def __init__(self, runner, buckets=None, max_wait_ms=None,
+                 max_queue=None, name="model", watchdog=None, stats=None):
+        self.runner = runner
+        self.name = name
+        self.buckets = parse_buckets(buckets)
+        if max_wait_ms is None:
+            max_wait_ms = float(get_env(ENV_SERVE_MAX_WAIT_MS))
+        self.max_wait = max(0.0, float(max_wait_ms)) / 1000.0
+        self.max_queue = max_queue          # None = unbounded (frontend
+        self.watchdog = watchdog            # owns admission control)
+        self.stats = stats
+        self._cv = threading.Condition()
+        self._queue = deque()
+        self._inflight = 0
+        self._draining = False
+        self._closing = False
+        self._ema_batch_s = None            # EMA of batch service time
+        self._sample_shapes = None          # fixed by the first request
+        self._thread = threading.Thread(
+            target=self._loop, name="mxserve-batch-%s" % name, daemon=True)
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+    @property
+    def depth(self):
+        """Queued + in-flight request count (the admission gauge)."""
+        with self._cv:
+            return len(self._queue) + self._inflight
+
+    def estimate_wait_ms(self):
+        """Rough time a NEW request would spend queued: the work ahead
+        of it (queued + in-flight rows, in units of largest-bucket
+        batches) x the EMA batch service time.  0 for an empty queue or
+        until the first batch has been timed (admit optimistically)."""
+        with self._cv:
+            depth = len(self._queue) + self._inflight
+            ema = self._ema_batch_s
+        if not ema or not depth:
+            return 0.0
+        return depth / float(self.buckets[-1]) * ema * 1000.0
+
+    def submit(self, inputs):
+        """Queue one request (``{input_name: per-sample float32 array}``,
+        NO batch dimension) -> future.  Raises :class:`Draining` during
+        shutdown and :class:`QueueFull` at the queue bound."""
+        shapes = {k: tuple(np.shape(v)) for k, v in inputs.items()}
+        with self._cv:
+            if self._draining:
+                raise Draining("model %r is draining" % self.name)
+            if self.max_queue is not None and \
+                    len(self._queue) >= self.max_queue:
+                raise QueueFull("model %r queue is at its bound (%d)"
+                                % (self.name, self.max_queue))
+            if self._sample_shapes is None:
+                self._sample_shapes = shapes
+            elif shapes != self._sample_shapes:
+                raise MXNetError(
+                    "request shapes %s do not match the model's %s"
+                    % (shapes, self._sample_shapes))
+            req = _Request(inputs)
+            self._queue.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    # -- dispatcher --------------------------------------------------------
+    def _loop(self):
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _next_batch(self):
+        """Block for the first request, then hold the batch open until
+        the largest bucket fills or the oldest request ages past
+        max_wait (draining skips the wait — flush what is queued)."""
+        cap = self.buckets[-1]
+        with self._cv:
+            while not self._queue:
+                if self._closing:
+                    return None
+                self._cv.wait(0.1)
+            oldest = self._queue[0].enqueued_at
+            while len(self._queue) < cap and not self._draining:
+                left = self.max_wait - (time.monotonic() - oldest)
+                if left <= 0:
+                    break
+                self._cv.wait(min(left, 0.02))
+            batch = [self._queue.popleft()
+                     for _ in range(min(len(self._queue), cap))]
+            self._inflight = len(batch)
+        return batch
+
+    def _run_batch(self, batch):
+        n = len(batch)
+        try:
+            bucket = pick_bucket(n, self.buckets)
+            inputs = {k: pad_to_bucket([r.inputs[k] for r in batch], bucket)
+                      for k in batch[0].inputs}
+            label = "serve %s batch n=%d bucket=%d" % (self.name, n, bucket)
+            tic = time.monotonic()
+            if self.watchdog is not None:
+                with self.watchdog.armed(label):
+                    faults.maybe_trip(SERVE_FORWARD_FAULT)
+                    faults.maybe_hang(SERVE_FORWARD_HANG)
+                    outs = self.runner(inputs, n)
+            else:
+                faults.maybe_trip(SERVE_FORWARD_FAULT)
+                faults.maybe_hang(SERVE_FORWARD_HANG)
+                outs = self.runner(inputs, n)
+            dt = time.monotonic() - tic
+        except Exception as e:  # noqa: BLE001 — every waiter must wake
+            for r in batch:
+                r.future.set_error(e)
+            with self._cv:
+                if not self._queue:
+                    # the pinned shapes may be the very thing that made
+                    # this batch fail (a malformed first request) — let
+                    # the next request after a drained queue re-pin
+                    # rather than rejecting correct traffic forever
+                    self._sample_shapes = None
+            return
+        self._ema_batch_s = dt if self._ema_batch_s is None \
+            else 0.8 * self._ema_batch_s + 0.2 * dt
+        if self.stats is not None:
+            self.stats.record_batch(n, bucket, dt)
+        now = time.monotonic()
+        for i, r in enumerate(batch):
+            r.future.set_result(
+                [o[i] if np.ndim(o) and np.shape(o)[0] == bucket else o
+                 for o in outs])
+            if self.stats is not None:
+                self.stats.record_latency((now - r.enqueued_at) * 1000.0)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain=True, timeout=30.0):
+        """Stop the dispatcher.  ``drain=True`` refuses new submissions
+        but finishes everything already queued/in flight first (the
+        SIGTERM contract: no accepted request is dropped)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            self._draining = True
+            if not drain:
+                dropped, self._queue = list(self._queue), deque()
+            else:
+                dropped = []
+            self._cv.notify_all()
+        for r in dropped:
+            r.future.set_error(Draining("dropped: close(drain=False)"))
+        with self._cv:
+            while self._queue or self._inflight:
+                if time.monotonic() >= deadline:
+                    break
+                self._cv.wait(0.1)
+            self._closing = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)

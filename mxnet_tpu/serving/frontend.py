@@ -1,0 +1,494 @@
+"""Admission-control HTTP front end: ``/predict/<model>``, ``/healthz``,
+``/stats`` — bounded queues, load shedding, graceful SIGTERM drain.
+
+Admission (Clipper-style SLO-aware control): a request is REFUSED with
+429 before it ever queues when the model's queue depth is at
+``MXTPU_SERVE_MAX_QUEUE`` (``shed_queue``) or the estimated queue wait
+exceeds the ``MXTPU_SERVE_SLO_MS`` latency objective (``shed_slo``) —
+under overload a serving system must answer *some* requests inside the
+SLO rather than all of them late.  Shed counters and per-stage metrics
+(queue depth, batch fill ratio, p50/p99 latency) are live on ``/stats``.
+
+Shutdown composes with ``tools/supervise.py``: SIGTERM flips the daemon
+to draining (new predicts get 503, ``/healthz`` reports ``draining``),
+every ACCEPTED request finishes and gets its 200, then the process
+exits 0.  A wedged forward is the StepWatchdog's job — armed around
+each batch dispatch, it dumps stacks and aborts with exit 87 so the
+supervisor relaunches the daemon (warm via ``MXTPU_COMPILE_CACHE``).
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..base import MXNetError, get_env, register_env
+from .batcher import BucketBatcher, Draining, QueueFull, parse_buckets
+
+__all__ = ["ServingFrontend", "ServeClient", "Stats",
+           "ENV_SERVE_MAX_QUEUE", "ENV_SERVE_SLO_MS"]
+
+ENV_SERVE_MAX_QUEUE = register_env(
+    "MXTPU_SERVE_MAX_QUEUE", default=256,
+    doc="Per-model queue-depth bound; requests beyond it are shed with "
+        "HTTP 429 (`shed_queue` on /stats)")
+ENV_SERVE_SLO_MS = register_env(
+    "MXTPU_SERVE_SLO_MS", default=0.0,
+    doc="Latency SLO: shed (429, `shed_slo`) when the estimated queue "
+        "wait exceeds this many ms; 0 disables the estimator")
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (no numpy interp —
+    the stats path must stay allocation-light)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class Stats(object):
+    """Thread-safe serving metrics: monotonically increasing counters, a
+    bounded latency window for percentiles, and batch-fill accounting."""
+
+    def __init__(self, window=4096):
+        self._lock = threading.Lock()
+        self._counters = {"accepted": 0, "completed": 0, "errors": 0,
+                          "shed_queue": 0, "shed_slo": 0, "rejected": 0}
+        self._latencies = deque(maxlen=window)
+        self._batches = 0
+        self._rows = 0
+        self._bucket_rows = 0
+        self._batch_time = 0.0
+
+    def inc(self, key, n=1):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def record_latency(self, ms):
+        with self._lock:
+            self._latencies.append(float(ms))
+
+    def record_batch(self, n, bucket, seconds):
+        with self._lock:
+            self._batches += 1
+            self._rows += int(n)
+            self._bucket_rows += int(bucket)
+            self._batch_time += float(seconds)
+
+    def snapshot(self):
+        with self._lock:
+            lat = sorted(self._latencies)
+            counters = dict(self._counters)
+            batches, rows = self._batches, self._rows
+            bucket_rows, batch_time = self._bucket_rows, self._batch_time
+        out = {"counters": counters,
+               "latency_ms": {"count": len(lat),
+                              "p50": _percentile(lat, 50),
+                              "p99": _percentile(lat, 99)},
+               "batches": {"count": batches, "rows": rows,
+                           "fill_ratio": round(rows / bucket_rows, 4)
+                           if bucket_rows else None,
+                           "avg_ms": round(batch_time / batches * 1000.0, 3)
+                           if batches else None}}
+        return out
+
+
+class ServingFrontend(object):
+    """The daemon: a :class:`ModelPool` behind per-model batchers and a
+    stdlib threading HTTP server.
+
+    HTTP surface::
+
+        POST /predict/<model>   body: {"inputs": {name: nested-list}}
+                                 (or {"data": [...]} shorthand, or a raw
+                                 .npy body with Content-Type
+                                 application/x-npy for the sole input)
+        GET  /healthz           {"status": "ok"|"draining", ...}
+        GET  /stats             counters + queue depth + fill + p50/p99
+
+    Responses: 200 result, 400 malformed, 404 unknown model, 429 shed
+    (queue bound / SLO), 503 draining.  Accepted work is never answered
+    5xx by a drain — that is the SIGTERM contract.
+    """
+
+    def __init__(self, pool, host="127.0.0.1", port=0, buckets=None,
+                 max_wait_ms=None, max_queue=None, slo_ms=None,
+                 watchdog=None, request_timeout=60.0):
+        self.pool = pool
+        self.host, self.port = host, int(port)
+        self.buckets = parse_buckets(buckets)
+        self.max_wait_ms = max_wait_ms
+        self.max_queue = int(get_env(ENV_SERVE_MAX_QUEUE)) \
+            if max_queue is None else int(max_queue)
+        self.slo_ms = float(get_env(ENV_SERVE_SLO_MS)) \
+            if slo_ms is None else float(slo_ms)
+        #: a StepWatchdog instance (or a zero-arg factory) ENABLING
+        #: watchdog coverage.  Each model's batcher gets its OWN
+        #: watchdog: armed()'s nesting bookkeeping is single-thread,
+        #: and every batcher dispatches on its own thread — one shared
+        #: watchdog across models would mis-track overlapping arms (a
+        #: wedged forward could go unmonitored, and a depth that never
+        #: returns to zero would disarm the watchdog for good)
+        self.watchdog = watchdog
+        self._watchdogs = []
+        self._given_watchdog_used = False
+        self.request_timeout = float(request_timeout)
+        self.stats = Stats()
+        self.draining = False
+        self._batchers = {}
+        self._lock = threading.Lock()
+        self._server = None
+        self._stopped = threading.Event()
+
+    # -- batching ----------------------------------------------------------
+    def _new_watchdog(self):
+        """One watchdog per batcher (call with ``_lock`` held).  The
+        given instance covers the first model; later models get a fresh
+        instance — same class, env-configured budget — or the factory's
+        product when ``watchdog`` is callable."""
+        if callable(self.watchdog):
+            wd = self.watchdog()
+        elif not self._given_watchdog_used:
+            self._given_watchdog_used = True
+            wd = self.watchdog
+        else:
+            wd = type(self.watchdog)()
+        self._watchdogs.append(wd)
+        wd.start()
+        return wd
+
+    def batcher(self, model, entry=None):
+        if entry is None:
+            entry = self.pool.get(model)  # raises on unknown model
+        with self._lock:
+            b = self._batchers.get(model)
+            if b is None:
+                wd = None if self.watchdog is None else \
+                    self._new_watchdog()
+                b = BucketBatcher(
+                    entry.forward, buckets=self.buckets,
+                    max_wait_ms=self.max_wait_ms,
+                    max_queue=self.max_queue, name=model,
+                    watchdog=wd, stats=self.stats)
+                self._batchers[model] = b
+        return b
+
+    def queue_depths(self):
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {name: b.depth for name, b in batchers.items()}
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, model):
+        """(accepted, http_status, reason) — the load-shedding decision,
+        taken BEFORE the request queues."""
+        return self._admit(self.batcher(model))
+
+    def _admit(self, b):
+        if self.draining:
+            return False, 503, "draining"
+        if b.depth >= self.max_queue:
+            self.stats.inc("shed_queue")
+            return False, 429, "queue depth %d at bound %d" % (
+                b.depth, self.max_queue)
+        if self.slo_ms > 0:
+            est = b.estimate_wait_ms()
+            if est > self.slo_ms:
+                self.stats.inc("shed_slo")
+                return False, 429, ("estimated wait %.1fms exceeds SLO "
+                                    "%.0fms" % (est, self.slo_ms))
+        return True, 200, None
+
+    def handle_predict(self, model, inputs, entry=None):
+        """Admission + batch + wait; returns ``(status, payload_dict)``.
+        Usable without the HTTP layer (tests, in-process serving).
+        ``entry`` skips the pool lookup when the caller (the HTTP
+        handler's 404 check) already resolved it."""
+        if entry is None:
+            entry = self.pool.get(model)
+        if entry.sample_shapes is not None:
+            # a client error must be a 400, not a 500 from deep inside
+            # the batch forward — and a WRONG first request must never
+            # pin the model's per-sample shapes
+            got = {k: tuple(np.shape(v)) for k, v in inputs.items()}
+            want = {k: tuple(s) for k, s in entry.sample_shapes.items()}
+            if got != want:
+                return 400, {"error": "input shapes %s != model's %s"
+                             % (got, want), "model": model}
+        b = self.batcher(model, entry=entry)
+        ok, status, reason = self._admit(b)
+        if not ok:
+            return status, {"error": reason, "model": model}
+        self.stats.inc("accepted")
+        tic = time.monotonic()
+        try:
+            fut = b.submit(inputs)
+            outs = fut.result(timeout=self.request_timeout)
+        except (Draining, QueueFull) as e:
+            # lost the race with a drain/bound between admit and submit
+            self.stats.inc("rejected")
+            return 429 if isinstance(e, QueueFull) else 503, \
+                {"error": str(e), "model": model}
+        except TimeoutError as e:
+            self.stats.inc("errors")
+            return 504, {"error": str(e), "model": model}
+        except Exception as e:  # noqa: BLE001 — the model failed, not us
+            self.stats.inc("errors")
+            return 500, {"error": "%s: %s" % (type(e).__name__, e),
+                         "model": model}
+        self.stats.inc("completed")
+        return 200, {"model": model,
+                     "outputs": [np.asarray(o).tolist() for o in outs],
+                     "ms": round((time.monotonic() - tic) * 1000.0, 3)}
+
+    def stats_payload(self):
+        payload = self.stats.snapshot()
+        payload["models"] = self.pool.names()
+        payload["queue_depth"] = self.queue_depths()
+        payload["draining"] = self.draining
+        payload["buckets"] = list(self.buckets)
+        return payload
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Bind the server + start the watchdog monitor; returns self.
+        ``self.port`` holds the real port (use port=0 for ephemeral)."""
+        if self._server is not None:
+            return self
+        frontend = self
+
+        class Handler(_Handler):
+            fe = frontend
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        # handler threads must outlive shutdown() so drained requests
+        # still get their responses written
+        self._server.daemon_threads = False
+        self._server.block_on_close = True
+        self.port = self._server.server_address[1]
+        return self
+
+    def serve_forever(self):
+        """Blocking accept loop (the daemon's main thread); returns
+        after :meth:`drain_and_stop` completes."""
+        self.start()
+        try:
+            self._server.serve_forever(poll_interval=0.1)
+        finally:
+            self._server.server_close()
+            self._stopped.set()
+
+    def serve_in_background(self):
+        """start() + serve_forever on a helper thread (tests)."""
+        self.start()
+        t = threading.Thread(target=self.serve_forever,
+                             name="mxserve-http", daemon=True)
+        t.start()
+        return self
+
+    def drain_and_stop(self, timeout=30.0):
+        """The SIGTERM path: stop admitting, finish every accepted
+        request, then stop the server.  Idempotent."""
+        self.draining = True
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=True, timeout=timeout)
+        with self._lock:
+            watchdogs, self._watchdogs = self._watchdogs, []
+        for wd in watchdogs:
+            wd.stop()
+        if self._server is not None:
+            self._server.shutdown()
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)):
+        """SIGTERM/SIGINT -> graceful drain (handler returns immediately;
+        a helper thread does the drain so the accept loop isn't blocked
+        inside the signal frame)."""
+        def _on_signal(signum, frame):
+            threading.Thread(target=self.drain_and_stop,
+                             name="mxserve-drain", daemon=True).start()
+        for sig in signals:
+            signal.signal(sig, _on_signal)
+        return self
+
+    def wait_stopped(self, timeout=None):
+        return self._stopped.wait(timeout)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes onto the owning :class:`ServingFrontend` (``fe`` class
+    attr, set by ``start()``)."""
+
+    fe = None
+    protocol_version = "HTTP/1.1"
+    #: socket timeout: an IDLE keep-alive connection parks its handler
+    #: thread in readline() — with block_on_close joining handler
+    #: threads at shutdown, a single idle client (a monitoring poller,
+    #: an unclosed ServeClient) would otherwise wedge the SIGTERM drain
+    #: forever.  On timeout http.server closes the connection, so the
+    #: drain's thread joins are bounded by ~this many seconds.  (It
+    #: does NOT bound an in-flight predict — that blocks in do_POST,
+    #: not in a socket read.)
+    timeout = 10.0
+
+    def log_message(self, fmt, *args):  # per-request stderr spam off
+        pass
+
+    def _reply(self, status, payload):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {
+                "status": "draining" if self.fe.draining else "ok",
+                "models": self.fe.pool.names()})
+        elif self.path == "/stats":
+            self._reply(200, self.fe.stats_payload())
+        else:
+            self._reply(404, {"error": "unknown path %r" % self.path})
+
+    def _parse_inputs(self, entry):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype == "application/x-npy":
+            import io as _pyio
+            arr = np.load(_pyio.BytesIO(body), allow_pickle=False)
+            return {entry.input_names[0]:
+                    np.ascontiguousarray(arr, dtype=np.float32)}
+        payload = json.loads(body.decode("utf-8"))
+        raw = payload.get("inputs", payload)
+        inputs = {}
+        for k, v in raw.items():
+            if k in entry.input_names:
+                inputs[k] = np.asarray(v, dtype=np.float32)
+        if set(inputs) != set(entry.input_names):
+            raise ValueError("need inputs %s, got %s"
+                             % (entry.input_names, sorted(raw)))
+        return inputs
+
+    def do_POST(self):
+        if not self.path.startswith("/predict/"):
+            self._reply(404, {"error": "unknown path %r" % self.path})
+            return
+        model = self.path[len("/predict/"):].strip("/")
+        try:
+            entry = self.fe.pool.get(model)
+        except MXNetError as e:
+            self._reply(404, {"error": str(e)})
+            return
+        try:
+            inputs = self._parse_inputs(entry)
+        except Exception as e:  # noqa: BLE001 — malformed client body
+            self._reply(400, {"error": "bad request body: %s" % (e,)})
+            return
+        status, payload = self.fe.handle_predict(model, inputs,
+                                                 entry=entry)
+        self._reply(status, payload)
+
+
+class ServeClient(object):
+    """Minimal keep-alive client for the daemon (tests, bench, drills).
+    One instance per thread — ``http.client`` connections are not
+    thread-safe."""
+
+    def __init__(self, host, port, timeout=60.0):
+        self.host, self.port, self.timeout = host, int(port), timeout
+        self._conn = None
+
+    def _connection(self):
+        import http.client
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def _request(self, method, path, body=None, headers=None):
+        # Retry ONLY send-phase failures (a keep-alive socket that died
+        # across a server restart surfaces in conn.request).  Once the
+        # request is on the wire, a response-phase failure must raise:
+        # blindly re-sending a non-idempotent POST /predict would
+        # execute it twice (double-counted stats, two queue slots).
+        try:
+            conn = self._connection()
+            conn.request(method, path, body=body, headers=headers or {})
+        except Exception:
+            self.close()
+            conn = self._connection()
+            conn.request(method, path, body=body, headers=headers or {})
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+        except Exception:
+            self.close()       # the connection is in an unknown state
+            if method not in ("GET", "HEAD"):
+                raise
+            # idempotent request on a keep-alive socket the server shut
+            # between requests (RemoteDisconnected): one clean retry
+            conn = self._connection()
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+        try:
+            payload = json.loads(data.decode("utf-8")) if data else {}
+        except ValueError:
+            payload = {"raw": data.decode("utf-8", "replace")}
+        return resp.status, payload
+
+    def predict(self, model, inputs, npy=False):
+        """``inputs``: {name: per-sample array} (or a bare array for the
+        single-input case).  Returns ``(status, payload)``."""
+        if not isinstance(inputs, dict):
+            inputs = {"data": inputs}
+        if npy:
+            import io as _pyio
+            (name, arr), = inputs.items()
+            buf = _pyio.BytesIO()
+            np.save(buf, np.asarray(arr, dtype=np.float32))
+            return self._request(
+                "POST", "/predict/%s" % model, body=buf.getvalue(),
+                headers={"Content-Type": "application/x-npy"})
+        body = json.dumps(
+            {"inputs": {k: np.asarray(v).tolist()
+                        for k, v in inputs.items()}}).encode("utf-8")
+        return self._request("POST", "/predict/%s" % model, body=body,
+                             headers={"Content-Type": "application/json"})
+
+    def healthz(self):
+        return self._request("GET", "/healthz")
+
+    def stats(self):
+        return self._request("GET", "/stats")
+
+    def wait_ready(self, deadline_s=60.0):
+        """Poll /healthz until the daemon answers; raises on timeout."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                status, payload = self.healthz()
+                if status == 200:
+                    return payload
+            except Exception:  # noqa: BLE001 — not accepting yet
+                self.close()
+            time.sleep(0.05)
+        raise TimeoutError("daemon at %s:%d never became healthy"
+                           % (self.host, self.port))

@@ -1,0 +1,291 @@
+"""Warm multi-model pool: named models, device-resident weights, one
+cached compiled forward per (model, bucket shape).
+
+Load surfaces mirror the training side's artifacts:
+
+- ``load(name, prefix, epoch)`` — the ``prefix-symbol.json`` +
+  ``prefix-%04d.params`` pair every ``save_checkpoint`` writes.
+- ``load_dir(name, directory)`` — a ``CheckpointManager`` directory:
+  ``resilience.restore`` picks the newest INTACT epoch (checksum
+  verification + corrupt-epoch walk-back included), so a serving daemon
+  pointed at a live training run always comes up on good weights.
+- ``add(name, symbol, arg_params, aux_params)`` — in-process handoff.
+
+Weights stay device-resident inside each model's ``predict.Predictor``
+(bound executors per bucket shape).  ``MXTPU_SERVE_DTYPE=bfloat16``
+casts floating-point weights at load time (half the HBM + memory
+bandwidth per forward; inputs stay f32 and XLA promotes), the classic
+weight-cast serving mode.
+
+``analyze()`` runs the mxlint graph rules over a bucket forward —
+donation/dtype/callback/collective hygiene applies to inference graphs
+too — and ``MXTPU_ANALYZE=1|strict`` lints each newly compiled bucket
+before its first dispatch, exactly like the training-side gate.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..base import MXNetError, get_env, register_env
+
+__all__ = ["ModelPool", "PooledModel", "ENV_SERVE_DTYPE"]
+
+ENV_SERVE_DTYPE = register_env(
+    "MXTPU_SERVE_DTYPE", default="float32",
+    doc="Serving weight dtype: `bfloat16` casts floating-point weights "
+        "at load time (weight-cast serving; inputs stay f32)")
+
+_CASTABLE = ("float32", "float64")
+
+
+class PooledModel(object):
+    """One warm model: symbol + device-resident params + a Predictor
+    whose per-shape executor cache holds one compiled forward per
+    bucket.  All forwards are expected on ONE thread (the model's
+    batcher dispatcher)."""
+
+    def __init__(self, name, symbol, arg_params, aux_params=None,
+                 dtype=None, ctx=None, sample_shapes=None):
+        from .. import symbol as sym_mod
+        self.name = name
+        self.symbol = symbol if hasattr(symbol, "list_arguments") \
+            else sym_mod.load_json(symbol)
+        self.dtype = dtype if dtype is not None else get_env(ENV_SERVE_DTYPE)
+        self.ctx = ctx
+        self.arg_params = self._cast(arg_params or {})
+        self.aux_params = self._cast(aux_params or {})
+        #: {input_name: per-sample shape} once declared or first served
+        self.sample_shapes = dict(sample_shapes) if sample_shapes else None
+        self._pred = None
+        self._cur_shapes = None
+        self._analyzed = set()      # signatures that linted clean/warned
+        self._refused = {}          # signature -> strict-mode message
+        arg_names = self.symbol.list_arguments()
+        #: data inputs = arguments with no loaded weight that aren't
+        #: loss labels (labels are zero-filled by Predictor.reshape)
+        self.input_names = [n for n in arg_names
+                            if n not in self.arg_params
+                            and not n.endswith("label")]
+        self.output_names = self.symbol.list_outputs()
+
+    def _cast(self, params):
+        if self.dtype in (None, "", "float32"):
+            return dict(params)
+        out = {}
+        for k, v in params.items():
+            if np.dtype(v.dtype).name in _CASTABLE:
+                out[k] = v.astype(self.dtype)
+            else:
+                out[k] = v
+        return out
+
+    def _blob(self):
+        blob = {"arg:%s" % k: v for k, v in self.arg_params.items()}
+        blob.update({"aux:%s" % k: v for k, v in self.aux_params.items()})
+        return blob
+
+    def forward(self, inputs, n_valid=None):
+        """One batch forward at the given (bucket) shapes -> list of
+        per-output numpy arrays.  Shapes repeat -> the Predictor's
+        cached executor; a new shape compiles once (and is graph-linted
+        when ``MXTPU_ANALYZE`` is set).  ``n_valid`` (how many leading
+        rows are real vs padding) is accepted for batcher-runner
+        compatibility; the whole padded batch always runs."""
+        from .. import predict
+        shapes = {k: tuple(np.shape(v)) for k, v in inputs.items()}
+        new_sig = self._cur_shapes != shapes
+        if new_sig:
+            # gate BEFORE recording the signature: a strict-mode
+            # refusal must stay sticky across retries, not be skipped
+            # because the shape "already ran"
+            self._maybe_env_analyze(shapes)
+        if self._pred is None:
+            self._pred = predict.Predictor(self.symbol, self._blob(),
+                                           shapes, ctx=self.ctx)
+        elif new_sig:
+            self._pred.reshape(shapes)
+        self._cur_shapes = shapes
+        self._pred.forward(**inputs)
+        if self.sample_shapes is None:
+            # commit only AFTER a successful forward: a malformed first
+            # request must never pin wrong shapes and brick the model
+            # for every correct request that follows
+            self.sample_shapes = {k: s[1:] for k, s in shapes.items()}
+        return [self._pred.get_output(i)
+                for i in range(len(self.output_names))]
+
+    def warmup(self, buckets):
+        """Compile (and fault in) one forward per bucket ahead of
+        traffic.  Needs ``sample_shapes`` (declared at load time or via
+        the first request)."""
+        if self.sample_shapes is None:
+            raise MXNetError(
+                "model %r has no declared sample_shapes to warm up "
+                "(pass sample_shapes= at load, or serve one request "
+                "first)" % self.name)
+        rs = np.random.RandomState(0)
+        for b in buckets:
+            dummy = {k: rs.rand(int(b), *s).astype(np.float32)
+                     for k, s in self.sample_shapes.items()}
+            self.forward(dummy)
+        return self
+
+    # -- static analysis ---------------------------------------------------
+    def analyze(self, bucket=1):
+        """mxlint graph lint of this model's bucket-``bucket`` forward
+        (inference graphs obey the same donation/dtype/callback rules as
+        training steps; a single-device forward must show NO
+        collectives).  Returns the :class:`~..analysis.report.Report`."""
+        import jax
+        import jax.numpy as jnp
+        from ..analysis import graph_lint
+        from ..executor import _build_eval
+        from ..ndarray import NDArray
+        if self.sample_shapes is None:
+            raise MXNetError("model %r: declare sample_shapes before "
+                             "analyze()" % self.name)
+        eval_fn = _build_eval(self.symbol)
+
+        def _raw(d):
+            return {k: (v._data if isinstance(v, NDArray)
+                        else jnp.asarray(v)) for k, v in d.items()}
+
+        params, auxs = _raw(self.arg_params), _raw(self.aux_params)
+        shapes = {k: (int(bucket),) + tuple(s)
+                  for k, s in self.sample_shapes.items()}
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        for n, shp in zip(self.symbol.list_arguments(), arg_shapes):
+            if n not in params and n not in shapes:
+                params[n] = jnp.zeros(shp, jnp.float32)
+        for n, shp in zip(self.symbol.list_auxiliary_states(), aux_shapes):
+            if n not in auxs:
+                auxs[n] = jnp.zeros(shp, jnp.float32)
+        input_names = sorted(shapes)
+        rng = jax.random.PRNGKey(0)
+
+        def infer(*inputs):
+            merged = dict(params)
+            merged.update(dict(zip(input_names, inputs)))
+            outs, _ = eval_fn(merged, auxs, rng, False)
+            return tuple(outs)
+
+        rs = np.random.RandomState(0)
+        args = [rs.rand(*shapes[n]).astype(np.float32)
+                for n in input_names]
+        return graph_lint.lint_jit(infer, *args, expect_allgather=False)
+
+    def _maybe_env_analyze(self, shapes):
+        """The ``MXTPU_ANALYZE`` gate, per newly compiled signature:
+        warn (``1``) or refuse to serve (``strict``) on findings."""
+        from ..analysis import ENV_ANALYZE
+        mode = get_env(ENV_ANALYZE)
+        if not mode:
+            return
+        sig = tuple(sorted(shapes.items()))
+        if sig in self._refused:
+            # a strict refusal is STICKY: a retry of the same signature
+            # must not slip the violating program into service
+            raise MXNetError(self._refused[sig])
+        if sig in self._analyzed:
+            return
+        bucket = next(iter(shapes.values()))[0]
+        report = self.analyze(bucket=bucket)
+        if report.ok:
+            self._analyzed.add(sig)
+            _log().info("MXTPU_ANALYZE: serving forward %s@%s is clean",
+                        self.name, bucket)
+            return
+        text = report.format_text()
+        if str(mode).strip().lower() == "strict":
+            msg = ("MXTPU_ANALYZE=strict: serving forward %s@%s has "
+                   "findings:\n%s" % (self.name, bucket, text))
+            self._refused[sig] = msg
+            raise MXNetError(msg)
+        self._analyzed.add(sig)
+        _log().warning("MXTPU_ANALYZE: serving forward %s@%s has "
+                       "findings:\n%s", self.name, bucket, text)
+
+
+def _log():
+    import logging
+    return logging.getLogger(__name__)
+
+
+class ModelPool(object):
+    """Name -> :class:`PooledModel` registry (admin ops are locked; the
+    per-model forward path is single-threaded by its batcher)."""
+
+    def __init__(self, ctx=None, dtype=None):
+        self.ctx = ctx
+        self.dtype = dtype
+        self._models = {}
+        self._lock = threading.Lock()
+
+    def _put(self, entry):
+        with self._lock:
+            self._models[entry.name] = entry
+        return entry
+
+    def add(self, name, symbol, arg_params=None, aux_params=None,
+            sample_shapes=None, dtype=None):
+        """Register an in-memory model."""
+        return self._put(PooledModel(
+            name, symbol, arg_params, aux_params,
+            dtype=dtype if dtype is not None else self.dtype,
+            ctx=self.ctx, sample_shapes=sample_shapes))
+
+    def load(self, name, prefix, epoch=0, sample_shapes=None, dtype=None):
+        """Load ``prefix-symbol.json`` + ``prefix-%04d.params`` (the
+        ``save_checkpoint`` pair)."""
+        from ..model import load_checkpoint
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return self._put(PooledModel(
+            name, symbol, arg_params, aux_params,
+            dtype=dtype if dtype is not None else self.dtype,
+            ctx=self.ctx, sample_shapes=sample_shapes))
+
+    def load_dir(self, name, directory, epoch=None, sample_shapes=None,
+                 dtype=None):
+        """Load the newest intact epoch from a ``CheckpointManager``
+        directory (checksum-verified, walk-back past corrupt epochs)."""
+        from ..resilience import CheckpointManager
+        man = CheckpointManager(directory)
+        symbol, arg_params, aux_params, _states, ep = man.restore(epoch)
+        if symbol is None:
+            raise MXNetError(
+                "checkpoint directory %r has no symbol file — serving "
+                "needs the graph, not just params" % directory)
+        entry = self._put(PooledModel(
+            name, symbol, arg_params, aux_params,
+            dtype=dtype if dtype is not None else self.dtype,
+            ctx=self.ctx, sample_shapes=sample_shapes))
+        entry.loaded_epoch = ep
+        return entry
+
+    def get(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise MXNetError("no model %r in the pool (have: %s)"
+                             % (name, self.names()))
+        return entry
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._models
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def remove(self, name):
+        with self._lock:
+            self._models.pop(name, None)
+
+    def warmup(self, buckets, names=None):
+        """Warm every (or the named) model over ``buckets``."""
+        for n in (self.names() if names is None else names):
+            self.get(n).warmup(buckets)
+        return self

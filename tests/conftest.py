@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers", "chaos: kill-and-resume drill (spawns subprocesses, "
         "sends real signals; runs in tier-1, combinable with slow for "
         "pod-scale variants)")
+    config.addinivalue_line(
+        "markers", "serve: inference-serving runtime test (batcher/"
+        "pool/frontend units run in tier-1; daemon drills spawn "
+        "tools/serve.py subprocesses)")
 
 
 @pytest.fixture
@@ -85,7 +89,11 @@ def pytest_collection_modifyitems(config, items):
                 "test_dec_example", "test_speech_demo_example",
                 # eager Custom-op training loops: every op is a separate
                 # tunnel round-trip (189s/55s even on CPU)
-                "test_stochdepth_example", "test_rcnn_example")
+                "test_stochdepth_example", "test_rcnn_example",
+                # serving: per-request forwards through the tunneled
+                # link + CPU-pinned daemon subprocesses; the CPU tier
+                # runs the full suite
+                "test_serving")
     for item in items:
         if any(k in str(item.fspath) for k in needs_mesh):
             item.add_marker(skip)

@@ -1,0 +1,164 @@
+"""bench.py harness invariants (ROADMAP item 5): per-metric timeout
+isolation — one metric hitting its budget costs THAT metric a partial
+artifact entry, never the run — and the regression gate that compares a
+fresh artifact against the most recent ``BENCH_*.json``.
+
+The isolation regression being pinned: ``subprocess.run(timeout=)``
+kills only the direct child; a grandchild (XLA compile worker, decode
+pool) holding the inherited stdout pipe then blocks the post-kill
+``communicate()`` indefinitely — the BENCH_r05 failure, where one 480s
+``inception-bn`` kill turned into rc=1 with no artifact at all.
+``_collect`` now runs each metric in its own session and SIGKILLs the
+whole process group.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# per-metric timeout isolation
+# ---------------------------------------------------------------------------
+
+def test_collect_timeout_returns_partial_record_fast():
+    """A metric that hangs WITH a pipe-holding grandchild (the r05
+    shape) must come back as a status record within ~the budget — not
+    block until the grandchild's natural exit (600s), not raise."""
+    t0 = time.monotonic()
+    out = bench._collect("_hang-grandchild", timeout=3)
+    elapsed = time.monotonic() - t0
+    assert out == {"_hang-grandchild": {"status": "timeout",
+                                        "timeout_s": 3}}
+    assert elapsed < 25, ("timeout isolation took %.1fs — the group "
+                          "kill regressed" % elapsed)
+
+
+def test_collect_failed_mode_returns_status_record():
+    """A metric whose subprocess dies (unknown mode -> no BENCH_PART
+    line) is recorded as failed, not silently dropped."""
+    out = bench._collect("_no-such-mode", timeout=120)
+    assert out["_no-such-mode"]["status"] == "failed"
+
+
+def test_timeout_records_land_in_incomplete_not_in_metrics():
+    """main() moves status records aside so numeric consumers never see
+    them — mirrored here on the exact dict shape _collect returns."""
+    parts = {"compute": 100.0,
+             "inception-bn": {"status": "timeout", "timeout_s": 480}}
+    statuses = {k: v for k, v in parts.items()
+                if isinstance(v, dict) and v.get("status")}
+    assert set(statuses) == {"inception-bn"}
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+# ---------------------------------------------------------------------------
+
+def _write(path, payload):
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return str(path)
+
+
+BASE = {"value": 1000.0, "compute_img_s": 2000.0,
+        "inception_bn_img_s": 800.0, "lstm_tok_s": 2.0e6,
+        "serve_mlp_c8_qps": 900.0, "pipeline_note": "prose ignored"}
+
+
+def test_gate_passes_within_tolerance(tmp_path):
+    new = dict(BASE, value=950.0)          # -5%: inside the 10% budget
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", BASE))
+    assert rep["pass"], rep
+    assert "value" in rep["checked"]
+
+
+def test_gate_fails_on_drop_beyond_tolerance(tmp_path):
+    new = dict(BASE, inception_bn_img_s=700.0)   # -12.5%
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", BASE))
+    assert not rep["pass"]
+    (reg,) = rep["regressions"]
+    assert reg["key"] == "inception_bn_img_s"
+    assert reg["drop"] == pytest.approx(0.125, abs=0.01)
+
+
+def test_gate_flags_missing_metric_as_regression(tmp_path):
+    """The r05 scenario through the gate: the timed-out model's key is
+    absent from the (partial) artifact — that IS a failure signal."""
+    new = {k: v for k, v in BASE.items() if k != "inception_bn_img_s"}
+    new["incomplete"] = {"inception-bn": {"status": "timeout",
+                                          "timeout_s": 480}}
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", BASE))
+    assert not rep["pass"]
+    (reg,) = rep["regressions"]
+    assert reg["key"] == "inception_bn_img_s"
+    assert reg["status"] == "missing"
+    assert rep["incomplete_modes"] == ["inception-bn"]
+
+
+def test_gate_serve_prefix_keys_are_guarded(tmp_path):
+    new = dict(BASE, serve_mlp_c8_qps=700.0)     # -22%
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", BASE))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "serve_mlp_c8_qps"
+
+
+def test_gate_unwraps_driver_artifacts_and_skips_unusable(tmp_path):
+    """Baselines come as the driver's {n, cmd, rc, parsed, tail}
+    wrapper; a wrapper with parsed=null (the r05 rc=1 file) must be
+    skipped in favor of the previous usable round."""
+    _write(tmp_path / "BENCH_r04.json",
+           {"n": 4, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": BASE})
+    _write(tmp_path / "BENCH_r05.json",
+           {"n": 5, "cmd": "python bench.py", "rc": 1,
+            "tail": "Traceback...", "parsed": None})
+    found = bench._latest_artifact(str(tmp_path))
+    assert found is not None
+    n, path, payload = found
+    assert n == 4 and payload == BASE
+
+
+def test_gate_no_baseline_found_in_empty_dir(tmp_path):
+    """A repo with no prior BENCH_*.json has nothing to gate against
+    (gate() then passes with a note rather than blocking the first
+    run); the discovery itself must return None, not crash."""
+    assert bench._latest_artifact(str(tmp_path)) is None
+
+
+def test_gate_cli_exit_codes(tmp_path):
+    old = _write(tmp_path / "old.json", BASE)
+    good = _write(tmp_path / "good.json", dict(BASE))
+    bad = _write(tmp_path / "bad.json", dict(BASE, value=500.0))
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--gate", good,
+         "--against", old], capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert json.loads(res.stdout)["pass"] is True
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--gate", bad,
+         "--against", old], capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1
+    report = json.loads(res.stdout)
+    assert report["regressions"][0]["key"] == "value"
+
+
+def test_gate_custom_tolerance(tmp_path):
+    old = _write(tmp_path / "old.json", BASE)
+    new = _write(tmp_path / "new.json", dict(BASE, value=800.0))  # -20%
+    assert not bench.gate(new, against=old, tolerance=0.10)["pass"]
+    assert bench.gate(new, against=old, tolerance=0.25)["pass"]

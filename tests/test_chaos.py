@@ -723,6 +723,197 @@ def test_chaos_sigkill_mid_async_save_resumes_previous_epoch(tmp_path):
         assert np.array_equal(full[name], cut[name]), name
 
 
+# ---------------------------------------------------------------------------
+# serving drills: SIGTERM drain + wedged-forward watchdog relaunch
+# (docs/how_to/serving.md — the daemon side of the survival story)
+# ---------------------------------------------------------------------------
+
+SERVE = os.path.join(REPO, "tools", "serve.py")
+
+#: relaunch-aware daemon wrapper: identical to running tools/serve.py,
+#: except a supervised RELAUNCH (MXTPU_RESUME=1) strips the armed fault
+#: so the second life serves clean — the drill's "fault strikes once"
+#: determinism, same pattern as CKPT_DRILL_SCRIPT
+SERVE_DRILL_SCRIPT = """
+import os, runpy, sys
+sys.path.insert(0, %(repo)r)
+if os.environ.get("MXTPU_RESUME") == "1":
+    os.environ.pop("MXTPU_FAULTS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.argv = ["serve.py",
+            "--model", "mlp=" + os.environ["SERVE_PREFIX"] + ":1",
+            "--input-shape", "data=32", "--port", "0",
+            "--port-file", os.environ["SERVE_PORT_FILE"],
+            "--buckets", "1,2,4,8", "--max-wait-ms", "5"]
+runpy.run_path(%(serve)r, run_name="__main__")
+"""
+
+
+def _save_serve_mlp(tmp_path):
+    from mxnet_tpu.model import save_checkpoint
+    sym = mlp_sym(num_classes=10, nh=32)
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 32))
+    args = {n: mx.nd.array(rs.uniform(-0.3, 0.3, s).astype("f"))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "mlp")
+    save_checkpoint(prefix, 1, sym, args, {}, blocking=True)
+    return prefix
+
+
+def _serve_request(port, timeout=10.0):
+    """One POST /predict/mlp; returns (status, payload) or (None, err)."""
+    from mxnet_tpu.serving import ServeClient
+    cli = ServeClient("127.0.0.1", port, timeout=timeout)
+    try:
+        return cli.predict("mlp", np.zeros((32,), "f"))
+    except Exception as e:  # noqa: BLE001 — daemon down/wedged
+        return None, {"error": str(e)}
+    finally:
+        cli.close()
+
+
+def _wait_port_file(path, proc, deadline_s=120):
+    deadline = time.monotonic() + deadline_s
+    while not os.path.exists(path):
+        assert proc.poll() is None, "daemon died before listening"
+        assert time.monotonic() < deadline, "daemon never listened"
+        time.sleep(0.05)
+    return int(open(path).read().split(":")[1])
+
+
+@pytest.mark.chaos
+def test_serving_drill_sigterm_drains_in_flight_requests(tmp_path):
+    """SIGTERM lands while requests are queued in an open batch window:
+    every ACCEPTED request still gets its 200 (no 5xx for accepted
+    work), post-drain arrivals are refused, and the daemon exits 0."""
+    import threading
+
+    prefix = _save_serve_mlp(tmp_path)
+    port_file = str(tmp_path / "port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, SERVE, "--model", "mlp=%s:1" % prefix,
+         "--input-shape", "data=32", "--port", "0",
+         "--port-file", port_file, "--buckets", "32",
+         "--max-wait-ms", "1500"],   # a wide-open batch window: the
+        # 12 requests below are all still QUEUED when SIGTERM lands
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = _wait_port_file(port_file, proc)
+        from mxnet_tpu.serving import ServeClient
+        ServeClient("127.0.0.1", port).wait_ready(60)
+
+        results = [None] * 12
+        done_at = [None] * 12
+
+        def fire(i):
+            results[i] = _serve_request(port, timeout=90)
+            done_at[i] = time.monotonic()
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)              # requests accepted, batch window open
+        proc.send_signal(signal.SIGTERM)
+        sigterm_at = time.monotonic()
+        for t in threads:
+            t.join(timeout=120)
+
+        # every accepted request completed 200 with a real result —
+        # and completed AFTER the SIGTERM (they really were in flight:
+        # the 1500ms batch window was still holding them queued)
+        for i, (status, payload) in enumerate(results):
+            assert status == 200, (i, payload)
+            assert len(payload["outputs"][0]) == 10
+            assert done_at[i] >= sigterm_at, (
+                "request %d completed before SIGTERM — nothing was in "
+                "flight, the drill proved nothing" % i)
+        rc = proc.wait(timeout=60)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        err = proc.stderr.read()
+        assert "drained" in err
+        # post-drain arrival: refused (503/conn error), never a 5xx==500
+        status, _ = _serve_request(port, timeout=5)
+        assert status in (None, 503)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+@pytest.mark.chaos
+def test_serving_drill_wedged_forward_watchdog_supervise_relaunch(
+        tmp_path):
+    """The serving half of the watchdog story: a wedged batch forward
+    (MXTPU_FAULTS=hang_serve_forward:1, the env plumbing a pod drill
+    would use) trips the StepWatchdog inside its 4s budget -> stack
+    dump + exit 87 -> supervise.py relaunches the daemon
+    (MXTPU_RESUME=1 strips the fault) -> traffic is served again, warm
+    via the shared compile cache.  A supervisor SIGTERM then drains the
+    relaunched daemon to rc 0."""
+    prefix = _save_serve_mlp(tmp_path)
+    script = tmp_path / "serve_drill.py"
+    script.write_text(SERVE_DRILL_SCRIPT
+                      % {"repo": REPO, "serve": SERVE})
+    port_file = str(tmp_path / "port")
+    debug_dir = tmp_path / "debug"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MXTPU_RESUME", None)
+    env.update(SERVE_PREFIX=prefix, SERVE_PORT_FILE=port_file,
+               MXTPU_FAULTS="hang_serve_forward:1",
+               MXTPU_STEP_TIMEOUT="4",
+               MXTPU_DEBUG_DIR=str(debug_dir),
+               MXTPU_COMPILE_CACHE=str(tmp_path / "xla_cache"))
+    proc = subprocess.Popen(
+        [sys.executable, SUPERVISE, "--max-restarts", "1", "--backoff",
+         "0", "--", sys.executable, str(script)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = _wait_port_file(port_file, proc)
+        # this request hits the armed hang: the dispatch wedges, the
+        # watchdog fires exit 87, supervise relaunches — keep knocking
+        # (re-reading the port file: the relaunch binds a new port)
+        # until the reborn daemon answers 200
+        deadline = time.monotonic() + 180
+        served = False
+        while time.monotonic() < deadline:
+            try:
+                port = int(open(port_file).read().split(":")[1])
+            except (OSError, ValueError, IndexError):
+                pass
+            status, payload = _serve_request(port, timeout=5)
+            if status == 200:
+                served = True
+                break
+            assert proc.poll() is None, \
+                "supervisor gave up: %s" % proc.stderr.read()[-3000:]
+            time.sleep(0.2)
+        assert served, "daemon never served after the watchdog relaunch"
+
+        # shut the relaunched daemon down through the supervisor
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        err = proc.stderr.read()
+        assert rc == 0, err[-3000:]
+        assert "watchdog abort (hung step)" in err     # supervise's log
+        assert "StepWatchdog" in err                   # the dump itself
+        assert "exceeded its 4.0s budget" in err
+        dumps = list(debug_dir.iterdir())
+        assert len(dumps) == 1 and \
+            dumps[0].name.startswith("watchdog-")
+        assert "serve mlp batch" in dumps[0].read_text()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 @pytest.mark.chaos
 def test_watchdog_drill_stalled_step_dumps_and_aborts(tmp_path):
     """A deliberately stalled fused step (MXTPU_FAULTS hang injection)
