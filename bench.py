@@ -298,7 +298,9 @@ def _decode_bench(batch=128, n_img=1024, trials=3):
             data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
             rand_crop=True, rand_mirror=True, preprocess_threads=threads,
             prefetch_buffer=4, dtype="uint8", layout="NHWC", seed=0,
-            host_batches=True)
+            host_batches=True, data_service=False)  # this metric IS the
+        # in-process pipe — an ambient MXTPU_DATA_WORKERS must not
+        # silently remeasure the service under the pipe's key
         for b in it:   # warm epoch (thread pools, buffers, page cache)
             pass
 
@@ -312,12 +314,125 @@ def _decode_bench(batch=128, n_img=1024, trials=3):
 
         scaling[threads] = round(_best_of(it_trial, trials), 2)
         it.close()
-    return {
+    out = {
         "decode": max(scaling.values()),
         "decode_per_core": scaling[1],
         "decode_scaling": scaling,
+        "decode_scaling_x": round(max(scaling.values()) / scaling[1], 3),
         "ncores": os.cpu_count(),
     }
+    if (os.cpu_count() or 1) == 1:
+        # honesty note: with one core the 1/2/4/8 rows are flat BY
+        # CONSTRUCTION — the gate skips scaling-shape comparisons on
+        # such hosts so a 1-core CI box can neither mask nor fake a
+        # real scaling regression (see gate())
+        out["decode_scaling_note"] = "flat_by_construction_1core"
+    return out
+
+
+def _data_service_bench(batch=128, n_img=1024, trials=2):
+    """The multi-process shared-memory data service
+    (mxnet_tpu/data_service/, docs/how_to/performance.md "Scaling the
+    input pipeline") against the in-process pipe, pure host work:
+
+      - data_service_transport_overhead: service at workers=1 vs the raw
+        in-process native pipe at preprocess_threads=1 — the cost of the
+        process hop + ring (decode lands directly in shared memory, the
+        collector hands zero-copy views, so this should be < 10% and is
+        typically NEGATIVE: the consumer stops stealing decode cycles).
+      - data_service_scaling: img/s per worker-process count; with >1
+        core this must scale near-linearly where the in-process pipe is
+        flat (decode_scaling).  data_service_scaling_x is the ratio at
+        min(4, ncores) workers vs 1; linear would equal that worker
+        count (data_service_linear_frac = x / workers >= 0.7 is the
+        acceptance bar).  On a 1-core host every row is flat by
+        construction and the note tells the gate to skip the shape.
+      - per-stage counters from the service's stats() surface
+        (producer/consumer stall %, mean ring occupancy).
+    """
+    import mxnet_tpu as mx
+
+    prefix = _make_dataset(n_img)
+    ncores = os.cpu_count() or 1
+    kw = dict(path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+              data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+              rand_crop=True, rand_mirror=True, prefetch_buffer=4,
+              dtype="uint8", layout="NHWC", seed=0, host_batches=True)
+
+    def measure(it):
+        """(best img/s, stats-delta of the best trial) after one warm
+        epoch."""
+        for b in it:
+            pass
+        best, best_stats = 0.0, None
+        for _ in range(max(1, trials)):
+            before = it.stats()
+            it.reset()
+            n = 0
+            tic = time.time()
+            for b in it:
+                n += b.data[0].shape[0]
+            dt = time.time() - tic
+            rate = n / dt
+            if rate > best:
+                best = rate
+                after = it.stats()
+                if after is not None:
+                    best_stats = {
+                        "elapsed_s": dt,
+                        "workers": after["num_workers"],
+                        "producer_stall_s":
+                            after["producer_stall_s"]
+                            - (before or after)["producer_stall_s"],
+                        "consumer_stall_s":
+                            after["consumer_stall_s"]
+                            - (before or after)["consumer_stall_s"],
+                        "ring_occupancy": after["ring_occupancy"],
+                    }
+        it.close()
+        return best, best_stats
+
+    # data_service=False pins the baseline to the in-process pipe even
+    # when an ambient MXTPU_DATA_WORKERS would route it (a service-vs-
+    # service "overhead" of ~0 would be a lie)
+    inproc, _ = measure(mx.io.ImageRecordIter(
+        preprocess_threads=1, data_service=False, **kw))
+
+    scaling, stats_at = {}, {}
+    for w in (1, 2, 4, 8):
+        svc, st = measure(mx.io.ImageRecordIter(
+            preprocess_threads=w, data_service=True, **kw))
+        scaling[w] = round(svc, 2)
+        if st is not None:
+            stats_at[w] = st
+
+    # largest MEASURED worker count within min(4, ncores) — ncores==3
+    # must pick row 2, not KeyError on a row that was never measured
+    w_target = max((w for w in scaling if w <= min(4, ncores)),
+                   default=1) if ncores > 1 else 1
+    sx = round(scaling[w_target] / scaling[1], 3) if scaling[1] else 0.0
+    out = {
+        "data_service_img_s": max(scaling.values()),
+        "data_service_scaling": scaling,
+        "data_service_scaling_x": sx,
+        "data_service_scaling_workers": w_target,
+        "data_service_linear_frac": round(sx / max(1, w_target), 3),
+        "data_service_inproc_img_s": round(inproc, 2),
+        "data_service_transport_overhead": round(
+            1.0 - scaling[1] / inproc, 3) if inproc else None,
+        "data_service_ncores": ncores,
+    }
+    st = stats_at.get(w_target)
+    if st is not None and st["elapsed_s"] > 0:
+        out["data_service_producer_stall_pct"] = round(
+            100.0 * st["producer_stall_s"]
+            / (st["workers"] * st["elapsed_s"]), 1)
+        out["data_service_consumer_stall_pct"] = round(
+            100.0 * st["consumer_stall_s"] / st["elapsed_s"], 1)
+        out["data_service_ring_occupancy"] = st["ring_occupancy"]
+    if ncores == 1:
+        out["data_service_scaling_note"] = "flat_by_construction_1core"
+    return out
 
 
 def _fed_cpu_bench(batch=64, steps=40, warmup=8, trials=3):
@@ -1151,8 +1266,11 @@ def _run_mode(mode):
         _sp.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
         time.sleep(600)
         return
+    if mode in ("data_service", "data-service"):
+        mode = "data-service"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume", "checkpoint", "analyze", "serve"):
+                "resume", "checkpoint", "analyze", "serve",
+                "data-service"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -1171,6 +1289,8 @@ def _run_mode(mode):
         out.update(_serve_bench())
     elif mode == "decode":
         out.update(_decode_bench())
+    elif mode == "data-service":
+        out.update(_data_service_bench())
     elif mode == "fed-cpu":
         out.update(_fed_cpu_bench())
     elif mode == "pipeline":
@@ -1291,7 +1411,19 @@ def _collect(mode, timeout=480, extra_env=None):
 GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "inception_bn_img_s", "resnet152_img_s", "lstm_tok_s",
              "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
-             "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup")
+             "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup",
+             "data_service_img_s", "data_service_scaling_x",
+             "pipeline_decode_scaling_x")
+
+#: scaling-SHAPE keys: flat by construction on a 1-core host (the
+#: decode threads/worker processes have nowhere to scale TO), so when
+#: either artifact carries the matching flat_by_construction note the
+#: comparison is skipped — a 1-core CI box can neither mask nor fake a
+#: scaling regression.  The absolute-throughput keys above still gate.
+SCALING_SHAPE_KEYS = {
+    "pipeline_decode_scaling_x": "decode_scaling_note",
+    "data_service_scaling_x": "data_service_scaling_note",
+}
 
 
 def _gate_payload(path):
@@ -1346,17 +1478,22 @@ def _match_gate_keys(payload):
 
 
 def gate(new_path, against=None, tolerance=0.10):
-    """Compare ``new_path`` against a baseline artifact; returns the
-    report dict (``pass`` False on any guarded key dropping more than
-    ``tolerance``, going missing, or timing out)."""
-    try:
-        new = _gate_payload(new_path)
-    except (OSError, ValueError) as e:
-        return {"pass": False, "error": "cannot read artifact %s: %s"
-                % (new_path, e)}
-    if new is None:
-        return {"pass": False, "error": "artifact %s holds no parsed "
-                "result" % new_path}
+    """Compare ``new_path`` (an artifact path, or an already-parsed
+    result dict — the self-gate in ``main()`` passes its own result)
+    against a baseline artifact; returns the report dict (``pass``
+    False on any guarded key dropping more than ``tolerance``, going
+    missing, or timing out)."""
+    if isinstance(new_path, dict):
+        new, new_path = new_path, None
+    else:
+        try:
+            new = _gate_payload(new_path)
+        except (OSError, ValueError) as e:
+            return {"pass": False, "error": "cannot read artifact %s: %s"
+                    % (new_path, e)}
+        if new is None:
+            return {"pass": False, "error": "artifact %s holds no parsed "
+                    "result" % new_path}
     if against:
         try:
             base_path, base = against, _gate_payload(against)
@@ -1374,8 +1511,15 @@ def gate(new_path, against=None, tolerance=0.10):
     if base is None:
         return {"pass": False, "error": "baseline %s holds no parsed "
                 "result" % base_path}
-    regressions, checked = [], []
+    regressions, checked, skipped = [], [], []
     for key in sorted(_match_gate_keys(base)):
+        note = SCALING_SHAPE_KEYS.get(key)
+        if note is not None and (
+                str(base.get(note, "")).startswith("flat_by_construction")
+                or str(new.get(note, "")).startswith(
+                    "flat_by_construction")):
+            skipped.append(key)
+            continue
         old_v = base[key]
         new_v = new.get(key)
         if not isinstance(new_v, (int, float)):
@@ -1393,6 +1537,8 @@ def gate(new_path, against=None, tolerance=0.10):
     report = {"pass": not regressions, "baseline": base_path,
               "tolerance": tolerance, "checked": checked,
               "regressions": regressions}
+    if skipped:
+        report["skipped_flat_by_construction"] = skipped
     if new.get("incomplete"):
         report["incomplete_modes"] = sorted(new["incomplete"])
     return report
@@ -1430,6 +1576,7 @@ def main():
     parts = {}
     if os.environ.get("BENCH_PIPELINE", "1") != "0":
         parts.update(_collect("decode"))
+        parts.update(_collect("data-service"))
         parts.update(_collect("fed-cpu"))
         parts.update(_collect("pipeline"))
         # cold vs warm bring-up through the persistent compile cache: two
@@ -1493,7 +1640,13 @@ def main():
             parts["decode"] / 3000.0, 3)
         result["pipeline_decode_per_core_img_s"] = parts["decode_per_core"]
         result["pipeline_decode_scaling"] = parts["decode_scaling"]
+        result["pipeline_decode_scaling_x"] = parts.get("decode_scaling_x")
         result["pipeline_ncores"] = parts["ncores"]
+        if "decode_scaling_note" in parts:
+            result["decode_scaling_note"] = parts["decode_scaling_note"]
+    for k in sorted(parts):
+        if k.startswith("data_service_"):
+            result[k] = parts[k]
     for k in ("fed_cpu", "fed_cpu_decode", "fed_cpu_step",
               "fed_cpu_ceiling", "fed_cpu_overlap",
               "pipeline_steps_s_depth0", "pipeline_steps_s_depth2",
@@ -1567,7 +1720,25 @@ def main():
         sys.stderr.write("ROOFLINE VIOLATION (>100%% MFU — measurement "
                          "invalid): %s\n" % "; ".join(violations))
 
+    # self-enforcing regression gate (ROADMAP item 5, final step): a full
+    # run compares itself against the newest usable BENCH_*.json on disk
+    # and FAILS THE PROCESS on >10% drops or vanished keys, so the
+    # driver/CI rc blocks regressions instead of accumulating them.
+    # BENCH_GATE=0 opts out; partial runs (BENCH_PIPELINE/BENCH_SWEEP
+    # off) never self-gate — they are missing keys by design.
+    gate_report = None
+    full_run = (os.environ.get("BENCH_PIPELINE", "1") != "0"
+                and os.environ.get("BENCH_SWEEP", "1") != "0")
+    if os.environ.get("BENCH_GATE", "1") != "0" and full_run:
+        gate_report = gate(result)
+        result["gate"] = gate_report
+        if not gate_report.get("pass", True):
+            sys.stderr.write("BENCH GATE FAILED: %s\n"
+                             % json.dumps(gate_report))
+
     print(json.dumps(result))
+    if gate_report is not None and not gate_report.get("pass", True):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

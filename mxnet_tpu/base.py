@@ -224,3 +224,11 @@ ENV_TEST_PLATFORM = register_env(
     "MXTPU_TEST_PLATFORM", default="cpu", scope="test",
     doc="Test-suite platform: cpu = 8-device virtual mesh, tpu = real "
         "chip (read by tests/conftest.py and bench tooling)")
+# Registered here (not in data_service/) because it is read across
+# modules: image.py routes ImageRecordIter through the data service when
+# it is set, and data_service.service sizes the worker fleet from it.
+ENV_DATA_WORKERS = register_env(
+    "MXTPU_DATA_WORKERS", default=0,
+    doc="N>0 routes ImageRecordIter through the multi-process "
+        "shared-memory data service with N decode worker processes "
+        "(same as data_service=True; docs/how_to/performance.md)")

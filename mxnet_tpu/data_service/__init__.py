@@ -1,0 +1,62 @@
+"""mxdata — the multi-process, shared-memory input-data service.
+
+The reference fed trainers from ONE C++ parser inside the trainer
+process (src/io/iter_image_recordio_2.cc); this package takes input
+processing out of the trainer process entirely (the tf.data-service /
+DALI lineage): a sharded recordio reader splits the ``.rec``/``.idx``
+across N decode worker PROCESSES, each decoding straight into a
+shared-memory ring, and a collector in the trainer process hands
+zero-copy numpy views to the device-staging path.
+
+Layering (worker processes must never import jax — see
+``_worker_main.py``):
+
+- :mod:`.common` — seeds, epoch order, shard assignment, ring layout
+  (stdlib+numpy; shared between both processes).
+- :mod:`.ring` — the single-producer/single-consumer shared-memory
+  batch ring (stdlib+numpy).
+- :mod:`._worker_main` — the worker entrypoint script (loads the
+  jax-free package leaves by path).
+- :mod:`.service` — ``DataService`` (coordinator: spawn, collect,
+  heartbeat-monitor, respawn, stats) and ``DataServiceIter`` (the
+  ``DataIter`` facade).  Imported lazily so the jax-free modules stay
+  loadable without the package.
+
+Use it through ``mx.io``-style iterators:
+``ImageRecordIter(..., data_service=True)`` (or ``MXTPU_DATA_WORKERS=N``)
+routes transparently; see docs/how_to/performance.md ("Scaling the
+input pipeline").
+"""
+from __future__ import annotations
+
+from ..base import register_env
+from .common import chunk_seed  # noqa: F401 — shared with image.py
+
+__all__ = ["DataService", "DataServiceIter", "chunk_seed"]
+
+# Registered here (the package root, imported eagerly via image.py's
+# chunk_seed import) rather than in service.py, which loads lazily —
+# the env registry must know every knob before anything reads it.
+# MXTPU_DATA_WORKERS lives in base.py (read across modules).
+ENV_DATA_RING_SLOTS = register_env(
+    "MXTPU_DATA_RING_SLOTS", default=4,
+    doc="Shared-memory ring slots per data-service worker (one slot = "
+        "one padded batch)")
+ENV_DATA_SLOT_BYTES = register_env(
+    "MXTPU_DATA_SLOT_BYTES", default=0,
+    doc="Override (grow) the per-slot data-region bytes; 0 derives "
+        "batch_size x prod(data_shape) x itemsize")
+ENV_DATA_HEARTBEAT = register_env(
+    "MXTPU_DATA_HEARTBEAT_S", default=30.0,
+    doc="Seconds without a data-service worker heartbeat before the "
+        "collector declares it hung and respawns it")
+
+
+def __getattr__(name):
+    # service.py pulls in io/resilience (trainer-process modules); keep
+    # it lazy so importing the package for `common` stays cheap and
+    # cycle-free during mxnet_tpu's own import
+    if name in ("DataService", "DataServiceIter"):
+        from . import service
+        return getattr(service, name)
+    raise AttributeError(name)

@@ -1,0 +1,231 @@
+"""Shared, dependency-free pieces of the data service.
+
+This module is imported BOTH by the trainer process (via the package)
+and by decode worker processes (loaded by file path under a synthetic
+package — see ``_worker_main.py``), so it must stay stdlib+numpy only:
+no jax, no package-relative imports.
+
+It owns the three contracts the service's determinism rests on:
+
+- :func:`chunk_seed` — the per-(seed, chunk, epoch) augmentation-seed
+  mix shared with ``image.py``'s in-process pipelines AND the native
+  C++ decoder (imagedec.cc ``MixSeed`` consumes its output), so a
+  sample's augmentation is a pure function of (user seed, global batch
+  index, epoch) no matter which process/thread decodes it.
+- :func:`epoch_order` — the per-epoch record permutation, replicating
+  ``ImageIter``'s semantics exactly (partition slice first, then a
+  stateful ``random.Random(seed)`` shuffled once per epoch), so a
+  seeded service delivers the same record stream as the in-process
+  pipe, and the same stream for ANY worker count.
+- the shard assignment: global batch ``i`` (records
+  ``order[i*B:(i+1)*B]``) belongs to worker ``i % num_workers``, and the
+  collector delivers batches in global order — the ordering contract
+  ``workers=1`` vs ``workers=N`` bit-identity tests pin.
+
+Plus the shared-memory ring layout constants ``ring.py`` and the worker
+agree on.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+#: the reference's default ImageNet channel normalization (image.py's
+#: ``mean=True`` / ``std=True``) — ONE definition shared by the
+#: in-process augmenters, the native-pipe setup and the data-service
+#: worker config, so the bit-identity contract cannot drift
+IMAGENET_MEAN = (123.68, 116.28, 103.53)
+IMAGENET_STD = (58.395, 57.12, 57.375)
+
+__all__ = [
+    "chunk_seed", "epoch_order", "worker_batches", "num_batches",
+    "IMAGENET_MEAN", "IMAGENET_STD", "np_dtype", "open_native_pipe",
+    "CTRL_WORDS", "CTRL_HEAD", "CTRL_TAIL", "CTRL_HB_MS", "CTRL_ACK_EPOCH",
+    "CTRL_STALL_MS", "CTRL_ABORT_EPOCH", "CTRL_STOP", "CTRL_BATCHES",
+    "SLOT_HDR_WORDS", "HDR_SEQ", "HDR_BATCH_IDX", "HDR_NVALID", "HDR_EPOCH",
+    "align64", "slot_layout",
+]
+
+
+def chunk_seed(seed, chunk_idx, epoch=0):
+    """Deterministic per-chunk seed (splitmix64-style mix keeps successive
+    chunks decorrelated even for seed=0).  epoch and chunk mix through
+    separate 64-bit odd multipliers — no bit-packing, so no field-width
+    aliasing at any dataset size or epoch count.  (Shared with image.py's
+    in-process pipelines; the native decoder mixes the result further
+    per image, imagedec.cc:MixSeed.)"""
+    m = (1 << 64) - 1
+    x = (int(seed) * 0x9e3779b97f4a7c15
+         + int(chunk_idx) * 0xbf58476d1ce4e5b9
+         + int(epoch) * 0x2545f4914f6cdd1d) & m
+    x ^= x >> 30
+    x = (x * 0x94d049bb133111eb) & m
+    x ^= x >> 31
+    return x % (2 ** 31)
+
+
+def epoch_order(keys, seed, epoch, shuffle, part_index=0, num_parts=1):
+    """The record-key order for ``epoch`` (1-based), replicating
+    ``ImageIter`` exactly: the partition slice is taken once, then a
+    stateful ``random.Random(seed)`` shuffles the slice once per epoch
+    (epoch 1 = one shuffle), orders accumulating across epochs.
+
+    O(epoch * len) — callers that advance one epoch at a time should use
+    :class:`EpochOrder` instead and only pay the replay on a cold start
+    (worker respawn mid-run).
+    """
+    o = EpochOrder(keys, seed, shuffle, part_index, num_parts)
+    for _ in range(max(1, int(epoch))):
+        o.advance()
+    return o.order
+
+
+class EpochOrder(object):
+    """Stateful epoch-order generator: ``advance()`` moves to the next
+    epoch's order (epoch 1 after the first call).  ``seek(epoch)``
+    replays from scratch — respawned workers use it to land mid-run."""
+
+    def __init__(self, keys, seed, shuffle, part_index=0, num_parts=1):
+        keys = list(keys)
+        if num_parts > 1:
+            chunk = len(keys) // num_parts
+            keys = keys[part_index * chunk:(part_index + 1) * chunk]
+        self._keys = keys
+        self._seed = int(seed)
+        self._shuffle = bool(shuffle)
+        self._rng = _pyrandom.Random(self._seed)
+        self.order = list(keys)
+        self.epoch = 0
+
+    def advance(self):
+        if self._shuffle:
+            self._rng.shuffle(self.order)
+        self.epoch += 1
+        return self.order
+
+    def seek(self, epoch):
+        """Jump to ``epoch`` (1-based), replaying shuffles from scratch
+        if the target is not simply the next epoch."""
+        epoch = int(epoch)
+        if epoch < self.epoch + 1:
+            self._rng = _pyrandom.Random(self._seed)
+            self.order = list(self._keys)
+            self.epoch = 0
+        while self.epoch < epoch:
+            self.advance()
+        return self.order
+
+
+def num_batches(n_records, batch_size):
+    """Batches per epoch: every record is delivered; the final partial
+    batch is padded (matching the in-process native pipe)."""
+    return (int(n_records) + int(batch_size) - 1) // int(batch_size)
+
+
+def worker_batches(order, batch_size, rank, num_workers):
+    """This worker's shard for one epoch: ``[(global_batch_idx,
+    [keys...]), ...]`` — batch ``i`` holds records
+    ``order[i*B:(i+1)*B]`` and belongs to worker ``i % num_workers``,
+    so the union over ranks is exactly the epoch's record stream in
+    order, for any worker count."""
+    out = []
+    for i in range(rank, num_batches(len(order), batch_size), num_workers):
+        out.append((i, order[i * batch_size:(i + 1) * batch_size]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared-memory ring layout (one segment per worker).
+#
+#   [ctrl: CTRL_WORDS x int64]
+#   [slot 0: hdr(SLOT_HDR_WORDS x int64) | label bytes | data bytes]
+#   [slot 1: ...] ...
+#
+# Single-producer (the worker) / single-consumer (the collector thread):
+# the producer writes HEAD, slot headers and payloads; the consumer
+# writes TAIL, ABORT_EPOCH and STOP; both sides only ever read the
+# other's words.  Payload publication is seqlock-style: the slot header
+# SEQ goes odd (2*batch_idx+1) before the payload is written and even
+# (2*batch_idx+2) after, and HEAD is bumped last — the consumer accepts
+# a slot only when HEAD covers it AND SEQ equals the even value for the
+# exact global batch it expects, so a torn write (worker SIGKILLed
+# mid-slot) can never be consumed as data.
+# ---------------------------------------------------------------------------
+
+CTRL_WORDS = 8
+CTRL_HEAD = 0         # batches produced (producer-owned)
+CTRL_TAIL = 1         # batches released (consumer-owned)
+CTRL_HB_MS = 2        # producer heartbeat, int milliseconds (monotonic-ish)
+CTRL_ACK_EPOCH = 3    # last epoch the producer finished/abandoned
+CTRL_STALL_MS = 4     # accumulated producer ring-full wait (stats)
+CTRL_ABORT_EPOCH = 5  # consumer: abandon this epoch (reset mid-epoch)
+CTRL_STOP = 6         # consumer: shut down
+CTRL_BATCHES = 7      # total batches produced across epochs (stats)
+
+SLOT_HDR_WORDS = 8
+HDR_SEQ = 0
+HDR_BATCH_IDX = 1
+HDR_NVALID = 2
+HDR_EPOCH = 3
+
+
+def np_dtype(name):
+    """The numpy dtype for a service dtype name — shared by the
+    coordinator (slot sizing, consumer views) and the worker (decode
+    target), so the two sides can never disagree on ring layout."""
+    import numpy as _np
+    if name == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+def open_native_pipe(lib, out_h, out_w, resize, rand_crop, rand_mirror,
+                     dtype_code, layout_code, mean, std, fast_dct,
+                     nthreads):
+    """Construct a native image pipe (``MXTPUImgPipeCreate``) — the ONE
+    place the ctypes argument marshaling lives, shared by the
+    in-process ``_NativePipeline`` (image.py) and the data-service
+    worker, so the two decode paths cannot drift apart and break the
+    bit-identity contract.  ``mean``/``std`` are 1- or 3-value float
+    sequences or None (resolve ``True`` to the IMAGENET_* defaults
+    before calling).  Returns ``(pipe_handle_or_None, keepalive)`` —
+    hold ``keepalive`` for the pipe's lifetime (the C side keeps
+    pointers into it)."""
+    import ctypes
+    import numpy as _np
+    fp = ctypes.POINTER(ctypes.c_float)
+
+    def _c3(v):
+        if v is None:
+            return None
+        a = _np.asarray(v, dtype=_np.float32).reshape(-1)
+        if a.size == 1:
+            a = _np.repeat(a, 3)
+        return (ctypes.c_float * 3)(*a[:3])
+
+    mean_c, std_c = _c3(mean), _c3(std)
+    pipe = lib.MXTPUImgPipeCreate(
+        int(nthreads), int(out_h), int(out_w), int(resize or 0),
+        1 if rand_crop else 0, 1 if rand_mirror else 0,
+        int(dtype_code), int(layout_code),
+        ctypes.cast(mean_c, fp) if mean_c else None,
+        ctypes.cast(std_c, fp) if std_c else None,
+        1 if fast_dct else 0)
+    return pipe, (mean_c, std_c)
+
+
+def align64(n):
+    return (int(n) + 63) & ~63
+
+
+def slot_layout(batch_size, data_shape, label_width, itemsize,
+                slot_bytes=None):
+    """Byte layout of one ring slot: ``(label_bytes, data_bytes,
+    slot_stride)``.  ``slot_bytes`` (MXTPU_DATA_SLOT_BYTES) can only
+    GROW the data region — a padded batch must always fit."""
+    import numpy as _np
+    need = int(batch_size) * int(_np.prod(data_shape)) * int(itemsize)
+    data_bytes = align64(max(need, int(slot_bytes or 0)))
+    label_bytes = align64(int(batch_size) * int(label_width) * 4)
+    stride = SLOT_HDR_WORDS * 8 + label_bytes + data_bytes
+    return label_bytes, data_bytes, stride

@@ -1,0 +1,567 @@
+"""The trainer-process side of the data service.
+
+``DataService`` owns N decode worker PROCESSES (``_worker_main.py`` —
+each with its own recordio handle, its own native decode pipe and its
+own shared-memory ring; no shared GIL, no shared pipe lock) and a
+collector that delivers batches in GLOBAL order: batch ``i`` comes from
+worker ``i % N``'s ring as a zero-copy numpy view.  The delivered
+stream is a pure function of (seed, epoch): the same records, the same
+augmentation, the same bytes for ANY worker count — see
+``common.epoch_order`` / ``common.worker_batches`` for the contract.
+
+Robustness is part of the design, not a bolt-on:
+
+- workers heartbeat through their ring control words; a dead worker
+  (crash, SIGKILL) is detected by ``Popen.poll`` immediately, a HUNG
+  worker by heartbeat age (``MXTPU_DATA_HEARTBEAT_S``),
+- either way the worker is respawned and its shard resumes at the last
+  CONSUMED record (production is deterministic, so re-decoded batches
+  are bit-identical — no duplicated or dropped records), with the
+  ``data_worker``/``hang_data_worker`` fault points stripped from the
+  child environment so an injected fault fires once per drill, not on
+  every respawn,
+- a worker that keeps dying exhausts its respawn budget and surfaces
+  as an ``MXNetError`` carrying its stderr tail.
+
+Per-stage counters (ring occupancy, producer/consumer stall, batches
+and respawns per worker) are exposed via :meth:`DataService.stats` and
+the ``bench.py data_service`` mode.
+
+Slot lifetime contract: with ``copy=False`` the arrays a delivered
+batch holds ALIAS the ring slot; the slot is recycled when the batch's
+``release()`` is called, or automatically when the NEXT batch is
+pulled — so zero-copy views are for STRICTLY SERIAL consumers that
+finish with batch N before pulling N+1 (the decode bench, a plain
+training loop).  Anything that runs ahead of its consumer must
+snapshot before the next pull: ``dataflow.DevicePrefetchIter`` does
+exactly that (copies on its background thread, then releases), and
+:class:`DataServiceIter`'s default ``copy=True`` hands out private
+arrays.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import weakref
+
+import numpy as np
+
+from ..base import ENV_DATA_WORKERS, MXNetError, get_env  # noqa: F401 — re-exported knob
+from ..io import DataBatch, DataDesc, DataIter
+from ..resilience import strip_faults_env
+from . import ENV_DATA_HEARTBEAT, ENV_DATA_RING_SLOTS, ENV_DATA_SLOT_BYTES
+from . import common as C
+from .ring import Ring
+
+__all__ = ["DataService", "DataServiceIter"]
+
+_LOG = logging.getLogger(__name__)
+
+_WORKER_MAIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_worker_main.py")
+
+#: CONSECUTIVE respawns (no batch delivered in between) per worker
+#: before the service gives up — a worker that dies on every attempt is
+#: a bug or a broken dataset, not a flaky host.  The streak resets the
+#: moment a respawned worker delivers a consumed batch, so transient
+#: deaths spread over a long run never accumulate into an abort
+#: (wk.respawns stays a lifetime counter for stats())
+MAX_RESPAWNS = 5
+
+#: fault points stripped from a respawned worker's environment (the
+#: supervise.py relaunch discipline: the injected fault must not
+#: re-fire forever)
+_WORKER_FAULT_POINTS = ("data_worker", "hang_data_worker")
+
+_live_services = None
+
+
+def _register_service(svc):
+    global _live_services
+    if _live_services is None:
+        _live_services = weakref.WeakSet()
+
+        def _stop_all():
+            for s in list(_live_services):
+                s.close()
+        atexit.register(_stop_all)
+    _live_services.add(svc)
+
+
+_DTYPE_CODES = {"uint8": 0, "float32": 1, "bfloat16": 2}
+
+
+class _Worker(object):
+    def __init__(self, rank):
+        self.rank = rank
+        self.proc = None
+        self.ring = None
+        self.consumed = 0      # shard batches consumed this epoch
+        self.respawns = 0        # lifetime (stats)
+        self.respawn_streak = 0  # consecutive, reset on delivery (budget)
+        self.stderr_path = None
+        self.consumer_stall_s = 0.0
+        self.occupancy_sum = 0
+        self.occupancy_n = 0
+
+    def stderr_tail(self, nbytes=2000):
+        if self.stderr_path is None:
+            return ""
+        try:
+            with open(self.stderr_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+
+class DataService(object):
+    """See the module docstring.  ``aug`` takes the native pipeline's
+    knob subset (resize, rand_crop, rand_mirror, mean, std)."""
+
+    def __init__(self, path_imgrec, path_imgidx, data_shape, batch_size,
+                 label_width=1, shuffle=False, seed=0, part_index=0,
+                 num_parts=1, num_workers=None, dtype="float32",
+                 layout="NCHW", aug=None, slots=None, slot_bytes=None,
+                 heartbeat_s=None, fast_dct=True):
+        from .. import recordio
+        if dtype not in _DTYPE_CODES:
+            raise MXNetError("data_service: unsupported dtype %r" % (dtype,))
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError("layout must be NCHW or NHWC")
+        self._rec = os.path.abspath(path_imgrec)
+        self._idx = os.path.abspath(path_imgidx)
+        self._shape = tuple(int(d) for d in data_shape)   # canonical (c,h,w)
+        if len(self._shape) != 3 or self._shape[0] != 3:
+            raise MXNetError("data_shape must be (3, height, width), got %s"
+                             % (self._shape,))
+        c, h, w = self._shape
+        self._ring_shape = (c, h, w) if layout == "NCHW" else (h, w, c)
+        self._bs = int(batch_size)
+        self._lw = int(label_width)
+        self._dtype = dtype
+        self._np_dtype = C.np_dtype(dtype)
+        self._layout = layout
+        self._seed = int(seed)
+        self._shuffle = bool(shuffle)
+        self._aug = dict(aug or {})
+        self._fast_dct = bool(fast_dct)
+        self.num_workers = max(1, int(num_workers or 1))
+        self._slots = max(2, int(slots if slots is not None
+                                 else get_env(ENV_DATA_RING_SLOTS, 4)))
+        self._slot_bytes = int(slot_bytes if slot_bytes is not None
+                               else get_env(ENV_DATA_SLOT_BYTES, 0))
+        self._hb_timeout = float(heartbeat_s if heartbeat_s is not None
+                                 else get_env(ENV_DATA_HEARTBEAT, 30.0))
+        keys = [k for k, _ in recordio.read_index(self._idx)]
+        if not keys:
+            raise MXNetError("data_service: empty index %s" % self._idx)
+        self._part_index = int(part_index)
+        self._num_parts = int(num_parts)
+        self._order = C.EpochOrder(keys, self._seed, self._shuffle,
+                                   self._part_index, self._num_parts)
+        self._order.advance()                 # epoch 1
+        self.epoch = 1
+        self._nbatches = C.num_batches(len(self._order.order), self._bs)
+        self._next_idx = 0                    # next global batch to deliver
+        self._pending = None                  # worker with an unreleased slot
+        self._closed = False
+        self._uid = "%d-%x" % (os.getpid(), id(self) & 0xffffff)
+        self._workers = [_Worker(r) for r in range(self.num_workers)]
+        try:
+            for wk in self._workers:
+                wk.ring = Ring("mxds-%s-r%d" % (self._uid, wk.rank),
+                               self._slots, self._bs, self._ring_shape,
+                               self._lw, self._np_dtype.itemsize,
+                               slot_bytes=self._slot_bytes, create=True)
+                self._spawn(wk)
+                self._command(wk, self.epoch, 0)
+        except BaseException:
+            self.close()
+            raise
+        _register_service(self)
+
+    # -- workers ------------------------------------------------------------
+    def _config(self, rank):
+        return {
+            "rec": self._rec, "idx": self._idx,
+            "shm_name": self._workers[rank].ring.name,
+            "slots": self._slots, "batch_size": self._bs,
+            "data_shape": list(self._shape),
+            "ring_shape": list(self._ring_shape),
+            "label_width": self._lw, "dtype": self._dtype,
+            "dtype_code": _DTYPE_CODES[self._dtype],
+            "layout": self._layout, "aug": _jsonable_aug(self._aug),
+            "fast_dct": self._fast_dct, "seed": self._seed,
+            "shuffle": self._shuffle,
+            "part_index": self._part_index,
+            "num_parts": self._num_parts,
+            "rank": rank, "num_workers": self.num_workers,
+            "slot_bytes": self._slot_bytes,
+            "coordinator_pid": os.getpid(),
+        }
+
+    def _spawn(self, wk, strip_faults=False):
+        if wk.stderr_path is None:
+            fd, wk.stderr_path = tempfile.mkstemp(
+                prefix="mxds-w%d-" % wk.rank, suffix=".err")
+            os.close(fd)
+        env = dict(os.environ)
+        if strip_faults:
+            stripped = strip_faults_env(env.get("MXTPU_FAULTS"),
+                                        _WORKER_FAULT_POINTS)
+            if stripped:
+                env["MXTPU_FAULTS"] = stripped
+            else:
+                env.pop("MXTPU_FAULTS", None)
+        # the CONSUMER stamps the first heartbeat: a worker that wedges
+        # during bootstrap (before its own first stamp) must still age
+        # out against MXTPU_DATA_HEARTBEAT_S — with hb=0 meaning "no
+        # age" it would never be declared hung
+        wk.ring.heartbeat()
+        stderr_f = open(wk.stderr_path, "ab")
+        try:
+            wk.proc = subprocess.Popen(
+                [sys.executable, _WORKER_MAIN, json.dumps(self._config(
+                    wk.rank))],
+                stdin=subprocess.PIPE, stdout=subprocess.DEVNULL,
+                stderr=stderr_f, env=env)
+        finally:
+            stderr_f.close()
+
+    def _command(self, wk, epoch, skip):
+        try:
+            wk.proc.stdin.write(("E %d %d\n" % (epoch, skip)).encode())
+            wk.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise MXNetError(
+                "data_service: worker %d rejected a command (%s); stderr: %s"
+                % (wk.rank, e, wk.stderr_tail())) from e
+
+    def _respawn(self, wk, reason):
+        wk.respawns += 1
+        wk.respawn_streak += 1
+        tail = wk.stderr_tail()
+        if wk.respawn_streak > MAX_RESPAWNS:
+            raise MXNetError(
+                "data_service: worker %d exceeded its respawn budget "
+                "(%d consecutive) — last failure: %s; stderr: %s"
+                % (wk.rank, MAX_RESPAWNS, reason, tail))
+        _LOG.warning(
+            "data_service: worker %d %s (respawn %d/%d, resuming shard at "
+            "batch %d)%s", wk.rank, reason, wk.respawn_streak, MAX_RESPAWNS,
+            wk.consumed,
+            ("; stderr tail: %s" % tail.strip()[-300:]) if tail.strip()
+            else "")
+        if wk.proc is not None and wk.proc.poll() is None:
+            wk.proc.kill()
+            try:
+                wk.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        wk.ring.reset_counters()
+        self._spawn(wk, strip_faults=True)
+        self._command(wk, self.epoch, wk.consumed)
+
+    # -- collector ----------------------------------------------------------
+    def next_batch(self):
+        """``(data_view, labels, pad, release)`` for the next global
+        batch, in order; raises StopIteration at epoch end.  ``labels``
+        is a fresh (tiny) copy; ``data_view`` aliases the ring slot —
+        see the module docstring for the lifetime contract."""
+        if self._closed:
+            raise MXNetError("data_service: closed")
+        self._release_pending()
+        if self._next_idx >= self._nbatches:
+            raise StopIteration
+        i = self._next_idx
+        wk = self._workers[i % self.num_workers]
+        deadline_poll = 0.0
+        t0 = time.monotonic()
+        waited = False
+        while not wk.ring.ready(i, self.epoch):
+            waited = True
+            now = time.monotonic()
+            if now >= deadline_poll:
+                deadline_poll = now + 0.2
+                if wk.proc.poll() is not None:
+                    self._respawn(wk, "died (rc=%s)" % wk.proc.returncode)
+                elif wk.ring.published_mismatch(i, self.epoch):
+                    # a published slot with the wrong batch/epoch can
+                    # only come from a straggler that missed an abort
+                    # (e.g. thawed after the reset handshake timed out)
+                    self._respawn(wk, "produced a stale slot")
+                elif wk.ring.heartbeat_age_s() > self._hb_timeout:
+                    self._respawn(
+                        wk, "hung (no heartbeat for %.1fs)"
+                        % wk.ring.heartbeat_age_s())
+            time.sleep(0.0005)
+        if waited:
+            wk.consumer_stall_s += time.monotonic() - t0
+        wk.occupancy_sum += wk.ring.occupancy()
+        wk.occupancy_n += 1
+        hdr, labv, datav = wk.ring.peek(self._np_dtype)
+        nvalid = int(hdr[C.HDR_NVALID])
+        labels = np.array(labv[:, 0] if self._lw == 1 else labv)
+        self._next_idx += 1
+        wk.consumed += 1
+        wk.respawn_streak = 0   # delivered: not a crash loop
+        released = [False]
+
+        def release(_wk=wk, _released=released):
+            if not _released[0]:
+                _released[0] = True
+                _wk.ring.release()
+        self._pending = release
+        return datav, labels, self._bs - nvalid, release
+
+    def _release_pending(self):
+        if self._pending is not None:
+            self._pending()
+            self._pending = None
+
+    def at_epoch_end(self):
+        return self._next_idx >= self._nbatches
+
+    def reset(self):
+        """Advance to the next epoch (abandoning the current one if it
+        was not fully consumed), like ``DataIter.reset``."""
+        if self._closed:
+            raise MXNetError("data_service: closed")
+        self._release_pending()
+        mid_epoch = self._next_idx < self._nbatches
+        for wk in self._workers:
+            if mid_epoch:
+                wk.ring.request_abort(self.epoch)
+            # wait for the producer to leave the epoch loop before the
+            # ring counters are reset under it
+            deadline = time.monotonic() + max(5.0, self._hb_timeout)
+            while (wk.proc.poll() is None
+                    and wk.ring.acked_epoch() < self.epoch):
+                if time.monotonic() > deadline:
+                    # unresponsive to the abort (frozen/SIGSTOPped): it
+                    # must NOT thaw later and write the old epoch into
+                    # the reset ring — kill it and respawn below
+                    wk.proc.kill()
+                    try:
+                        wk.proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    break
+                time.sleep(0.001)
+            wk.ring.reset_counters()
+            if wk.proc.poll() is not None:
+                # dead between epochs (or killed above): bring it back
+                wk.respawns += 1
+                wk.respawn_streak += 1
+                if wk.respawn_streak > MAX_RESPAWNS:
+                    raise MXNetError(
+                        "data_service: worker %d exceeded its respawn "
+                        "budget (%d consecutive); stderr: %s"
+                        % (wk.rank, MAX_RESPAWNS, wk.stderr_tail()))
+                self._spawn(wk, strip_faults=True)
+            else:
+                # alive and idle until the next epoch command: stamp
+                # the heartbeat so a worker that wedges between epochs
+                # still ages out (reset_counters zeroed the stamp)
+                wk.ring.heartbeat()
+            wk.consumed = 0
+        self.epoch += 1
+        self._order.advance()
+        self._next_idx = 0
+        for wk in self._workers:
+            self._command(wk, self.epoch, 0)
+
+    # -- observability ------------------------------------------------------
+    def stats(self):
+        """Per-stage counters since construction.  After close() the
+        final pre-teardown snapshot is returned (monitoring hooks poll
+        stats at shutdown)."""
+        if self._closed:
+            return self._final_stats
+        per = {}
+        prod_stall = cons_stall = occ_sum = occ_n = batches = 0.0
+        for wk in self._workers:
+            ring = wk.ring
+            per[wk.rank] = {
+                "batches": ring.batches_produced(),
+                "respawns": wk.respawns,
+                "producer_stall_s": round(ring.producer_stall_s(), 3),
+                "consumer_stall_s": round(wk.consumer_stall_s, 3),
+                "ring_occupancy": round(
+                    wk.occupancy_sum / max(1, wk.occupancy_n), 2),
+                "alive": wk.proc is not None and wk.proc.poll() is None,
+            }
+            prod_stall += ring.producer_stall_s()
+            cons_stall += wk.consumer_stall_s
+            occ_sum += wk.occupancy_sum
+            occ_n += wk.occupancy_n
+            batches += ring.batches_produced()
+        return {
+            "num_workers": self.num_workers,
+            "epoch": self.epoch,
+            "batches_produced": int(batches),
+            "producer_stall_s": round(prod_stall, 3),
+            "consumer_stall_s": round(cons_stall, 3),
+            "ring_occupancy": round(occ_sum / max(1, occ_n), 2),
+            "ring_slots": self._slots,
+            "workers": per,
+        }
+
+    def worker_pids(self):
+        """Live worker pids (chaos drills kill these)."""
+        return [wk.proc.pid for wk in self._workers
+                if wk.proc is not None and wk.proc.poll() is None]
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        if self._closed:
+            return
+        try:
+            self._final_stats = self.stats()
+        except Exception:  # noqa: BLE001 — mid-construction close
+            self._final_stats = None
+        self._closed = True
+        self._pending = None
+        for wk in getattr(self, "_workers", []):
+            if wk.ring is not None:
+                try:
+                    wk.ring.request_stop()
+                except TypeError:  # ring already torn down
+                    pass
+            if wk.proc is not None:
+                try:
+                    wk.proc.stdin.write(b"Q\n")
+                    wk.proc.stdin.flush()
+                except (BrokenPipeError, OSError, ValueError):
+                    pass
+                try:
+                    wk.proc.stdin.close()
+                except (OSError, ValueError):
+                    pass
+        for wk in getattr(self, "_workers", []):
+            if wk.proc is not None:
+                try:
+                    wk.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    wk.proc.kill()
+                    try:
+                        wk.proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
+            if wk.ring is not None:
+                wk.ring.close()
+                wk.ring = None
+            if wk.stderr_path is not None:
+                try:
+                    os.remove(wk.stderr_path)
+                except OSError:
+                    pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def _jsonable_aug(aug):
+    out = {}
+    for k, v in aug.items():
+        if isinstance(v, np.ndarray):
+            v = [float(x) for x in v.reshape(-1)]
+        elif v is True and k in ("mean", "std"):
+            v = list(C.IMAGENET_MEAN if k == "mean" else C.IMAGENET_STD)
+        out[k] = v
+    return out
+
+
+class DataServiceIter(DataIter):
+    """`DataIter` facade over :class:`DataService`: host numpy batches
+    (the ``host_batches`` analog of the in-process native pipe).
+
+    ``copy=True`` (the safe default) hands each consumer a private
+    array.  ``copy=False`` hands the ring-slot VIEW itself — fastest,
+    but only for strictly serial consumers: the array is valid until
+    ``batch.release()`` or the next pull, and anything "uploading" it
+    must truly copy (on the CPU backend ``jax.device_put`` ALIASES
+    numpy memory; use ``jnp.array(view, copy=True)``).
+    ``ImageRecordIter``'s ``host_batches`` service mode and the decode
+    bench use ``copy=False``; wrapping either flavor in
+    ``dataflow.DevicePrefetchIter(stage=trainer)`` is safe — the
+    prefetcher snapshots slot-backed batches on its background thread
+    and releases the slot before running ahead."""
+
+    def __init__(self, service=None, data_name="data",
+                 label_name="softmax_label", copy=True, **kwargs):
+        self._service = service if service is not None \
+            else DataService(**kwargs)
+        super().__init__(self._service._bs)
+        self._copy = bool(copy)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        svc = self._service
+        dt = np.dtype("float32" if svc._dtype == "bfloat16" else svc._dtype)
+        return [DataDesc(self.data_name, (svc._bs,) + svc._ring_shape,
+                         dtype=dt)]
+
+    @property
+    def provide_label(self):
+        svc = self._service
+        shape = (svc._bs, svc._lw) if svc._lw > 1 else (svc._bs,)
+        return [DataDesc(self.label_name, shape)]
+
+    def next(self):
+        data, labels, pad, release = self._service.next_batch()
+        batch = DataBatch([data], [labels], pad=pad,
+                          provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        if self._copy:
+            # already private: copy now, recycle the slot, and do NOT
+            # attach the instance-level release — its presence is the
+            # "transport-owned buffers" signal DevicePrefetchIter keys
+            # its snapshot on, which would re-copy every batch
+            batch.data = [np.array(data)]
+            release()
+        else:
+            batch.release = release
+        self.current_batch = batch
+        return batch
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def reset(self):
+        self._service.reset()
+
+    def stats(self):
+        return self._service.stats()
+
+    def close(self):
+        self.current_batch = None   # drop the last zero-copy view
+        self._service.close()

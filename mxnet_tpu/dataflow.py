@@ -27,7 +27,7 @@ import queue
 import threading
 
 from .base import MXNetError
-from .io import DataIter, StagedBatch
+from .io import DataBatch, DataIter, StagedBatch
 
 __all__ = ["DevicePrefetchIter"]
 
@@ -149,6 +149,25 @@ class DevicePrefetchIter(DataIter):
         from .resilience import faults
         faults.maybe_hang("hang_stage")
         faults.maybe_fail("stage_batch")
+        # Transport-owned buffers (shared-memory data-service ring
+        # slots override release() per instance): this worker runs
+        # AHEAD of the consumer, so by the time a queued batch is
+        # consumed its slot views may have been recycled — and a CPU
+        # backend device_put can ALIAS numpy memory rather than copy
+        # it, so even the staged arrays aren't safe.  Snapshot on this
+        # background thread (off the step's critical path) and hand the
+        # slot back to the producer immediately.
+        release = batch.__dict__.get("release")
+        if release is not None:
+            import numpy as _np
+            batch = DataBatch(
+                [_np.array(d) for d in batch.data],
+                [_np.array(l) for l in batch.label]
+                if batch.label is not None else None,
+                pad=batch.pad, index=batch.index,
+                provide_data=batch.provide_data,
+                provide_label=batch.provide_label)
+            release()
         if self._stage is None:
             return batch
         arrays = list(batch.data) + list(batch.label or [])

@@ -25,7 +25,7 @@ import numpy as np
 from . import io as mxio
 from . import ndarray as nd
 from . import recordio
-from .base import MXNetError, get_env, register_env
+from .base import ENV_DATA_WORKERS, MXNetError, get_env, register_env
 
 ENV_UPLOAD_THREADS = register_env(
     "MXNET_UPLOAD_THREADS", default=4,
@@ -76,19 +76,13 @@ def _seed_aug_rng(seed_val):
     _AUG_RNG.np = np.random.RandomState(int(seed_val) % (2 ** 31))
 
 
-def _chunk_seed(seed, chunk_idx, epoch=0):
-    """Deterministic per-chunk seed (splitmix64-style mix keeps successive
-    chunks decorrelated even for seed=0).  epoch and chunk mix through
-    separate 64-bit odd multipliers — no bit-packing, so no field-width
-    aliasing at any dataset size or epoch count."""
-    m = (1 << 64) - 1
-    x = (int(seed) * 0x9e3779b97f4a7c15
-         + int(chunk_idx) * 0xbf58476d1ce4e5b9
-         + int(epoch) * 0x2545f4914f6cdd1d) & m
-    x ^= x >> 30
-    x = (x * 0x94d049bb133111eb) & m
-    x ^= x >> 31
-    return x % (2 ** 31)
+# Deterministic per-(seed, chunk, epoch) augmentation seed and the
+# default ImageNet normalization constants — ONE implementation shared
+# with the out-of-process data service (its decode workers derive the
+# identical seed for the identical global batch, which is what makes
+# service output bit-identical to the in-process pipe).
+from .data_service import common as _dsc  # noqa: E402
+_chunk_seed = _dsc.chunk_seed
 
 __all__ = [
     "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
@@ -354,9 +348,9 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
 
     if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
+        mean = np.array(_dsc.IMAGENET_MEAN)
     if std is True:
-        std = np.array([58.395, 57.12, 57.375])
+        std = np.array(_dsc.IMAGENET_STD)
     if mean is not None or std is not None:
         auglist.append(ColorNormalizeAug(mean, std))
     return auglist
@@ -695,6 +689,11 @@ class _AsyncPipeline(object):
         """Stop the reader thread BEFORE interpreter/XLA teardown — a
         daemon thread killed mid-XLA-call aborts the process.  No imports
         here: __del__ can run while the interpreter shuts down."""
+        if not hasattr(self, "_queue"):
+            # a subclass __init__ failed before _AsyncPipeline.__init__
+            # ran (it cleans its own resources on that path); there is
+            # no thread/queue to stop and __del__ must not raise
+            return
         self._stopping = True
         try:
             self._cmd.put_nowait("stop")
@@ -948,21 +947,9 @@ class _NativePipeline(_AsyncPipeline):
         mean = aug_kwargs.get("mean")
         std = aug_kwargs.get("std")
         if mean is True:
-            mean = np.array([123.68, 116.28, 103.53])
+            mean = np.array(_dsc.IMAGENET_MEAN)
         if std is True:
-            std = np.array([58.395, 57.12, 57.375])
-        fp = ctypes.POINTER(ctypes.c_float)
-
-        def _c3(v):
-            if v is None:
-                return None
-            a = np.asarray(v, dtype=np.float32).reshape(-1)
-            if a.size == 1:
-                a = np.repeat(a, 3)
-            return (ctypes.c_float * 3)(*a[:3])
-
-        self._mean_c = _c3(mean)   # keep alive for the pipe's lifetime
-        self._std_c = _c3(std)
+            std = np.array(_dsc.IMAGENET_STD)
         # honor the requested thread count (reference preprocess_threads
         # semantics) — C++ decode threads are cheap to park, and tests
         # exercise the pool even on small hosts
@@ -972,14 +959,14 @@ class _NativePipeline(_AsyncPipeline):
         # dwarfs it); MXNET_JPEG_DECODE_FAST=0 restores byte parity with
         # cv2 (the mx.nd.imdecode op is always exact)
         fast_dct = get_env(ENV_JPEG_DECODE_FAST, "1") != "0"
-        self._pipe = lib.MXTPUImgPipeCreate(
-            nthreads, h, w, int(aug_kwargs.get("resize", 0) or 0),
-            1 if aug_kwargs.get("rand_crop") else 0,
-            1 if aug_kwargs.get("rand_mirror") else 0,
-            code, 0 if layout == "NCHW" else 1,
-            ctypes.cast(self._mean_c, fp) if self._mean_c else None,
-            ctypes.cast(self._std_c, fp) if self._std_c else None,
-            1 if fast_dct else 0)
+        # one shared constructor with the data-service worker's decoder
+        # (data_service.common) — the two paths must configure the C++
+        # pipe identically or the bit-identity contract breaks
+        self._pipe, self._pipe_keepalive = _dsc.open_native_pipe(
+            lib, h, w, aug_kwargs.get("resize"),
+            aug_kwargs.get("rand_crop"), aug_kwargs.get("rand_mirror"),
+            code, 0 if layout == "NCHW" else 1, mean, std, fast_dct,
+            nthreads)
         if not self._pipe:
             raise MXNetError("native image pipeline: create failed")
         super(_NativePipeline, self).__init__(it, batch_size, prefetch,
@@ -1172,7 +1159,7 @@ class ImageRecordIter(mxio.DataIter):
                  prefetch_buffer=4, preprocess_threads=4, round_batch=True,
                  data_name="data", label_name="softmax_label", dtype="float32",
                  layout="NCHW", device_transform=None, host_batches=False,
-                 **aug_kwargs):
+                 data_service=None, **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
         from . import random as _random
         self._eff_seed = _random.get_seed() if seed is None else int(seed)
@@ -1189,6 +1176,45 @@ class ImageRecordIter(mxio.DataIter):
             raise MXNetError(
                 "host_batches yields raw numpy batches — a device_transform "
                 "would be silently skipped; pass one or the other")
+        # Multi-process data service (docs/how_to/performance.md "Scaling
+        # the input pipeline"): data_service=True uses preprocess_threads
+        # worker PROCESSES; MXTPU_DATA_WORKERS=N turns it on (and sizes
+        # the fleet) without touching call sites.  data_service=False
+        # forces the in-process pipelines even when the env is set.
+        self._service = None
+        self._service_iter = None
+        self._it = None
+        env_workers = int(get_env(ENV_DATA_WORKERS, 0) or 0)
+        if data_service or (data_service is None and env_workers > 0):
+            # an EXPLICIT data_service=True sizes the fleet from the
+            # call's preprocess_threads; the env sizes only env-routed
+            # iterators (it must not silently override a call site —
+            # the bench's scaling sweep depends on this)
+            workers = max(1, int(preprocess_threads)) if data_service \
+                else env_workers
+            try:
+                self._init_service(
+                    path_imgrec, path_imgidx, data_shape, batch_size,
+                    label_width, shuffle, part_index, num_parts, workers,
+                    dtype, layout, aug_kwargs, has_custom_augs,
+                    device_transform, host_batches, data_name, label_name)
+            except MXNetError:
+                if data_service:   # explicitly requested: surface it
+                    raise
+                logging.warning(
+                    "ImageRecordIter: MXTPU_DATA_WORKERS is set but this "
+                    "configuration cannot route through the data service; "
+                    "using the in-process pipeline", exc_info=True)
+        if self._service is not None:
+            self.batch_size = batch_size
+            self.data_shape = tuple(data_shape)
+            self.label_width = label_width
+            self._dtype = dtype
+            self._host_batches = bool(host_batches)
+            self._device_transform = device_transform
+            self._data_name = data_name
+            self._label_name = label_name
+            return
         self._it = ImageIter(
             batch_size, data_shape, label_width=label_width,
             path_imgrec=path_imgrec, path_imgidx=path_imgidx,
@@ -1260,10 +1286,57 @@ class ImageRecordIter(mxio.DataIter):
             self._read_var = self._engine.new_variable()
             self._start_prefetch()
 
+    def _init_service(self, path_imgrec, path_imgidx, data_shape,
+                      batch_size, label_width, shuffle, part_index,
+                      num_parts, workers, dtype, layout, aug_kwargs,
+                      has_custom_augs, device_transform, host_batches,
+                      data_name, label_name):
+        """Route through data_service.DataService; raises MXNetError for
+        configurations the service cannot express."""
+        from .data_service import DataService, DataServiceIter
+        if path_imgidx is None:
+            raise MXNetError(
+                "data_service needs path_imgidx (sharded readers plan "
+                "from the index)")
+        if has_custom_augs:
+            raise MXNetError(
+                "data_service cannot ship a custom aug_list to worker "
+                "processes")
+        unsupported = set(aug_kwargs) - _NativePipeline.SUPPORTED
+        if unsupported:
+            raise MXNetError(
+                "data_service does not implement augmentations %s"
+                % sorted(unsupported))
+        if not _rec_looks_jpeg(path_imgrec):
+            # worker processes decode through their own native libjpeg
+            # pipes — a PNG/BMP .rec would crash-loop every worker at
+            # runtime; fail eligibility here so env routing falls back
+            # to the cv2 pipelines instead
+            raise MXNetError(
+                "data_service needs a JPEG-payload .rec (the worker "
+                "decode pipes are libjpeg); this file's first record "
+                "is not JPEG")
+        fast_dct = get_env(ENV_JPEG_DECODE_FAST, "1") != "0"
+        self._service = DataService(
+            path_imgrec, path_imgidx, tuple(data_shape), batch_size,
+            label_width=label_width, shuffle=shuffle, seed=self._eff_seed,
+            part_index=part_index, num_parts=num_parts,
+            num_workers=workers, dtype=dtype, layout=layout,
+            aug=aug_kwargs, fast_dct=fast_dct)
+        # copy=False: the host_batches contract (views valid until the
+        # next pull) matches the bench's ephemeral reads, and the device
+        # path makes its own guaranteed copy in _next_service
+        self._service_iter = DataServiceIter(
+            self._service, data_name=data_name, label_name=label_name,
+            copy=False)
+
     @property
     def provide_data(self):
         dt = np.dtype("float32" if self._dtype == "bfloat16"
                       else self._dtype)
+        if self._service is not None:
+            descs = self._service_iter.provide_data
+            return [mxio.DataDesc(d.name, d.shape, dtype=dt) for d in descs]
         descs = []
         for d in self._it.provide_data:
             shape = d.shape
@@ -1275,6 +1348,8 @@ class ImageRecordIter(mxio.DataIter):
 
     @property
     def provide_label(self):
+        if self._service is not None:
+            return self._service_iter.provide_label
         return self._it.provide_label
 
     def _produce_one(self):
@@ -1365,6 +1440,9 @@ class ImageRecordIter(mxio.DataIter):
             self._produce_one()
 
     def reset(self):
+        if self._service is not None:
+            self._service_iter.reset()
+            return
         if self._pipeline is not None:
             self._pipeline.reset()
             return
@@ -1374,7 +1452,32 @@ class ImageRecordIter(mxio.DataIter):
         self._it.reset()
         self._start_prefetch()
 
+    def _next_service(self):
+        """One batch off the service collector.  host_batches hands the
+        zero-copy views through (valid until the next pull — the exact
+        product the C++ parser handed out); the device path uploads with
+        ``copy=True`` (on the CPU backend a plain device_put ALIASES the
+        numpy buffer — releasing the ring slot would corrupt the "device"
+        array) and releases the slot immediately."""
+        batch = self._service_iter.next()
+        if self._host_batches:
+            return batch
+        import jax.numpy as jnp
+        data = nd.NDArray._from_jax(jnp.array(batch.data[0], copy=True))
+        if self._device_transform is not None:
+            data = nd.NDArray._from_jax(self._device_transform(data._data))
+        labels = nd.array(batch.label[0])
+        batch.release()   # device copies made: recycle the ring slot
+        return mxio.DataBatch([data], [labels], pad=batch.pad,
+                              provide_data=self.provide_data,
+                              provide_label=self.provide_label)
+
     def next(self):
+        if self._service is not None:
+            batch = self._next_service()
+            batch.provide_data = self.provide_data
+            batch.provide_label = self.provide_label
+            return batch
         if self._pipeline is not None:
             batch = self._pipeline.next()
             batch.provide_data = self.provide_data
@@ -1393,7 +1496,17 @@ class ImageRecordIter(mxio.DataIter):
 
     __next__ = next
 
+    def stats(self):
+        """Per-stage data-service counters (ring occupancy, stall times,
+        respawns); None for the in-process pipelines."""
+        if self._service is not None:
+            return self._service.stats()
+        return None
+
     def close(self):
+        if self._service is not None:
+            self._service_iter.close()
+            return
         if self._pipeline is not None:
             self._pipeline.shutdown()
             return

@@ -59,6 +59,14 @@ class DataBatch(object):
         self.provide_data = provide_data
         self.provide_label = provide_label
 
+    def release(self):
+        """Hand transport-owned buffers back to the producer.  A no-op
+        for ordinary batches; slot-backed batches (the shared-memory
+        data service) override it PER INSTANCE, and consumers that are
+        done with the arrays — or have copied them, like
+        ``DevicePrefetchIter``'s snapshot — call it to recycle the slot
+        early.  Must be idempotent."""
+
 
 class StagedBatch(DataBatch):
     """A DataBatch whose inputs are ALREADY placed on the mesh.
@@ -120,6 +128,13 @@ class DataIter(object):
 
     def getpad(self):
         return 0
+
+    def close(self):
+        """Release background resources (threads, worker processes,
+        shared memory).  A no-op for plain in-memory iterators; iterators
+        owning a pipeline (``ImageRecordIter``, ``DataServiceIter``,
+        ``DevicePrefetchIter``) override it, so generic consumers can
+        always call ``it.close()`` when done."""
 
 
 def _init_data(data, allow_empty, default_name):

@@ -23,8 +23,25 @@ import numpy as np
 from . import native
 from .base import MXNetError
 
-__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "read_index",
            "pack", "unpack", "pack_img", "unpack_img"]
+
+
+def read_index(idx_path, key_type=int):
+    """Parse a ``.idx`` file into an ordered ``[(key, position), ...]``
+    without opening the ``.rec`` it indexes — sharded readers (the data
+    service coordinator) plan shard assignments from the index alone."""
+    out = []
+    with open(idx_path) as fin:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            fields = line.split("\t")
+            # tolerate trailing extra columns (some external im2rec
+            # variants append a size field) like the historical parser
+            out.append((key_type(fields[0]), int(fields[1])))
+    return out
 
 _MAGIC = 0xced7230a
 _LEN_MASK = (1 << 29) - 1
@@ -236,10 +253,8 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.fidx = open(self.idx_path, self.flag)
         if self.flag == "r":
-            for line in self.fidx.readlines():
-                line = line.strip().split("\t")
-                key = self.key_type(line[0])
-                self.idx[key] = int(line[1])
+            for key, pos in read_index(self.idx_path, self.key_type):
+                self.idx[key] = pos
                 self.keys.append(key)
 
     def close(self):

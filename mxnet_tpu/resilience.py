@@ -59,7 +59,7 @@ __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
            "PreemptionHandler", "preempted_exit",
            "checksum_file", "checksum_bytes", "checkpoint_async",
            "snapshot_params", "submit_checkpoint", "wait_checkpoints",
-           "TransientError", "FaultInjector", "faults",
+           "TransientError", "FaultInjector", "faults", "strip_faults_env",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
            "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
@@ -287,6 +287,20 @@ class FaultInjector(object):
 
 
 faults = FaultInjector()
+
+
+def strip_faults_env(value, points):
+    """Drop the given fault points from an ``MXTPU_FAULTS`` env value
+    (``"point:times[@after],..."``), keeping everything else — the
+    respawn discipline the data service applies to its workers (and
+    chaos-drill wrapper scripts apply around relaunches): an injected
+    fault fires once per drill, never again on the respawned process
+    (or it would crash-loop the respawn budget away)."""
+    points = set(points)
+    keep = [part for part in
+            filter(None, (p.strip() for p in (value or "").split(",")))
+            if part.partition(":")[0] not in points]
+    return ",".join(keep)
 
 
 # ---------------------------------------------------------------------------

@@ -162,3 +162,54 @@ def test_gate_custom_tolerance(tmp_path):
     new = _write(tmp_path / "new.json", dict(BASE, value=800.0))  # -20%
     assert not bench.gate(new, against=old, tolerance=0.10)["pass"]
     assert bench.gate(new, against=old, tolerance=0.25)["pass"]
+
+
+def test_gate_accepts_result_dict_payload(tmp_path):
+    """main()'s self-gate passes its own in-memory result instead of a
+    path; behavior must match the file route."""
+    rep = bench.gate(dict(BASE, value=500.0),
+                     against=_write(tmp_path / "old.json", BASE))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "value"
+    rep = bench.gate(dict(BASE), against=_write(tmp_path / "o2.json", BASE))
+    assert rep["pass"]
+
+
+def test_gate_data_service_keys_are_guarded(tmp_path):
+    base = dict(BASE, data_service_img_s=6000.0,
+                data_service_scaling_x=1.8)
+    new = dict(base, data_service_img_s=4000.0)   # -33%
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_service_img_s"
+
+
+def test_gate_skips_scaling_shape_on_1core_hosts(tmp_path):
+    """A 1-core host's scaling rows are flat BY CONSTRUCTION: the
+    matching note (on either side) exempts the scaling-SHAPE keys, so a
+    1-core CI box can neither mask nor fake a scaling regression — but
+    the absolute-throughput keys still gate."""
+    base = dict(BASE, data_service_img_s=6000.0,
+                data_service_scaling_x=1.8,
+                pipeline_decode_scaling_x=1.7)
+    flat = dict(base, data_service_scaling_x=1.0,
+                pipeline_decode_scaling_x=1.0,
+                data_service_scaling_note="flat_by_construction_1core",
+                decode_scaling_note="flat_by_construction_1core")
+    rep = bench.gate(_write(tmp_path / "new.json", flat),
+                     against=_write(tmp_path / "old.json", base))
+    assert rep["pass"], rep
+    assert set(rep["skipped_flat_by_construction"]) == {
+        "data_service_scaling_x", "pipeline_decode_scaling_x"}
+    # note on the BASELINE side exempts too (flat baseline, multicore new)
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, data_service_scaling_x=0.9)),
+                     against=_write(tmp_path / "o2.json", flat))
+    assert rep["pass"], rep
+    # without the note a scaling-shape collapse IS a regression
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, data_service_scaling_x=1.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_service_scaling_x"

@@ -945,3 +945,106 @@ def test_watchdog_drill_stalled_step_dumps_and_aborts(tmp_path):
     assert "jax backend: cpu" in report    # device/mesh state
     # fired within the timeout, not at the 60s hang's natural end
     assert elapsed < 120
+
+
+# ---------------------------------------------------------------------------
+# data-service worker drills (mxnet_tpu/data_service/): a decode worker
+# is a real OS process — kill it with a real SIGKILL / wedge it with a
+# real injected hang and prove the epoch still delivers every record
+# exactly once, bit-identical to an undisturbed run.
+# ---------------------------------------------------------------------------
+
+def _ds_rec_dataset(tmp_path, n=41):
+    import cv2
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "chaos.rec")
+    idx = str(tmp_path / "chaos.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    rs = np.random.RandomState(0)
+    for i in range(n):
+        img = rs.randint(0, 255, (48, 48, 3)).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        w.write_idx(i, recordio.pack(
+            mx.recordio.IRHeader(0, float(i % 7), i, 0), buf.tobytes()))
+    w.close()
+    return path, idx
+
+
+def _ds_iter(path, idx, workers, **over):
+    kw = dict(path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+              batch_size=8, shuffle=True, rand_crop=True, rand_mirror=True,
+              seed=5, dtype="float32", host_batches=True,
+              data_service=True, preprocess_threads=workers)
+    kw.update(over)
+    return mx.io.ImageRecordIter(**kw)
+
+
+def _ds_stream(it):
+    return [(np.array(b.data[0]).copy(), np.array(b.label[0]).copy(),
+             b.pad) for b in it]
+
+
+@pytest.mark.chaos
+def test_data_service_drill_sigkill_worker_mid_epoch(tmp_path):
+    """SIGKILL one decode worker after the first delivered batch: the
+    service respawns it, the epoch completes with no duplicated or
+    dropped records, and the delivered batch stream is bit-identical to
+    an uninterrupted seeded run — including the NEXT epoch."""
+    path, idx = _ds_rec_dataset(tmp_path)
+    it = _ds_iter(path, idx, workers=2)
+    ref_e1 = _ds_stream(it)
+    it.reset()
+    ref_e2 = _ds_stream(it)
+    it.close()
+
+    it = _ds_iter(path, idx, workers=2)
+    got = []
+    for n, b in enumerate(it):
+        got.append((np.array(b.data[0]).copy(),
+                    np.array(b.label[0]).copy(), b.pad))
+        if n == 0:
+            victims = it._service.worker_pids()
+            assert len(victims) == 2
+            os.kill(victims[0], signal.SIGKILL)
+    st = it.stats()
+    assert sum(w["respawns"] for w in st["workers"].values()) == 1, st
+    it.reset()
+    got_e2 = _ds_stream(it)
+    it.close()
+
+    assert len(got) == len(ref_e1)
+    for i, (a, b) in enumerate(zip(ref_e1, got)):
+        assert a[2] == b[2], ("pad", i)
+        np.testing.assert_array_equal(a[1], b[1], err_msg="labels %d" % i)
+        np.testing.assert_array_equal(a[0], b[0], err_msg="data %d" % i)
+    for i, (a, b) in enumerate(zip(ref_e2, got_e2)):
+        np.testing.assert_array_equal(a[0], b[0],
+                                      err_msg="epoch2 data %d" % i)
+
+
+@pytest.mark.chaos
+def test_data_service_drill_hung_worker_heartbeat_respawn(
+        tmp_path, monkeypatch, clean_faults):
+    """A WEDGED (not dead) worker: MXTPU_FAULTS=hang_data_worker:1
+    stalls one worker's decode loop for an hour.  Its heartbeat goes
+    stale, the collector kills + respawns it (fault stripped from the
+    child env), and the stream still matches the undisturbed run."""
+    path, idx = _ds_rec_dataset(tmp_path)
+    it = _ds_iter(path, idx, workers=2)
+    ref = _ds_stream(it)
+    it.close()
+
+    monkeypatch.setenv("MXTPU_FAULTS", "hang_data_worker:1")
+    monkeypatch.setenv("MXTPU_DATA_HEARTBEAT_S", "2")
+    t0 = time.monotonic()
+    it = _ds_iter(path, idx, workers=2)
+    got = _ds_stream(it)
+    st = it.stats()
+    it.close()
+    assert sum(w["respawns"] for w in st["workers"].values()) >= 1, st
+    assert time.monotonic() - t0 < 120   # heartbeat fired, not the hang
+    assert len(got) == len(ref)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(a[0], b[0], err_msg="data %d" % i)
+        np.testing.assert_array_equal(a[1], b[1], err_msg="labels %d" % i)
