@@ -885,6 +885,159 @@ def _checkpoint_bench(saves=5, steps_between=3, batch=64, hidden=1024):
     return out
 
 
+def _roofline_bench(preset=None, trials=None):
+    """``bench.py roofline`` — per-op proof for the fused kernels
+    (mxnet_tpu/kernels/, docs/how_to/kernels.md).
+
+    For each kernel the mode times (a) the FUSED implementation (the
+    routed tier as one jitted program — fused-lax on the CPU tier,
+    Pallas on TPU) and (b) the UNFUSED composition at dispatch
+    granularity: every primitive its own compiled call, the execution
+    model the pre-fusion graphs (and the reference's per-op engine) pay.
+    Each fused time is also compared against an analytic bytes/FLOPs
+    roofline (kernels/roofline.py) using the machine's MEASURED matmul
+    rate and copy bandwidth (calibrated here, not nominal), so the
+    artifact shows how close each kernel runs to the hardware and which
+    side binds it.
+
+    Self-gating: every kernel must beat its unfused composition
+    (``roofline_<op>_win``); the ``roofline_<op>_speedup`` keys are in
+    GATE_KEYS so later rounds cannot silently regress them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kernels import bn_act as BA
+    from mxnet_tpu.kernels import flash_attention as FA
+    from mxnet_tpu.kernels import lstm_cell as LC
+    from mxnet_tpu.kernels import roofline as RL
+    from mxnet_tpu.ops import nn as NN
+
+    preset = preset or os.environ.get("BENCH_ROOFLINE_PRESET", "full")
+    trials = trials or _env_int("BENCH_TRIALS", 3)
+    small = preset == "small"
+    reps = 3 if small else 10
+
+    def timeit(fn, *args):
+        """Best-of-trials seconds for one call of fn (block-synced; the
+        roofline mode runs on the CPU tier where block_until_ready is a
+        true completion barrier — see the module docstring for why the
+        tunneled TPU tier needs fetch-synced slopes instead)."""
+        jax.block_until_ready(fn(*args))           # warm/compile
+        best = float("inf")
+        for _ in range(max(1, trials)):
+            tic = time.perf_counter()
+            for _ in range(reps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - tic) / reps)
+        return best
+
+    # -- machine calibration: achieved matmul rate + copy bandwidth ----
+    n = 256 if small else 1024
+    a = jnp.ones((n, n), jnp.float32)
+    mm = jax.jit(lambda x: x @ x)
+    t_mm = timeit(mm, a)
+    peak_flops = 2.0 * n * n * n / t_mm
+    buf = jnp.ones((1 << 20,) if small else (1 << 24,), jnp.float32)
+    scale_pass = jax.jit(lambda x: x * 1.0000001)   # one read + one write
+    t_cp = timeit(scale_pass, buf)
+    mem_bw = 2.0 * buf.size * 4 / t_cp
+
+    rs = np.random.RandomState(0)
+    out = {
+        "roofline_peak_gflops": round(peak_flops / 1e9, 1),
+        "roofline_mem_gbs": round(mem_bw / 1e9, 2),
+        "roofline_preset": preset,
+    }
+
+    def record(name, fused_s, unfused_s, work):
+        bound_s = RL.roofline_seconds(work["flops"], work["fused_bytes"],
+                                      peak_flops, mem_bw)
+        out["roofline_%s_fused_us" % name] = round(fused_s * 1e6, 2)
+        out["roofline_%s_unfused_us" % name] = round(unfused_s * 1e6, 2)
+        out["roofline_%s_speedup" % name] = round(unfused_s / fused_s, 3)
+        out["roofline_%s_bound_us" % name] = round(bound_s * 1e6, 2)
+        out["roofline_%s_bound" % name] = RL.bound_side(
+            work["flops"], work["fused_bytes"], peak_flops, mem_bw)
+        out["roofline_%s_of_roofline" % name] = round(
+            bound_s / fused_s, 3) if fused_s else None
+        out["roofline_%s_win" % name] = bool(unfused_s >= fused_s)
+
+    # -- bn_act: the inception-bn inner loop shape --------------------
+    N, C, HW = (8, 32, 28 * 28) if small else (32, 64, 56 * 56)
+    x = jnp.asarray(rs.rand(N, C, HW).astype("f").reshape(N, C, HW))
+    gam = jnp.asarray(rs.rand(C).astype("f") + 0.5)
+    bet = jnp.asarray(rs.rand(C).astype("f"))
+    mmean = jnp.zeros(C)
+    mvar = jnp.ones(C)
+
+    fused_bn = jax.jit(lambda x, g, b, m, v: BA.fused_bn_act_lax(
+        x, g, b, m, v, act_type="relu", fix_gamma=False, is_train=True))
+    bn_stage = jax.jit(lambda x, g, b, m, v: NN.batch_norm(
+        x, g, b, m, v, fix_gamma=False, is_train=True))
+    act_stage = jax.jit(lambda x: NN.activation(x, act_type="relu"))
+
+    def unfused_bn(x, g, b, m, v):
+        o, nm, nv = bn_stage(x, g, b, m, v)
+        return act_stage(o), nm, nv
+
+    record("bn_act",
+           timeit(fused_bn, x, gam, bet, mmean, mvar),
+           timeit(unfused_bn, x, gam, bet, mmean, mvar),
+           RL.workload("bn_act", n=N, c=C, hw=HW))
+
+    # -- lstm_cell: the lstm_tok_s bench's cell shape -----------------
+    B, H = (16, 64) if small else (32, 200)
+    gates = jnp.asarray(rs.randn(B, 4 * H).astype("f"))
+    cprev = jnp.asarray(rs.randn(B, H).astype("f"))
+
+    fused_cell = jax.jit(LC.lstm_cell_lax)
+    sig = jax.jit(jax.nn.sigmoid)
+    tnh = jax.jit(jnp.tanh)
+    mul = jax.jit(jnp.multiply)
+    add = jax.jit(jnp.add)
+    split4 = jax.jit(lambda g: tuple(jnp.split(g, 4, axis=-1)))
+
+    def unfused_cell(g, c):
+        i, f, gg, o = split4(g)
+        c2 = add(mul(sig(f), c), mul(sig(i), tnh(gg)))
+        return mul(sig(o), tnh(c2)), c2
+
+    record("lstm_cell",
+           timeit(fused_cell, gates, cprev),
+           timeit(unfused_cell, gates, cprev),
+           RL.workload("lstm_cell", b=B, h=H))
+
+    # -- flash_attention ----------------------------------------------
+    Bq, T, Hh, D = (2, 128, 2, 64) if small else (4, 512, 8, 64)
+    q = jnp.asarray(rs.randn(Bq, T, Hh, D).astype("f"))
+    k = jnp.asarray(rs.randn(Bq, T, Hh, D).astype("f"))
+    v = jnp.asarray(rs.randn(Bq, T, Hh, D).astype("f"))
+
+    fused_fa = jax.jit(lambda q, k, v: FA.flash_attention_lax(
+        q, k, v, causal=True))
+    scores_stage = jax.jit(
+        lambda q, k: jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D))
+    mask_soft = jax.jit(lambda s: jax.nn.softmax(
+        jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -jnp.inf),
+        axis=-1))
+    out_stage = jax.jit(lambda p, v: jnp.einsum("bhqk,bkhd->bqhd", p, v))
+
+    def unfused_fa(q, k, v):
+        return out_stage(mask_soft(scores_stage(q, k)), v)
+
+    record("flash_attention",
+           timeit(fused_fa, q, k, v),
+           timeit(unfused_fa, q, k, v),
+           RL.workload("flash_attention", b=Bq, t=T, heads=Hh, d=D))
+
+    out["roofline_all_win"] = all(
+        out["roofline_%s_win" % op]
+        for op in ("bn_act", "lstm_cell", "flash_attention"))
+    return out
+
+
 def _lstm_bench(batch, seq_len, steps, warmup, trials):
     """2-layer LSTM LM (lstm_bucketing workload, one bucket) tokens/sec."""
     import jax
@@ -1270,7 +1423,7 @@ def _run_mode(mode):
         mode = "data-service"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
                 "resume", "checkpoint", "analyze", "serve",
-                "data-service"):
+                "data-service", "roofline"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -1285,6 +1438,8 @@ def _run_mode(mode):
         jax.config.update("jax_platforms", "cpu")
     if mode == "analyze":
         out.update(_analyze_bench())
+    elif mode == "roofline":
+        out.update(_roofline_bench())
     elif mode == "serve":
         out.update(_serve_bench())
     elif mode == "decode":
@@ -1347,6 +1502,16 @@ def _run_mode(mode):
         sys.stderr.write("unknown BENCH_MODE %r\n" % mode)
         sys.exit(2)
     print("BENCH_PART " + json.dumps(out))
+
+
+#: modes the positional CLI form (`python bench.py <mode>`) accepts —
+#: the same names BENCH_MODE understands (aliases included)
+KNOWN_MODES = frozenset((
+    "decode", "data-service", "data_service", "fed-cpu", "pipeline",
+    "compile-probe", "resume", "checkpoint", "analyze", "serve",
+    "roofline", "fed", "compute", "compute-large", "inception-bn",
+    "resnet-152", "lstm",
+))
 
 
 def _collect(mode, timeout=480, extra_env=None):
@@ -1413,7 +1578,7 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
              "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup",
              "data_service_img_s", "data_service_scaling_x",
-             "pipeline_decode_scaling_x")
+             "pipeline_decode_scaling_x", "roofline_*_speedup")
 
 #: scaling-SHAPE keys: flat by construction on a 1-core host (the
 #: decode threads/worker processes have nowhere to scale TO), so when
@@ -1567,6 +1732,13 @@ def main():
     if any(a.startswith("--gate") for a in sys.argv[1:]):
         sys.exit(_gate_main(sys.argv[1:]))
     mode = os.environ.get("BENCH_MODE")
+    if mode is None and len(sys.argv) > 1 and sys.argv[1] in KNOWN_MODES:
+        # positional single-mode form, e.g. `python bench.py roofline`
+        # (docs/how_to/kernels.md) — same path as BENCH_MODE=<mode>.
+        # Restricted to the known-mode set: main() is also called
+        # IN-PROCESS (tests monkeypatch _collect), where argv belongs
+        # to the embedding program, not to bench.
+        mode = sys.argv[1]
     if mode:
         _run_mode(mode)
         return
@@ -1598,6 +1770,7 @@ def main():
         parts.update(_collect("resume"))
         parts.update(_collect("checkpoint"))
         parts.update(_collect("serve"))
+        parts.update(_collect("roofline"))
         parts.update(_collect("fed"))
     parts.update(_collect("analyze", timeout=240))
     parts.update(_collect("compute"))
@@ -1666,7 +1839,7 @@ def main():
         if k in parts:
             result[k] = parts[k]
     for k in sorted(parts):
-        if k.startswith("serve_"):
+        if k.startswith("serve_") or k.startswith("roofline_"):
             result[k] = parts[k]
     if compute is not None:
         if fed is None:

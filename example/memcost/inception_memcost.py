@@ -38,9 +38,9 @@ def measure(network, batch, mirror):
     import numpy as np
     d = mx.nd.array(np.zeros((batch, 3, 224, 224), "f")).astype("bfloat16")
     l = mx.nd.array(np.zeros(batch, "f"))
-    extras = {"guard": (trainer._scalar_acc(0, np.int32),
-                        trainer._scalar_acc(0, np.int32),
-                        trainer._scalar_acc(0, np.int32))}
+    # the step's guard carry: one stacked i32[3] (total, consec, trips)
+    extras = {"guard": trainer._scalar_acc(np.zeros(3, np.int32),
+                                           np.int32)}
     lowered = trainer._step_fn.lower(
         trainer.params, trainer.aux, trainer.opt_state, extras,
         {"data": d._data, "softmax_label": l._data},

@@ -118,3 +118,7 @@ from . import predict
 from . import serving
 from . import test_utils
 from . import analysis
+# fused Pallas/lax kernels (registers the _FusedLSTMCell op and the
+# MXTPU_FLASH_BLOCK knob — imported at package init for registry
+# completeness, like serving)
+from . import kernels

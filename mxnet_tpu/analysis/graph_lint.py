@@ -24,6 +24,10 @@ of the invariants the runtime relies on:
 - ``graph-dtype-drift``: dot/conv equations computing in a wider float
   than the declared ``compute_dtype`` — silent f32 math inside a bf16
   step costs ~2x FLOP time on the MXU.
+- ``graph-pallas-no-vjp``: a ``pallas_call`` not protected by a
+  registered ``custom_vjp``/``custom_jvp`` — Pallas has no reverse-mode
+  transpose, so a differentiated step reaching it dies at trace time
+  (or the op is silently forward-only); rtc.py documents the contract.
 
 All jax imports are function-local so importing this module costs
 nothing in host-only contexts (the AST level and the CLI).
@@ -35,13 +39,27 @@ import re
 from .report import Finding, Report
 
 __all__ = ["iter_eqns", "find_callbacks", "audit_dtype", "audit_donation",
-           "collective_stats", "audit_collectives", "lint_lowered",
-           "lint_jit", "CALLBACK_PRIMITIVES", "COLLECTIVE_OPS"]
+           "collective_stats", "audit_collectives", "find_unprotected_pallas",
+           "lint_lowered", "lint_jit", "CALLBACK_PRIMITIVES",
+           "COLLECTIVE_OPS", "PALLAS_PRIMITIVES"]
 
 #: jaxpr primitives that re-enter the host mid-step
 CALLBACK_PRIMITIVES = frozenset((
     "pure_callback", "io_callback", "debug_callback", "callback",
     "host_callback_call", "outside_call",
+))
+
+#: Pallas kernel-call primitives — no reverse-mode transpose exists for
+#: these (rtc.py's documented contract), so one reachable from a
+#: differentiated step MUST sit under a registered custom_vjp
+PALLAS_PRIMITIVES = frozenset(("pallas_call",))
+
+#: primitives whose body is differentiation-protected: jax never
+#: transposes THROUGH these (the registered rules apply instead), so a
+#: pallas_call inside them is safe and the walk does not descend
+_CUSTOM_DIFF_WRAPPERS = frozenset((
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "custom_jvp_call",
+    "custom_jvp_call_jaxpr", "custom_jvp_generic_call",
 ))
 
 #: primitives whose dtype decides where the MXU/VPU math happens
@@ -88,14 +106,20 @@ def _eqn_location(eqn):
         return None, None
 
 
-def iter_eqns(jaxpr):
+def iter_eqns(jaxpr, prune=frozenset()):
     """Yield every equation in ``jaxpr`` including nested sub-jaxprs
-    (pjit bodies, scan/while bodies, cond branches, remat, custom_vjp)."""
+    (pjit bodies, scan/while bodies, cond branches, remat, custom_vjp).
+
+    ``prune``: primitive names whose equations are yielded but whose
+    sub-jaxprs are NOT descended into (the pallas rule prunes at
+    custom-vjp wrappers — their bodies are differentiation-protected)."""
     import jax
 
     def _walk(jxp):
         for eqn in jxp.eqns:
             yield eqn
+            if eqn.primitive.name in prune:
+                continue
             for v in eqn.params.values():
                 items = v if isinstance(v, (list, tuple)) else (v,)
                 for item in items:
@@ -120,6 +144,35 @@ def find_callbacks(closed_jaxpr):
                 "host sync point (move it out of the step or behind a "
                 "deferred metric/guard carry)" % name,
                 file=fname, line=line))
+    return out
+
+
+def find_unprotected_pallas(closed_jaxpr):
+    """``graph-pallas-no-vjp``: a ``pallas_call`` NOT wrapped in a
+    ``custom_vjp``/``custom_jvp`` rule.  Pallas has no reverse-mode
+    transpose, so differentiating through such a kernel is a trace-time
+    error at best — and in a step assembled from many ops the failure
+    surfaces far from the kernel that caused it (rtc.py documents the
+    hazard; kernels/ pairs every Pallas forward with a backward kernel
+    behind ``jax.custom_vjp``).  The walk descends into ordinary
+    sub-jaxprs (pjit/scan/while/cond/remat) but NOT into custom-vjp
+    wrappers, whose bodies are differentiation-protected by the
+    registered rule."""
+    out = []
+    for eqn in iter_eqns(closed_jaxpr, prune=_CUSTOM_DIFF_WRAPPERS):
+        if eqn.primitive.name not in PALLAS_PRIMITIVES:
+            continue
+        fname, line = _eqn_location(eqn)
+        out.append(Finding(
+            "graph-pallas-no-vjp",
+            "pallas_call without a registered custom_vjp is "
+            "reachable from this step — Pallas kernels have no "
+            "reverse-mode transpose, so differentiation fails "
+            "at trace time (or silently degrades); pair the "
+            "forward kernel with a backward kernel via "
+            "jax.custom_vjp (rtc.register_kernel(vjp=...), "
+            "kernels/ pattern)",
+            file=fname, line=line))
     return out
 
 
@@ -333,6 +386,7 @@ def lint_lowered(lowered, closed_jaxpr=None, compute_dtype=None,
                               carry_argnums=carry_argnums))
     if closed_jaxpr is not None:
         rep.extend(find_callbacks(closed_jaxpr))
+        rep.extend(find_unprotected_pallas(closed_jaxpr))
         if compute_dtype is not None:
             findings, tally = audit_dtype(closed_jaxpr, compute_dtype)
             rep.extend(findings)

@@ -232,3 +232,13 @@ ENV_DATA_WORKERS = register_env(
     doc="N>0 routes ImageRecordIter through the multi-process "
         "shared-memory data service with N decode worker processes "
         "(same as data_service=True; docs/how_to/performance.md)")
+# Registered here (not in kernels/) because it is read across modules:
+# ops/nn.py's RNN scan, rnn/rnn_cell.py's LSTMCell, executor.py's
+# BN+activation fusion pass and parallel/ring_attention.py all consult it
+# at trace/bind time (docs/how_to/kernels.md).
+ENV_FUSED_KERNELS = register_env(
+    "MXTPU_FUSED_KERNELS", default="1",
+    doc="Fused-kernel routing (mxnet_tpu/kernels/): 1 = all fused "
+        "kernels on (default), 0 = exact pre-fusion graphs, or a "
+        "comma list from {bn_act, bn_fold, lstm_cell, flash_attention} "
+        "to enable individually (docs/how_to/kernels.md)")

@@ -56,17 +56,24 @@ def _filter_attrs(op, attrs):
 
 
 def _node_plan(symbol):
-    """Precompute the per-node execution plan for the trace.  The last
-    slot is the node's position in this graph's topological order — the
+    """Precompute the per-node execution plan for the trace.  Slot 5 is
+    the node's position in this graph's topological order — the
     per-node RNG fold constant.  It must be a pure function of the GRAPH
     (never of process history): folding the old process-global Symbol
     uid meant the same seeded program drew different Dropout masks
     depending on how many symbols the process had ever created, so a
-    test suite's earlier tests silently changed later seeded runs."""
+    test suite's earlier tests silently changed later seeded runs.
+
+    Slot 6 is an optional fusion override, ``None`` or ``(fn,
+    extra_refs)``: the interpreter then calls ``fn`` instead of the
+    node's op, appending the values of ``extra_refs`` ((src_node, idx)
+    pairs) to the node's own inputs — how the BN+activation fusion pass
+    (:func:`_fuse_bn_plan`) reroutes node pairs without renumbering the
+    plan (RNG fold constants stay put)."""
     plan = []
     for ix, node in enumerate(symbol._nodes()):
         if node.is_variable:
-            plan.append((node, None, None, None, ix))
+            plan.append((node, None, None, None, ix, None))
             continue
         attrs = node.op.normalize_attrs(node.op_attrs())
         call_attrs = _filter_attrs(node.op, attrs)
@@ -78,8 +85,120 @@ def _node_plan(symbol):
             if n_in + k < len(node.inputs):
                 src, _ = node.inputs[n_in + k]
                 aux_var_names.append(src.name if src.is_variable else None)
-        plan.append((node, call_attrs, n_out, aux_var_names, ix))
+        plan.append((node, call_attrs, n_out, aux_var_names, ix, None))
     return plan
+
+
+#: Activation types the BN+activation fusion accepts (the fused kernel's
+#: lax tier covers every registered act_type; the Pallas tier narrows
+#: further internally and falls back to lax for the rest)
+_FUSABLE_ACTS = ("relu", "sigmoid", "tanh", "softrelu", "softsign")
+
+
+def _make_fused_bn_fn(act_type, conv_attrs):
+    """The override body for one fused BatchNorm site.
+
+    Training: fused normalize+scale/shift+activate in one kernel pass
+    (kernels/bn_act.py; Pallas on TPU, fused-lax elsewhere — bit-equal
+    to the unfused graph on the lax tier).  Inference with a private
+    Conv producer: BN folds into the conv weights and the original conv
+    result goes dead (XLA DCEs it out of the eval program); parity is
+    tolerance-bound there (float reassociation), the documented
+    exception in tests/test_kernels.py.
+    """
+    def fused(data, gamma, beta, moving_mean, moving_var, *conv_ins,
+              is_train=False, **bn_attrs):
+        from .kernels import bn_act as _ba
+        bn_attrs.pop("output_mean_var", None)   # fusion requires False
+        if conv_ins and not is_train:
+            cdata, w = conv_ins[0], conv_ins[1]
+            cbias = conv_ins[2] if len(conv_ins) > 2 else None
+            from .ops.nn import activation, convolution
+            w2, b2 = _ba.fold_bn_into_conv(
+                w, cbias, gamma, beta, moving_mean, moving_var,
+                eps=bn_attrs.get("eps", 0.001),
+                fix_gamma=bn_attrs.get("fix_gamma", True))
+            out = convolution(cdata, w2, b2,
+                              **{k: v for k, v in conv_attrs.items()
+                                 if k != "no_bias"})
+            if act_type:
+                out = activation(out, act_type=act_type)
+            return out, moving_mean, moving_var
+        return _ba.fused_bn_act(data, gamma, beta, moving_mean,
+                                moving_var, act_type=act_type,
+                                is_train=is_train, **bn_attrs)
+    return fused
+
+
+def _fuse_bn_plan(plan, out_refs):
+    """Rewrite the plan for the BatchNorm fusions (MXTPU_FUSED_KERNELS
+    ``bn_act``/``bn_fold``; docs/how_to/kernels.md):
+
+    - a BatchNorm whose single consumer is an Activation gets the fused
+      one-pass kernel; the Activation entry becomes a passthrough.
+    - a BatchNorm whose data producer is a private Convolution
+      additionally folds into the conv weights on the inference trace.
+
+    Aux updates are untouched: the overridden entry still returns
+    ``(out, new_mm, new_mv)`` at the BatchNorm node, where the executor
+    already writes them back.  Entries keep their positions, so RNG fold
+    constants are unchanged and ``MXTPU_FUSED_KERNELS=0`` (which skips
+    this pass entirely) restores the exact pre-fusion program.
+    """
+    from .kernels import fused_enabled
+    do_act = fused_enabled("bn_act")
+    do_fold = fused_enabled("bn_fold")
+    if not (do_act or do_fold):
+        return plan
+    consumers = {}
+    entry_of = {}
+    for e in plan:
+        node = e[0]
+        entry_of[id(node)] = e
+        if node.op is None:
+            continue
+        for pos, (src, idx) in enumerate(node.inputs):
+            consumers.setdefault((id(src), idx), []).append((node, pos))
+    out_ids = {(nid, i) for nid, i in out_refs}
+
+    overrides = {}   # id(node) -> (fn, extra_refs)
+    for e in plan:
+        node, call_attrs, n_out = e[0], e[1], e[2]
+        if node.op is None or node.op.name != "BatchNorm" or n_out != 1:
+            continue
+        users = consumers.get((id(node), 0), [])
+        act_node, act_type = None, None
+        if do_act and len(users) == 1 and (id(node), 0) not in out_ids:
+            u, pos = users[0]
+            if u.op is not None and u.op.name == "Activation" \
+                    and pos == 0 and len(u.inputs) == 1:
+                a_attrs = entry_of[id(u)][1] or {}
+                at = str(a_attrs.get("act_type", "relu"))
+                if at in _FUSABLE_ACTS:
+                    act_node, act_type = u, at
+        conv_node = None
+        if do_fold and node.inputs:
+            src, idx = node.inputs[0]
+            if src.op is not None and src.op.name == "Convolution" \
+                    and idx == 0 \
+                    and len(consumers.get((id(src), 0), [])) == 1 \
+                    and (id(src), 0) not in out_ids:
+                conv_node = src
+        if act_node is None and conv_node is None:
+            continue
+        conv_attrs = dict(entry_of[id(conv_node)][1]) if conv_node \
+            else {}
+        extra = list(conv_node.inputs) if conv_node is not None else []
+        overrides[id(node)] = (_make_fused_bn_fn(act_type, conv_attrs),
+                               extra)
+        if act_node is not None:
+            overrides[id(act_node)] = (lambda x, **_kw: x, [])
+
+    if not overrides:
+        return plan
+    return [e if id(e[0]) not in overrides
+            else e[:5] + (overrides[id(e[0])],)
+            for e in plan]
 
 
 def _build_eval(symbol, placement=None, mirror_segments=0):
@@ -101,6 +220,11 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
     plan = _node_plan(symbol)
     out_refs = [(id(n), i) for n, i in symbol._outputs]
     placement = placement or {}
+    # BN+activation fusion / conv-BN folding (MXTPU_FUSED_KERNELS):
+    # fused dispatch only — the placement (eager per-op) path and
+    # monitored runs keep the plain plan, so per-node taps still see
+    # the unfused node outputs
+    fused_plan = plan if placement else _fuse_bn_plan(plan, out_refs)
     if mirror_segments and mirror_segments > 1:
         if placement:
             import logging
@@ -108,13 +232,14 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
                 "MXNET_BACKWARD_DO_MIRROR ignored: group2ctx placement "
                 "runs per-op eagerly, which jax.checkpoint cannot wrap")
         else:
-            return _build_eval_segmented(plan, out_refs,
+            return _build_eval_segmented(plan, fused_plan, out_refs,
                                          int(mirror_segments))
 
     if not placement:
         def eval_fn(args, aux, rng, is_train, monitor=None):
             env, aux_updates = {}, {}
-            _run_plan_nodes(plan, env, args, aux, rng, is_train,
+            _run_plan_nodes(plan if monitor is not None else fused_plan,
+                            env, args, aux, rng, is_train,
                             aux_updates, monitor)
             return [env[nid][i] for nid, i in out_refs], aux_updates
         return eval_fn
@@ -122,7 +247,7 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
     def eval_fn(args, aux, rng, is_train, monitor=None):
         env = {}
         aux_updates = {}
-        for node, call_attrs, n_out, aux_var_names, rng_ix in plan:
+        for node, call_attrs, n_out, aux_var_names, rng_ix, _ov in plan:
             dev = placement.get(id(node))
             if node.op is None:
                 if node.name in args:
@@ -176,7 +301,7 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
                     monitor=None):
     """Interpret a slice of the node plan against ``env`` (id -> outputs
     tuple).  Shared by the plain and segmented eval builders."""
-    for node, call_attrs, n_out, aux_var_names, rng_ix in chunk:
+    for node, call_attrs, n_out, aux_var_names, rng_ix, override in chunk:
         if node.op is None:
             if node.name in args:
                 val = args[node.name]
@@ -192,12 +317,19 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
             kw["is_train"] = is_train
         if node.op.needs_rng:
             kw["rng"] = jax.random.fold_in(rng, rng_ix)
+        if override is not None:
+            # fusion override (_fuse_bn_plan): fn replaces the op, with
+            # the referenced extra inputs appended (conv data/weights)
+            fn, extra_refs = override
+            ins = ins + [env[id(src)][idx] for src, idx in extra_refs]
+        else:
+            fn = node.op.fn
         # named_scope stamps the symbol node name into HLO op_name
         # metadata, so device profiles attribute fused-program time back
         # to graph nodes (reference per-op profiler semantics,
         # src/engine/profiler.cc AddOprStat with opr_name)
         with jax.named_scope(node.name):
-            out = node.op.fn(*ins, **call_attrs, **kw)
+            out = fn(*ins, **call_attrs, **kw)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         env[id(node)] = tuple(out[:n_out])
@@ -208,14 +340,17 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
             monitor(node, env[id(node)])
 
 
-def _build_eval_segmented(plan, out_refs, n_segments):
+def _build_eval_segmented(plan, fused_plan, out_refs, n_segments):
     """Segmented-remat eval: the plan is split into ~n_segments chunks,
     each wrapped in jax.checkpoint.  Residuals between segments are only
     the live boundary values, so activation memory scales with the segment
-    size while the backward recomputes within each segment."""
-    n = len(plan)
+    size while the backward recomputes within each segment.  Monitored
+    (per-op tap) runs interpret the plain ``plan``; everything else runs
+    the (possibly BN-fused) ``fused_plan`` — same node positions, so the
+    liveness analysis below serves both."""
+    n = len(fused_plan)
     seg_size = max(1, -(-n // n_segments))
-    chunks = [plan[i:i + seg_size] for i in range(0, n, seg_size)]
+    chunks = [fused_plan[i:i + seg_size] for i in range(0, n, seg_size)]
 
     # liveness: which node outputs cross each boundary
     produced_in = {}
@@ -224,9 +359,13 @@ def _build_eval_segmented(plan, out_refs, n_segments):
             produced_in[id(node)] = ci
     consumers = {}   # id -> last chunk index that reads it
     for ci, chunk in enumerate(chunks):
-        for node, *_ in chunk:
+        for entry in chunk:
+            node, override = entry[0], entry[5]
             if node.op is not None:
-                for src, _idx in node.inputs:
+                refs = list(node.inputs)
+                if override is not None:
+                    refs += list(override[1])   # fusion extra inputs
+                for src, _idx in refs:
                     consumers[id(src)] = max(consumers.get(id(src), -1), ci)
     for nid, _ in out_refs:
         consumers[nid] = len(chunks)

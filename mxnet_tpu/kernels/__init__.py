@@ -1,0 +1,114 @@
+"""mxkern — fused Pallas/lax kernels for the graphs XLA leaves on the table.
+
+The bench trajectory (BENCH_r04) shows the conv/matmul models near the
+machine's ceiling while BatchNorm/concat-heavy (inception-bn) and
+gate-heavy (LSTM) graphs trail badly: those graphs spend their time in
+memory-bound elementwise chains that benefit from being ONE kernel pass
+instead of a dispatch-granularity composition.  Following the
+FlashAttention discipline (Dao et al., 2022 — materialize nothing you can
+recompute in-tile), every kernel here ships at two tiers:
+
+- **Pallas tier** (TPU): a ``pl.pallas_call`` kernel with a registered
+  ``jax.custom_vjp`` backward, per the :mod:`~mxnet_tpu.rtc` contract
+  (Pallas has no reverse-mode transpose; an unprotected kernel in a
+  differentiated step is a trace-time error — mxlint's
+  ``graph-pallas-no-vjp`` rule polices this).
+- **fused-lax reference** (CPU tier, and the numeric oracle): the same
+  math as the unfused op composition, in one traced function, written so
+  the per-element operation sequence is IDENTICAL to the unfused graph —
+  bit-comparable where float reassociation permits (asserted in
+  tests/test_kernels.py), and faster than the op-by-op composition
+  because it compiles to one program instead of a dispatch chain.
+
+Routing is per-kernel via ``MXTPU_FUSED_KERNELS`` (registered in
+``base.py``): ``1`` (default) enables everything, ``0`` restores the
+exact pre-fusion graphs, a comma list enables individual kernels.  The
+env is consulted at trace/bind time (symbol build, executor bind, jit
+trace), so toggling it affects the NEXT graph built, never a compiled
+program.  ``bench.py roofline`` times each kernel fused-vs-unfused and
+against a bytes/FLOPs roofline estimate so every kernel proves its win
+in the artifact (docs/how_to/kernels.md).
+
+Kernel catalog (``KNOWN_KERNELS``):
+
+- ``bn_act``   — fused BatchNorm+activation (training one-pass), wired
+  into the executor's BN aux-update path (:mod:`.bn_act`).
+- ``bn_fold``  — fold BN scale/shift into conv weights for inference
+  (:func:`.bn_act.fold_bn_into_conv`; executor eval trace).
+- ``lstm_cell`` — one-kernel LSTM gate math consumed by the fused RNN
+  op's ``lax.scan`` and by ``rnn_cell.LSTMCell`` (:mod:`.lstm_cell`).
+- ``flash_attention`` — tiled online-softmax attention that
+  ``parallel/ring_attention.py`` composes with (:mod:`.flash_attention`).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import ENV_FUSED_KERNELS, get_env, register_env
+
+__all__ = ["KNOWN_KERNELS", "fused_enabled", "enabled_kernels",
+           "use_pallas", "ENV_FLASH_BLOCK", "bn_act", "lstm_cell",
+           "flash_attention", "roofline"]
+
+_LOG = logging.getLogger(__name__)
+
+#: every kernel name the router understands (docs/how_to/kernels.md)
+KNOWN_KERNELS = ("bn_act", "bn_fold", "lstm_cell", "flash_attention")
+
+# registered EAGERLY at package import (a lazy registration inside the
+# flash module failed the three-way registry==docs==reads sync for the
+# data-service knobs — same lesson here)
+ENV_FLASH_BLOCK = register_env(
+    "MXTPU_FLASH_BLOCK", default=128,
+    doc="Tile size (query and key block length) for the flash-attention "
+        "kernel; sequences at or below one block use plain attention")
+
+_ON = frozenset(("1", "on", "true", "yes", "all"))
+_OFF = frozenset(("", "0", "off", "false", "no", "none"))
+
+_warned_unknown = set()
+
+
+def enabled_kernels():
+    """The set of fused kernels the env currently enables.  Read per
+    call — callers consult it at trace/bind time, so the cost is paid
+    once per graph build, not per step."""
+    raw = str(get_env(ENV_FUSED_KERNELS, "1")).strip().lower()
+    if raw in _ON:
+        return frozenset(KNOWN_KERNELS)
+    if raw in _OFF:
+        return frozenset()
+    names = set()
+    for part in raw.replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part in KNOWN_KERNELS:
+            names.add(part)
+        elif part not in _warned_unknown:
+            _warned_unknown.add(part)
+            _LOG.warning(
+                "MXTPU_FUSED_KERNELS names unknown kernel %r "
+                "(known: %s) — ignored", part, ", ".join(KNOWN_KERNELS))
+    return frozenset(names)
+
+
+def fused_enabled(name):
+    """Whether the named fused kernel should be used for graphs built
+    NOW (``MXTPU_FUSED_KERNELS``; see module docstring for the catalog)."""
+    return name in enabled_kernels()
+
+
+def use_pallas():
+    """Tier selection: compiled Pallas kernels on TPU backends, the
+    fused-lax reference elsewhere.  Tests force the Pallas tier with
+    ``interpret=True`` explicitly (the rtc.py story: same kernel code
+    runs interpreted on the virtual CPU mesh)."""
+    from ..rtc import on_tpu
+    return on_tpu()
+
+
+from . import roofline            # noqa: E402  (stdlib-light, analytic)
+from . import bn_act              # noqa: E402
+from . import lstm_cell           # noqa: E402
+from . import flash_attention     # noqa: E402

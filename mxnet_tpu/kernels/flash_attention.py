@@ -1,0 +1,268 @@
+"""Flash attention — tiled online-softmax attention.
+
+Plain attention materializes the (T x T) score and probability matrices:
+4 extra memory passes over B*H*T^2 elements that dwarf the useful q/k/v
+traffic for long sequences.  The flash formulation (Dao et al., 2022)
+streams over key blocks keeping a running (max, sum-of-exp, accumulator)
+triple per query row — nothing quadratic ever exists.
+
+Shared core: :func:`online_update` is ONE streaming-softmax accumulation
+step.  The lax flash scan uses it per key block, and
+``parallel/ring_attention.py`` composes with it per ring hop — ring
+attention IS this kernel's accumulation run across devices, so the two
+paths cannot drift numerically.
+
+Tiers (package docstring):
+
+- :func:`flash_attention_lax` — ``lax.scan`` over key blocks; pure lax,
+  differentiable by jax (the scan transposes to the standard recompute
+  backward), O(T) memory.
+- :func:`flash_attention_pallas` — a ``pl.pallas_call`` kernel (grid
+  over batch x heads x query blocks, ``fori_loop`` over key blocks with
+  the running triple in registers/VMEM) behind ``jax.custom_vjp``; the
+  registered backward recomputes through the fused-lax tier (O(T)
+  memory, the FlashAttention recompute discipline) — Pallas has no
+  reverse-mode transpose (rtc.py contract; mxlint ``graph-pallas-no-vjp``
+  polices unprotected kernels).
+
+Numerics: the streaming softmax reassociates the sum of exponentials, so
+parity with :func:`~mxnet_tpu.parallel.ring_attention.full_attention` is
+tolerance-checked (f32 ~1e-5 relative), not bitwise — the documented
+tolerance in tests/test_kernels.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["flash_attention", "flash_attention_lax",
+           "flash_attention_pallas", "online_update", "default_block"]
+
+
+def default_block():
+    from ..base import get_env
+    from . import ENV_FLASH_BLOCK
+    try:
+        return max(8, int(get_env(ENV_FLASH_BLOCK, 128)))
+    except (TypeError, ValueError):
+        return 128
+
+
+def online_update(acc, m_run, s_run, q, k, v, scale, mask):
+    """One streaming-softmax accumulation step.
+
+    ``acc`` (B, Tq, H, D) f32, ``m_run``/``s_run`` (B, H, Tq); ``q``
+    (B, Tq, H, D); ``k``/``v`` (B, Tk, H, D); ``mask`` broadcastable to
+    (B, H, Tq, Tk), True = attend.  Returns the updated triple.  Shared
+    verbatim by the flash scan (per key block) and ring attention (per
+    ring hop) so the two compositions stay numerically identical.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)
+    # guard fully-masked rows
+    m_safe = jnp.where(jnp.isfinite(m_blk), m_blk, 0.0)
+    p = jnp.exp(scores - m_safe[..., None])
+    p = jnp.where(mask, p, 0.0)
+    s_blk = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # rescale both running state and the new block to the common max; a
+    # fully-masked block (s_blk == 0) must not move the running max
+    m_new = jnp.maximum(m_run, jnp.where(s_blk > 0, m_safe, m_run))
+    alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_new), 0.0)
+    beta = jnp.where(jnp.isfinite(m_blk) & (s_blk > 0),
+                     jnp.exp(m_safe - m_new), 0.0)
+    s_new = s_run * alpha + s_blk * beta
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + \
+        out.astype(acc.dtype) * beta.transpose(0, 2, 1)[..., None]
+    return acc_new, m_new, s_new
+
+
+def _finalize(acc, s_run, dtype):
+    s = jnp.maximum(s_run, 1e-20)
+    return (acc / s.transpose(0, 2, 1)[..., None]).astype(dtype)
+
+
+def flash_attention_lax(q, k, v, causal=False, scale=None, block_k=None):
+    """Tiled online-softmax attention in pure lax: ``lax.scan`` over key
+    blocks.  q/k/v (B, T, H, D) -> (B, Tq, H, D).  Memory O(B*T*H*D) —
+    the (Tq x Tk) score matrix never materializes beyond one
+    (Tq x block_k) tile."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale or (1.0 / np.sqrt(D))
+    bk = min(block_k or default_block(), Tk)
+    nk = -(-Tk // bk)
+    pad = nk * bk - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # (nk, B, bk, H, D) blocks for the scan
+    kb = jnp.moveaxis(k.reshape(B, nk, bk, H, D), 1, 0)
+    vb = jnp.moveaxis(v.reshape(B, nk, bk, H, D), 1, 0)
+    # absolute positions: q row i attends k col j iff j - i <= Tk - Tq
+    # (the full_attention tril convention)
+    q_pos = jnp.arange(Tq) + (Tk - Tq)
+
+    acc0 = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
+    m0 = jnp.full((B, H, Tq), -jnp.inf)
+    s0 = jnp.zeros((B, H, Tq))
+
+    def body(carry, blk):
+        acc, m_run, s_run, idx = carry
+        kblk, vblk = blk
+        k_pos = idx * bk + jnp.arange(bk)
+        valid = k_pos < Tk                                # padding tail
+        if causal:
+            mask = (q_pos[:, None] >= k_pos[None, :]) & valid[None, :]
+        else:
+            mask = jnp.broadcast_to(valid[None, :], (Tq, bk))
+        acc, m_run, s_run = online_update(
+            acc, m_run, s_run, q, kblk, vblk, scale, mask[None, None])
+        return (acc, m_run, s_run, idx + 1), None
+
+    (acc, _, s_run, _), _ = lax.scan(body, (acc0, m0, s0, 0), (kb, vb))
+    return _finalize(acc, s_run, q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas tier
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(causal, scale, Tq, Tk, bk, q_ref, k_ref, v_ref, o_ref):
+    """One (batch, head, q-block) program: fori_loop over key blocks
+    with the running (acc, m, s) triple held in VMEM values.  ``Tq``/
+    ``Tk`` are the TRUE (unpadded) lengths — causal offsets must not
+    see the block padding."""
+    from jax.experimental import pallas as pl
+
+    bq = q_ref.shape[2]
+    D = q_ref.shape[3]
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)          # (bq, D)
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0) \
+        + (Tk - Tq)
+    nk = -(-Tk // bk)
+
+    def body(j, carry):
+        acc, m_run, s_run = carry
+        kb = k_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        mask = k_pos < Tk
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        scores = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) \
+            * scale
+        scores = jnp.where(mask, scores, -jnp.inf)
+        m_blk = jnp.max(scores, axis=-1, keepdims=True)
+        m_safe = jnp.where(jnp.isfinite(m_blk), m_blk, 0.0)
+        p = jnp.where(mask, jnp.exp(scores - m_safe), 0.0)
+        s_blk = jnp.sum(p, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_run, jnp.where(s_blk > 0, m_safe, m_run))
+        alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_new), 0.0)
+        beta = jnp.where(jnp.isfinite(m_blk) & (s_blk > 0),
+                         jnp.exp(m_safe - m_new), 0.0)
+        s_new = s_run * alpha + s_blk * beta
+        acc_new = acc * alpha + \
+            jnp.dot(p, vb, preferred_element_type=jnp.float32) * beta
+        return acc_new, m_new, s_new
+
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, _, s_run = lax.fori_loop(0, nk, body, (acc0, m0, s0))
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(s_run, 1e-20)) \
+        .astype(o_ref.dtype)
+
+
+def _flash_pallas_fwd(q, k, v, causal, scale, block, interpret):
+    """pallas_call over a (B, H, nq) grid in (B, H, T, D) layout."""
+    from jax.experimental import pallas as pl
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = min(block, Tq)
+    nq = -(-Tq // bq)
+    pad_q = nq * bq - Tq
+    qt = jnp.moveaxis(q, 1, 2)                          # (B, H, Tq, D)
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    bk = min(block, Tk)
+    pad_k = (-(-Tk // bk)) * bk - Tk
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    kernel = functools.partial(_flash_kernel, causal, scale, Tq, Tk, bk)
+    kw = {"grid": (B, H, nq),
+          "in_specs": [
+              pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+              pl.BlockSpec((1, 1, kt.shape[2], D),
+                           lambda b, h, i: (b, h, 0, 0)),
+              pl.BlockSpec((1, 1, vt.shape[2], D),
+                           lambda b, h, i: (b, h, 0, 0))],
+          "out_specs": pl.BlockSpec((1, 1, bq, D),
+                                    lambda b, h, i: (b, h, i, 0))}
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+        interpret=interpret, **kw)(qt, kt, vt)
+    if pad_q:
+        out = out[:, :, :Tq, :]
+    return jnp.moveaxis(out, 2, 1)                      # (B, Tq, H, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pallas(q, k, v, causal, scale, block, interpret):
+    return _flash_pallas_fwd(q, k, v, causal, scale, block, interpret)
+
+
+def _fp_fwd(q, k, v, causal, scale, block, interpret):
+    return _flash_pallas_fwd(q, k, v, causal, scale, block, interpret), \
+        (q, k, v)
+
+
+def _fp_bwd(causal, scale, block, interpret, res, g):
+    # registered backward: recompute through the fused-lax tier — O(T)
+    # memory, no quadratic residuals (the FlashAttention recompute rule)
+    q, k, v = res
+    _, vjp_fn = jax.vjp(
+        lambda a, b, c: flash_attention_lax(a, b, c, causal=causal,
+                                            scale=scale, block_k=block),
+        q, k, v)
+    return vjp_fn(g)
+
+
+_flash_pallas.defvjp(_fp_fwd, _fp_bwd)
+
+
+def flash_attention_pallas(q, k, v, causal=False, scale=None, block=None,
+                           interpret=None):
+    """Pallas-tier flash attention (custom_vjp registered)."""
+    if interpret is None:
+        from ..rtc import on_tpu
+        interpret = not on_tpu()
+    D = q.shape[-1]
+    scale = scale or (1.0 / np.sqrt(D))
+    return _flash_pallas(q, k, v, bool(causal), float(scale),
+                         int(block or default_block()), bool(interpret))
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block=None):
+    """Backend-routed flash attention: compiled Pallas on TPU, the lax
+    scan elsewhere.  Same contract as
+    :func:`~mxnet_tpu.parallel.ring_attention.full_attention`."""
+    from . import use_pallas
+    if use_pallas():
+        return flash_attention_pallas(q, k, v, causal=causal, scale=scale,
+                                      block=block, interpret=False)
+    return flash_attention_lax(q, k, v, causal=causal, scale=scale,
+                               block_k=block)

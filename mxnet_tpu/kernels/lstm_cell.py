@@ -1,0 +1,215 @@
+"""Fused LSTM cell — all gate math in one kernel.
+
+The unfused cell (ops/nn.py ``_rnn_cell_step``, rnn_cell.py ``LSTMCell``)
+splits the (B, 4H) gate pre-activations into four tensors and chains
+sigmoid/tanh/mul/add ops — at dispatch granularity that is ~10 memory
+passes over (B, H) for ~10 flops/element, squarely memory-bound.  The
+fused cell does the whole block in one pass:
+
+    i, f, g, o = gates            # static slices, gate order [i, f, c, o]
+    c = sigmoid(f) * c_prev + sigmoid(i) * tanh(g)
+    h = sigmoid(o) * tanh(c)
+
+Two tiers (package docstring):
+
+- :func:`lstm_cell_lax` — the fused-lax reference.  The per-element
+  operation sequence is IDENTICAL to the unfused composition, so forward
+  values are bit-equal and autodiff gradients match the unfused graph's
+  (tests/test_kernels.py pins both).  Differentiable by jax as-is.
+- :func:`lstm_cell_pallas` — a ``pl.pallas_call`` kernel pair behind
+  ``jax.custom_vjp`` (Pallas has no reverse-mode transpose — rtc.py
+  contract).  The backward kernel RECOMPUTES the gate activations
+  in-tile from the saved pre-activations instead of materializing them
+  (the FlashAttention discipline), so residuals are just (gates, c_prev).
+
+:func:`lstm_cell` routes by backend; the symbolic graph consumes the
+``_FusedLSTMCell`` op (``rnn_cell.LSTMCell`` emits it when
+``MXTPU_FUSED_KERNELS`` enables ``lstm_cell``), and the fused RNN op's
+``lax.scan`` (ops/nn.py ``rnn``) calls :func:`lstm_cell` directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lstm_cell", "lstm_cell_lax", "lstm_cell_pallas"]
+
+
+def lstm_cell_lax(gates, c_prev):
+    """Fused-lax reference: one traced function, unfused op order.
+
+    ``gates``: (B, 4H) pre-activations in gate order [i, f, c, o]
+    (i2h + h2h + biases already summed); ``c_prev``: (B, H).
+    Returns ``(h, c)``.
+    """
+    h = c_prev.shape[-1]
+    i = jax.nn.sigmoid(gates[..., 0 * h:1 * h])
+    f = jax.nn.sigmoid(gates[..., 1 * h:2 * h])
+    g = jnp.tanh(gates[..., 2 * h:3 * h])
+    o = jax.nn.sigmoid(gates[..., 3 * h:4 * h])
+    c = f * c_prev + i * g
+    new_h = o * jnp.tanh(c)
+    return new_h, c
+
+
+def _fwd_kernel(g_ref, c_ref, h_out, c_out):
+    """Pallas forward body: whole-block gate math in VMEM."""
+    h = c_ref.shape[-1]
+    gates = g_ref[...]
+    i = jax.nn.sigmoid(gates[..., 0 * h:1 * h])
+    f = jax.nn.sigmoid(gates[..., 1 * h:2 * h])
+    g = jnp.tanh(gates[..., 2 * h:3 * h])
+    o = jax.nn.sigmoid(gates[..., 3 * h:4 * h])
+    c = f * c_ref[...] + i * g
+    c_out[...] = c
+    h_out[...] = o * jnp.tanh(c)
+
+
+def _bwd_kernel(g_ref, c_ref, dh_ref, dc_ref, dg_out, dcp_out):
+    """Pallas backward body: recompute activations in-tile, emit
+    (dgates, dc_prev) from (dh, dc_next)."""
+    h = c_ref.shape[-1]
+    gates = g_ref[...]
+    i = jax.nn.sigmoid(gates[..., 0 * h:1 * h])
+    f = jax.nn.sigmoid(gates[..., 1 * h:2 * h])
+    g = jnp.tanh(gates[..., 2 * h:3 * h])
+    o = jax.nn.sigmoid(gates[..., 3 * h:4 * h])
+    c = f * c_ref[...] + i * g
+    tanh_c = jnp.tanh(c)
+    dh = dh_ref[...]
+    # dc accumulates the explicit cotangent and the h = o * tanh(c) path
+    dc = dc_ref[...] + dh * o * (1.0 - tanh_c * tanh_c)
+    do = dh * tanh_c * o * (1.0 - o)
+    di = dc * g * i * (1.0 - i)
+    df = dc * c_ref[...] * f * (1.0 - f)
+    dg = dc * i * (1.0 - g * g)
+    dg_out[...] = jnp.concatenate([di, df, dg, do], axis=-1)
+    dcp_out[...] = dc * f
+
+
+def _pallas_call(kernel, out_shapes, interpret):
+    from jax.experimental import pallas as pl
+
+    def call(*arrays):
+        kw = {}
+        if not interpret:
+            # compiled tier: pin operands to VMEM (the default memory
+            # space can land blocks in slow HBM — pallas_guide.md
+            # pitfall 1); the interpreter ignores memory spaces, so
+            # specs are omitted there
+            from jax.experimental.pallas import tpu as pltpu
+            spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+            kw = {"in_specs": [spec] * len(arrays),
+                  "out_specs": (spec, spec)}
+        return pl.pallas_call(
+            kernel,
+            out_shape=out_shapes(*arrays),
+            interpret=interpret,
+            **kw,
+        )(*arrays)
+    return call
+
+
+def _make_pallas(interpret):
+    fwd_call = _pallas_call(
+        _fwd_kernel,
+        lambda g, c: (jax.ShapeDtypeStruct(c.shape, c.dtype),) * 2,
+        interpret)
+    bwd_call = _pallas_call(
+        _bwd_kernel,
+        lambda g, c, dh, dc: (jax.ShapeDtypeStruct(g.shape, g.dtype),
+                              jax.ShapeDtypeStruct(c.shape, c.dtype)),
+        interpret)
+
+    @jax.custom_vjp
+    def cell(gates, c_prev):
+        return fwd_call(gates, c_prev)
+
+    def cell_fwd(gates, c_prev):
+        # residuals are the INPUTS only; the backward kernel recomputes
+        # every activation in-tile (nothing materialized between passes)
+        return fwd_call(gates, c_prev), (gates, c_prev)
+
+    def cell_bwd(res, cot):
+        gates, c_prev = res
+        dh, dc = cot
+        return bwd_call(gates, c_prev, dh, dc)
+
+    cell.defvjp(cell_fwd, cell_bwd)
+    return cell
+
+
+_pallas_compiled = None
+_pallas_interpret = None
+
+
+def lstm_cell_pallas(gates, c_prev, interpret=None):
+    """Pallas-tier fused cell (custom_vjp registered).  ``interpret``
+    defaults to auto (compiled on TPU, interpreter elsewhere — the
+    rtc.py convention, so tests exercise the same kernel code on CPU)."""
+    global _pallas_compiled, _pallas_interpret
+    if interpret is None:
+        from ..rtc import on_tpu
+        interpret = not on_tpu()
+    if interpret:
+        if _pallas_interpret is None:
+            _pallas_interpret = _make_pallas(True)
+        return _pallas_interpret(gates, c_prev)
+    if _pallas_compiled is None:
+        _pallas_compiled = _make_pallas(False)
+    return _pallas_compiled(gates, c_prev)
+
+
+def lstm_cell(gates, c_prev):
+    """Backend-routed fused LSTM cell: compiled Pallas on TPU, the
+    fused-lax reference elsewhere (interpret-mode Pallas is for parity
+    tests, not production CPU dispatch).  The compiled tier engages only
+    for (sublane, lane)-aligned shapes — H a lane multiple, rows a
+    sublane multiple — so tile-unaligned cells (H=200 etc.) take the
+    fused-lax path instead of paying Mosaic relayouts."""
+    from . import use_pallas
+    H = c_prev.shape[-1]
+    rows = int(np.prod(c_prev.shape[:-1]))
+    if use_pallas() and H % 128 == 0 and rows % 8 == 0:
+        return lstm_cell_pallas(gates, c_prev, interpret=False)
+    return lstm_cell_lax(gates, c_prev)
+
+
+# ---------------------------------------------------------------------------
+# symbolic surface: the op rnn_cell.LSTMCell emits when fusion is enabled
+# ---------------------------------------------------------------------------
+
+def _flc_infer(attrs, in_shapes):
+    g = in_shapes[0]
+    if g is None:
+        if len(in_shapes) > 1 and in_shapes[1] is not None:
+            c = tuple(in_shapes[1])
+            return [(c[0], 4 * c[1]), c], [c, c], []
+        return in_shapes, [None, None], []
+    c = (g[0], g[1] // 4)
+    return [tuple(g), c], [c, c], []
+
+
+def _register_op():
+    from ..ops.registry import OP_REGISTRY, register
+
+    if "_FusedLSTMCell" in OP_REGISTRY:  # idempotent under re-import
+        return
+
+    @register("_FusedLSTMCell", input_names=("gates", "prev_c"),
+              num_outputs=2, output_names=("h", "c"),
+              infer_shape=_flc_infer, hidden=True)
+    def _fused_lstm_cell(gates, prev_c):
+        """Fused LSTM gate block (mxnet_tpu/kernels/lstm_cell.py):
+        (B, 4H) pre-activations + previous cell -> (next_h, next_c)."""
+        return lstm_cell(gates, prev_c)
+
+    # late registration: the autogen nd/sym modules were populated at
+    # package import — self-inject like rtc.register_kernel does
+    from ..rtc import _inject
+    _inject("_FusedLSTMCell")
+
+
+_register_op()

@@ -1,0 +1,111 @@
+"""Analytic roofline accounting for the fused kernels.
+
+A kernel's best-case time on a device is bounded below by
+``max(flops / peak_flops, bytes_moved / mem_bandwidth)`` — the classic
+roofline.  ``bench.py roofline`` times each kernel fused-vs-unfused and
+reports the measured time against this bound, so the artifact shows not
+just "fused beat unfused" but HOW CLOSE to the machine each kernel runs
+and which side (compute or memory) binds it.
+
+``workload(name, **shape)`` returns the analytic ``(flops, bytes)`` for
+one kernel invocation at the given shapes, counting ideal traffic: every
+input read once, every output written once — exactly what a perfectly
+fused single pass moves.  The unfused composition's traffic is also
+reported (``unfused_bytes``): each intermediate materialized to memory
+and read back, which is the whole reason the fused kernels exist.
+
+Pure python/analytic on purpose — importable with no accelerator
+runtime (the CLI and docs examples use it standalone).
+"""
+from __future__ import annotations
+
+__all__ = ["workload", "roofline_seconds", "bound_side"]
+
+
+def _bn_act(n, c, hw, itemsize):
+    """Fused BatchNorm(+activation) training pass over NCHW data.
+
+    flops: ~2 passes over the data for the batch stats (sum, sumsq) and
+    ~4 ops/element for normalize+scale+shift+activate.
+    fused bytes: read x once for stats, read x once for normalize, write
+    y once, plus the tiny per-channel vectors.
+    unfused bytes: the composition additionally materializes the
+    normalized output and re-reads it for the activation (+2 passes).
+    """
+    elems = n * c * hw
+    flops = 6 * elems
+    chan = 6 * c * itemsize                 # gamma/beta/stats vectors
+    fused = (2 * elems + elems) * itemsize + chan
+    unfused = fused + 2 * elems * itemsize
+    return flops, fused, unfused
+
+
+def _lstm_cell(b, h, itemsize):
+    """Fused LSTM cell elementwise block: gates (B, 4H) + c_prev (B, H)
+    -> h, c.  ~10 transcendental-ish ops per hidden element.
+
+    fused: read gates + c_prev, write h + c.
+    unfused: the split/sigmoid/tanh/mul/add chain materializes ~7
+    intermediate (B, H) tensors (4 activated gates, candidate product,
+    forget product, tanh(c)) and re-reads each.
+    """
+    elems = b * h
+    flops = 10 * elems
+    fused = (4 * elems + elems + 2 * elems) * itemsize
+    unfused = fused + 2 * 7 * elems * itemsize
+    return flops, fused, unfused
+
+
+def _flash_attention(b, t, heads, d, itemsize):
+    """Attention over (B, T, H, D): 2 matmuls of 2*B*H*T*T*D flops plus
+    softmax (~5 flops/score).
+
+    fused (flash): q/k/v read once, output written once — the T x T
+    score matrix never exists.
+    unfused: scores and probabilities each materialized AND re-read
+    (4 passes over B*H*T*T).
+    """
+    scores = b * heads * t * t
+    flops = 2 * 2 * scores * d + 5 * scores
+    qkv = 3 * b * t * heads * d * itemsize
+    out = b * t * heads * d * itemsize
+    fused = qkv + out
+    unfused = fused + 4 * scores * itemsize
+    return flops, fused, unfused
+
+
+_WORKLOADS = {
+    "bn_act": _bn_act,
+    "lstm_cell": _lstm_cell,
+    "flash_attention": _flash_attention,
+}
+
+
+def workload(name, itemsize=4, **shape):
+    """Analytic cost of one fused-kernel invocation.
+
+    Returns ``{"flops", "fused_bytes", "unfused_bytes"}``.  Shapes:
+    ``bn_act(n, c, hw)``, ``lstm_cell(b, h)``,
+    ``flash_attention(b, t, heads, d)``.
+    """
+    if name not in _WORKLOADS:
+        raise KeyError("unknown kernel workload %r (have: %s)"
+                       % (name, sorted(_WORKLOADS)))
+    flops, fused, unfused = _WORKLOADS[name](itemsize=itemsize, **shape)
+    return {"flops": int(flops), "fused_bytes": int(fused),
+            "unfused_bytes": int(unfused)}
+
+
+def roofline_seconds(flops, nbytes, peak_flops, mem_bw):
+    """Lower-bound seconds for a kernel moving ``nbytes`` and computing
+    ``flops`` on a machine with the given peaks (flops/s, bytes/s)."""
+    t_c = flops / peak_flops if peak_flops else 0.0
+    t_m = nbytes / mem_bw if mem_bw else 0.0
+    return max(t_c, t_m)
+
+
+def bound_side(flops, nbytes, peak_flops, mem_bw):
+    """Which roofline side binds: 'compute' or 'memory'."""
+    t_c = flops / peak_flops if peak_flops else 0.0
+    t_m = nbytes / mem_bw if mem_bw else 0.0
+    return "compute" if t_c >= t_m else "memory"

@@ -914,10 +914,23 @@ def _rnn_split_params(params, num_layers, input_size, state_size,
 
 
 def _rnn_cell_step(mode, state_size):
+    # MXTPU_FUSED_KERNELS routing is resolved ONCE per trace (this
+    # factory runs at trace time): the fused cell does all gate math in
+    # one kernel pass (mxnet_tpu/kernels/lstm_cell.py — Pallas on TPU,
+    # fused-lax elsewhere, bit-identical op order either way)
+    fused_lstm = None
+    if mode == "lstm":
+        from ..kernels import fused_enabled
+        if fused_enabled("lstm_cell"):
+            from ..kernels.lstm_cell import lstm_cell as fused_lstm
+
     def step(carry, x_proj, w_h2h, b_h2h):
         if mode == "lstm":
             h, c = carry
             gates = x_proj + jnp.dot(h, w_h2h.T) + b_h2h
+            if fused_lstm is not None:
+                h, c = fused_lstm(gates, c)
+                return (h, c), h
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
             h = jax.nn.sigmoid(o) * jnp.tanh(c)

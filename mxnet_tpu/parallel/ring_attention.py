@@ -26,31 +26,28 @@ __all__ = ["ring_attention", "full_attention", "ring_attention_sharded"]
 
 
 def full_attention(q, k, v, causal=False, scale=None):
-    """Plain attention reference: q,k,v (B, T, H, D) -> (B, T, H, D)."""
+    """Single-device attention: q,k,v (B, T, H, D) -> (B, T, H, D).
+
+    Long sequences route through the tiled online-softmax kernel
+    (mxnet_tpu/kernels/flash_attention.py — Pallas on TPU, lax scan
+    elsewhere) when ``MXTPU_FUSED_KERNELS`` enables it: the (Tq x Tk)
+    score matrix then never materializes.  Short sequences (at most one
+    key block) and ``MXTPU_FUSED_KERNELS=0`` use the exact-softmax
+    reference below."""
     B, Tq, H, D = q.shape
     scale = scale or (1.0 / np.sqrt(D))
+    Tk = k.shape[1]
+    from ..kernels import fused_enabled
+    if fused_enabled("flash_attention"):
+        from ..kernels import flash_attention as fa
+        if Tk > fa.default_block():
+            return fa.flash_attention(q, k, v, causal=causal, scale=scale)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        Tk = k.shape[1]
         mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), Tk - Tq)
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-
-
-def _block_attn(q, k, v, scale, mask):
-    """One block's contribution: returns (unnormalized_out, row_max,
-    row_sumexp)."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    scores = jnp.where(mask, scores, -jnp.inf)
-    m = jnp.max(scores, axis=-1)                       # (B,H,Tq)
-    # guard fully-masked rows
-    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    p = jnp.exp(scores - m_safe[..., None])
-    p = jnp.where(mask, p, 0.0)
-    s = jnp.sum(p, axis=-1)                            # (B,H,Tq)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)          # (B,Tq,H,D)
-    return out, m_safe, s
 
 
 def _ring_body(axis_name, n_blocks, causal, scale, q, k0, v0, my_idx):
@@ -61,6 +58,13 @@ def _ring_body(axis_name, n_blocks, causal, scale, q, k0, v0, my_idx):
     acc = jnp.zeros((B, Tq, H, D), dtype=jnp.float32)
     m_run = jnp.full((B, H, Tq), -jnp.inf)
     s_run = jnp.zeros((B, H, Tq))
+
+    # each hop is ONE streaming-softmax accumulation step — the same
+    # online_update the flash-attention kernel runs per key block
+    # (mxnet_tpu/kernels/flash_attention.py), so ring attention IS the
+    # flash accumulation composed across devices and the two paths
+    # cannot drift numerically
+    from ..kernels.flash_attention import online_update
 
     def hop(carry, hop_idx):
         acc, m_run, s_run, k, v = carry
@@ -73,21 +77,13 @@ def _ring_body(axis_name, n_blocks, causal, scale, q, k0, v0, my_idx):
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((Tq, Tk), dtype=bool)
-        mask = mask[None, None]                        # (1,1,Tq,Tk)
-        out, m_blk, s_blk = _block_attn(q, k, v, scale, mask)
-        m_new = jnp.maximum(m_run, m_blk)
-        # rescale running stats to the new max
-        alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_new), 0.0)
-        beta = jnp.where(jnp.isfinite(m_blk) & (s_blk > 0),
-                         jnp.exp(m_blk - m_new), 0.0)
-        s_new = s_run * alpha + s_blk * beta
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + \
-            out * beta.transpose(0, 2, 1)[..., None]
+        acc, m_run, s_run = online_update(
+            acc, m_run, s_run, q, k, v, scale, mask[None, None])
         # pass K/V to the next device on the ring (ICI neighbor exchange)
         perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (acc, m_new, s_new, k, v), None
+        return (acc, m_run, s_run, k, v), None
 
     (acc, m_run, s_run, _, _), _ = lax.scan(
         hop, (acc, m_run, s_run, k0, v0), jnp.arange(n_blocks))

@@ -498,16 +498,22 @@ class SPMDTrainer(object):
                 # counts runs REACHING the abort threshold — so a bad run
                 # that ends between two deferred flushes still aborts at
                 # the next flush (the peak would otherwise be lost when
-                # consec resets).  The host reads all three lazily
-                # (flush_step_guard), never per-step.
-                total, consec, trips = extras["guard"]
+                # consec resets).  The host reads the counters lazily
+                # (flush_step_guard), never per-step — and they travel
+                # as ONE stacked i32[3] carry so each flush costs a
+                # single device->host transfer, not three (three scalar
+                # fetches were measurable per-step host work on the
+                # dispatch-bound LSTM path over a high-RTT device link).
+                g = extras["guard"]
+                total, consec, trips = g[0], g[1], g[2]
                 new_consec = jnp.where(finite, jnp.zeros_like(consec),
                                        consec + 1)
                 if maxbad > 0:
                     trips = trips + (new_consec == maxbad).astype(
                         trips.dtype)
-                new_extras["guard"] = (
-                    jnp.where(finite, total, total + 1), new_consec, trips)
+                new_extras["guard"] = jnp.stack(
+                    [jnp.where(finite, total, total + 1), new_consec,
+                     trips])
             if metric_fn is not None:
                 # in-graph metric accumulation from this step's own
                 # outputs and (pre-transform) labels; a guard-skipped
@@ -675,9 +681,8 @@ class SPMDTrainer(object):
         extras = {}
         if self.step_guard:
             if self._guard_acc is None:
-                self._guard_acc = (self._scalar_acc(0, np.int32),
-                                   self._scalar_acc(0, np.int32),
-                                   self._scalar_acc(0, np.int32))
+                self._guard_acc = self._scalar_acc(
+                    np.zeros(3, np.int32), np.int32)
                 self._trips_seen = 0
             extras["guard"] = self._guard_acc
         if self._metric_fn is not None:
@@ -757,9 +762,11 @@ class SPMDTrainer(object):
         if not self._guard_pending:
             return
         self._guard_pending = False
-        total = int(self._read_scalar(self._guard_acc[0])) + self._skip_base
-        consec = int(self._read_scalar(self._guard_acc[1]))
-        trips = int(self._read_scalar(self._guard_acc[2]))
+        # ONE device->host fetch for all three counters (stacked i32[3])
+        acc = np.asarray(self._read_scalar(self._guard_acc))
+        total = int(acc[0]) + self._skip_base
+        consec = int(acc[1])
+        trips = int(acc[2])
         delta = total - self._skipped_steps
         self.last_step_skipped = consec > 0
         self._consecutive_bad_steps = consec
@@ -1060,10 +1067,9 @@ class SPMDTrainer(object):
         data = self._eval_batch(batch_arrays)
         extras = {}
         if self.step_guard:
-            extras["guard"] = self._guard_acc or (
-                self._scalar_acc(0, np.int32),
-                self._scalar_acc(0, np.int32),
-                self._scalar_acc(0, np.int32))
+            extras["guard"] = self._guard_acc if self._guard_acc \
+                is not None else self._scalar_acc(np.zeros(3, np.int32),
+                                                  np.int32)
         if self._metric_fn is not None:
             extras["metric"] = self._metric_acc or (
                 self._scalar_acc(0.0, np.float32),

@@ -256,6 +256,15 @@ class LSTMCell(BaseRNNCell):
                                     num_hidden=self._num_hidden * 4,
                                     name="%sh2h" % name)
         gates = i2h + h2h
+        from ..kernels import fused_enabled
+        if fused_enabled("lstm_cell"):
+            # one-kernel gate math (mxnet_tpu/kernels/lstm_cell.py);
+            # MXTPU_FUSED_KERNELS=0 at symbol-build time restores the
+            # exact slice/activation graph below (parity-tested)
+            fused = symbol._FusedLSTMCell(gates, states[1],
+                                          name="%sfused" % name)
+            next_h, next_c = fused[0], fused[1]
+            return next_h, [next_h, next_c]
         slice_gates = symbol.SliceChannel(gates, num_outputs=4, axis=1,
                                           name="%sslice" % name)
         in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",

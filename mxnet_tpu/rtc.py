@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ops.registry import OP_REGISTRY, register
 
-__all__ = ["register_kernel", "elementwise_pallas_kernel", "MXRtc"]
+__all__ = ["register_kernel", "elementwise_pallas_kernel", "MXRtc",
+           "on_tpu"]
 
 
 def _inject(reg_name):
@@ -106,12 +107,18 @@ def register_kernel(name, fn=None, *, input_names=("data",), num_outputs=1,
     return _do
 
 
-def _on_tpu():
+def on_tpu():
+    """Whether a real TPU backend is available — the tier selector for
+    two-tier kernels (mxnet_tpu/kernels/): compiled Pallas on TPU, the
+    fused-lax reference (or ``interpret=True``) elsewhere."""
     try:
         return jax.default_backend() == "tpu" or any(
             d.platform == "tpu" for d in jax.devices())
     except Exception:  # noqa: BLE001
         return False
+
+
+_on_tpu = on_tpu  # historical private alias
 
 
 def elementwise_pallas_kernel(kernel_body, interpret=None):

@@ -185,6 +185,53 @@ def test_gate_data_service_keys_are_guarded(tmp_path):
     assert rep["regressions"][0]["key"] == "data_service_img_s"
 
 
+def test_gate_keys_cover_model_and_roofline_metrics():
+    """Satellite: model-level throughput (lstm_tok_s,
+    inception_bn_img_s) and the per-op roofline speedups are guarded —
+    a regression in any of them must block like everything else."""
+    assert "lstm_tok_s" in bench.GATE_KEYS
+    assert "inception_bn_img_s" in bench.GATE_KEYS
+    assert "roofline_*_speedup" in bench.GATE_KEYS
+
+
+def test_gate_roofline_prefix_keys_are_guarded(tmp_path):
+    base = dict(BASE, roofline_lstm_cell_speedup=4.0,
+                roofline_bn_act_speedup=1.3)
+    new = dict(base, roofline_lstm_cell_speedup=2.0)      # -50%
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "roofline_lstm_cell_speedup"
+    # a VANISHED roofline key (kernel dropped from the bench) also blocks
+    gone = {k: v for k, v in base.items()
+            if k != "roofline_bn_act_speedup"}
+    rep = bench.gate(_write(tmp_path / "n2.json", gone),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "roofline_bn_act_speedup"
+
+
+def test_roofline_bench_small_preset_proves_wins():
+    """The roofline mode's self-proof on the small preset: every fused
+    kernel reports fused/unfused timings, a roofline bound with its
+    binding side, and beats its unfused composition (the win each
+    kernel must prove in the artifact)."""
+    out = bench._roofline_bench(preset="small", trials=1)
+    for op in ("bn_act", "lstm_cell", "flash_attention"):
+        assert out["roofline_%s_fused_us" % op] > 0
+        assert out["roofline_%s_unfused_us" % op] > 0
+        assert out["roofline_%s_speedup" % op] > 0
+        assert out["roofline_%s_bound" % op] in ("memory", "compute")
+        assert out["roofline_%s_bound_us" % op] > 0
+        assert isinstance(out["roofline_%s_win" % op], bool)
+    assert out["roofline_peak_gflops"] > 0
+    assert out["roofline_mem_gbs"] > 0
+    # the LSTM cell is the dispatch-bound poster child: the fused pass
+    # must actually beat the op-by-op chain, not just tie it
+    assert out["roofline_lstm_cell_speedup"] > 1.0
+    assert out["roofline_lstm_cell_win"] is True
+
+
 def test_gate_skips_scaling_shape_on_1core_hosts(tmp_path):
     """A 1-core host's scaling rows are flat BY CONSTRUCTION: the
     matching note (on either side) exempts the scaling-SHAPE keys, so a

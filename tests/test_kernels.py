@@ -1,0 +1,504 @@
+"""Fused-kernel (mxnet_tpu/kernels/) bit-parity matrix and routing.
+
+Every fused kernel is checked against its unfused lax composition:
+
+- fused-lax tier: BITWISE equal in forward AND gradient (the fused
+  reference runs the identical per-element op sequence, so XLA computes
+  identical values) — at f32 and bf16, on odd/partial-tile shapes.
+- Pallas tier (``interpret=True`` on this CPU tier — the same kernel
+  code a TPU compiles): equal within the DOCUMENTED tolerances below.
+  The interpreter evaluates the same math but through pallas' own
+  load/store path, so exact bit equality is not guaranteed; observed
+  deviations are ~1e-7 (f32).
+- BN-into-conv folding reassociates float math by construction
+  (``conv(x, w*s)`` vs ``s * conv(x, w)``), so the eval-path fold is
+  tolerance-checked, never bitwise — the one documented exception.
+
+Plus: ``MXTPU_FUSED_KERNELS=0`` restores the exact pre-fusion graphs
+(symbol structure and executor plan), and the executor-level BN fusion
+trains bit-identically to the unfused composition.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.kernels import (bn_act as BA, flash_attention as FA,
+                               lstm_cell as LC, roofline as RL,
+                               enabled_kernels, fused_enabled)
+from mxnet_tpu.ops import nn as NN
+
+#: documented Pallas-interpret tolerances per dtype (forward; gradients
+#: get 10x the atol — the backward kernels recompute activations, one
+#: extra rounding step)
+TOL = {"float32": dict(rtol=1e-5, atol=1e-5),
+       "bfloat16": dict(rtol=5e-2, atol=5e-2)}
+
+
+def _xprog_close(a, b, msg=""):
+    """Cross-PROGRAM comparator (documented tolerance): fused and
+    unfused whole graphs are two different XLA programs, and CPU
+    dot-general partitioning can differ between them in the final bits
+    (observed only under full-suite load).  The kernel math itself is
+    bitwise-identical (the eager op-level tests above); whole-graph
+    forward/gradient parity is asserted to ~2 ULP of f32 instead."""
+    np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7, err_msg=msg)
+
+
+def _close(a, b, dtype, grad=False):
+    tol = dict(TOL[dtype])
+    if grad:
+        tol["atol"] *= 10
+    np.testing.assert_allclose(
+        np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+        **tol)
+
+
+# ---------------------------------------------------------------------------
+# LSTM cell
+# ---------------------------------------------------------------------------
+
+def _unfused_lstm(gates, c_prev):
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    return jax.nn.sigmoid(o) * jnp.tanh(c), c
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("shape", [(5, 7), (16, 128), (3, 50)])
+def test_lstm_cell_lax_bitwise(dtype, shape):
+    """Fused-lax forward AND gradient are bit-equal to the unfused
+    composition — f32 and bf16, odd/partial-tile shapes included."""
+    B, H = shape
+    rs = np.random.RandomState(0)
+    g = jnp.asarray(rs.randn(B, 4 * H)).astype(dtype)
+    c = jnp.asarray(rs.randn(B, H)).astype(dtype)
+    h1, c1 = _unfused_lstm(g, c)
+    h2, c2 = LC.lstm_cell_lax(g, c)
+    assert np.array_equal(np.asarray(h1), np.asarray(h2))
+    assert np.array_equal(np.asarray(c1), np.asarray(c2))
+
+    def loss(fn):
+        def run(g, c):
+            h, cc = fn(g, c)
+            return (h.astype(jnp.float32) ** 2).sum() \
+                + (cc.astype(jnp.float32) * 3).sum()
+        return jax.grad(run, argnums=(0, 1))(g, c)
+
+    for a, b in zip(loss(_unfused_lstm), loss(LC.lstm_cell_lax)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("shape", [(5, 7), (16, 128)])
+def test_lstm_cell_pallas_interpret_parity(dtype, shape):
+    """The Pallas kernel pair (interpret=True — the code a TPU compiles)
+    matches the unfused composition in forward and vjp within the
+    documented tolerance."""
+    B, H = shape
+    rs = np.random.RandomState(1)
+    g = jnp.asarray(rs.randn(B, 4 * H)).astype(dtype)
+    c = jnp.asarray(rs.randn(B, H)).astype(dtype)
+    h1, c1 = _unfused_lstm(g, c)
+    h2, c2 = LC.lstm_cell_pallas(g, c, interpret=True)
+    _close(h1, h2, dtype)
+    _close(c1, c2, dtype)
+
+    def loss(fn):
+        def run(g, c):
+            h, cc = fn(g, c)
+            return (h.astype(jnp.float32) ** 2).sum() \
+                + (cc.astype(jnp.float32) * 3).sum()
+        return jax.grad(run, argnums=(0, 1))(g, c)
+
+    ref = loss(_unfused_lstm)
+    got = loss(lambda g, c: LC.lstm_cell_pallas(g, c, interpret=True))
+    for a, b in zip(ref, got):
+        _close(a, b, dtype, grad=True)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm + activation
+# ---------------------------------------------------------------------------
+
+def _bn_inputs(dtype, shape=(4, 6, 5, 5)):
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(*shape)).astype(dtype)
+    c = shape[1]
+    gam = jnp.asarray(rs.rand(c) + 0.5).astype(dtype)
+    bet = jnp.asarray(rs.randn(c)).astype(dtype)
+    mm = jnp.zeros(c, jnp.float32)
+    mv = jnp.ones(c, jnp.float32)
+    return x, gam, bet, mm, mv
+
+
+@pytest.mark.parametrize("act", ["relu", "tanh", None])
+@pytest.mark.parametrize("is_train", [True, False])
+def test_bn_act_lax_bitwise(act, is_train):
+    x, gam, bet, mm, mv = _bn_inputs("float32")
+    o1, m1, v1 = NN.batch_norm(x, gam, bet, mm, mv, fix_gamma=False,
+                               is_train=is_train)
+    if act:
+        o1 = NN.activation(o1, act_type=act)
+    o2, m2, v2 = BA.fused_bn_act_lax(x, gam, bet, mm, mv, act_type=act,
+                                     fix_gamma=False, is_train=is_train)
+    assert np.array_equal(np.asarray(o1), np.asarray(o2))
+    assert np.array_equal(np.asarray(m1), np.asarray(m2))
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh"])
+def test_bn_act_pallas_interpret_parity(dtype, act):
+    """Pallas normalize+activate kernel pair vs the unfused graph:
+    forward and all three input gradients (the backward kernel's
+    per-block partial reductions included) — odd channel/row counts."""
+    x, gam, bet, mm, mv = _bn_inputs(dtype, shape=(3, 5, 7, 3))
+
+    def ref(x, gam, bet):
+        o, _, _ = NN.batch_norm(x, gam, bet, mm, mv, fix_gamma=False,
+                                is_train=True)
+        return NN.activation(o, act_type=act)
+
+    def pal(x, gam, bet):
+        o, _, _ = BA.fused_bn_act_pallas(
+            x, gam, bet, mm, mv, act_type=act, fix_gamma=False,
+            is_train=True, interpret=True)
+        return o
+
+    _close(ref(x, gam, bet), pal(x, gam, bet), dtype)
+    g1 = jax.grad(lambda *a: (ref(*a).astype(jnp.float32) ** 2).sum(),
+                  argnums=(0, 1, 2))(x, gam, bet)
+    g2 = jax.grad(lambda *a: (pal(*a).astype(jnp.float32) ** 2).sum(),
+                  argnums=(0, 1, 2))(x, gam, bet)
+    for a, b in zip(g1, g2):
+        _close(a, b, dtype, grad=True)
+
+
+def test_bn_fold_matches_unfused_eval():
+    """conv -> BN(+relu) inference with folded weights equals the
+    unfused graph within the DOCUMENTED fold tolerance (float
+    reassociation: w*s convolved vs conv then scaled)."""
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(2, 3, 8, 8).astype("f"))
+    w = jnp.asarray(rs.randn(6, 3, 3, 3).astype("f") * 0.2)
+    b = jnp.asarray(rs.randn(6).astype("f") * 0.1)
+    gam = jnp.asarray(rs.rand(6).astype("f") + 0.5)
+    bet = jnp.asarray(rs.randn(6).astype("f"))
+    mm = jnp.asarray(rs.randn(6).astype("f") * 0.1)
+    mv = jnp.asarray(rs.rand(6).astype("f") + 0.5)
+
+    conv = NN.convolution(x, w, b, kernel=(3, 3), pad=(1, 1), num_filter=6)
+    ref, _, _ = NN.batch_norm(conv, gam, bet, mm, mv, fix_gamma=False,
+                              is_train=False)
+    ref = NN.activation(ref, act_type="relu")
+    w2, b2 = BA.fold_bn_into_conv(w, b, gam, bet, mm, mv, fix_gamma=False)
+    got = NN.activation(
+        NN.convolution(x, w2, b2, kernel=(3, 3), pad=(1, 1), num_filter=6),
+        act_type="relu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _exact_attention(q, k, v, causal):
+    Tq, Tk = q.shape[1], k.shape[1]
+    # scale as a reciprocal MULTIPLY — the exact op full_attention uses,
+    # so the =0 route can be compared bitwise
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) \
+        * (1.0 / np.sqrt(q.shape[-1]))
+    if causal:
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    return jnp.einsum("bhqk,bkhd->bqhd",
+                      jax.nn.softmax(scores, axis=-1), v)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("T", [16, 37])
+def test_flash_attention_parity(dtype, causal, T):
+    """Tiled online-softmax (lax scan AND the Pallas kernel in
+    interpret mode) vs exact attention — non-block-aligned T included;
+    forward + gradient.  The streaming softmax reassociates the exp
+    sums, so this is the documented-tolerance comparison."""
+    rs = np.random.RandomState(4)
+    mk = lambda: jnp.asarray(rs.randn(2, T, 3, 8)).astype(dtype)
+    q, k, v = mk(), mk(), mk()
+    ref = _exact_attention(q, k, v, causal)
+    fl = FA.flash_attention_lax(q, k, v, causal=causal, block_k=16)
+    fp = FA.flash_attention_pallas(q, k, v, causal=causal, block=16,
+                                   interpret=True)
+    _close(ref, fl, dtype)
+    _close(ref, fp, dtype)
+    if dtype == "float32":
+        gr = jax.grad(lambda q: (_exact_attention(q, k, v, causal)
+                                 ** 2).sum())(q)
+        gl = jax.grad(lambda q: (FA.flash_attention_lax(
+            q, k, v, causal=causal, block_k=16) ** 2).sum())(q)
+        gp = jax.grad(lambda q: (FA.flash_attention_pallas(
+            q, k, v, causal=causal, block=16, interpret=True)
+            ** 2).sum())(q)
+        _close(gr, gl, dtype, grad=True)
+        _close(gr, gp, dtype, grad=True)
+
+
+def test_full_attention_routes_to_flash(monkeypatch):
+    """ring_attention.full_attention composes with the flash kernel for
+    long sequences when enabled, and restores the exact-softmax graph
+    under MXTPU_FUSED_KERNELS=0."""
+    from mxnet_tpu.parallel import ring_attention as RA
+    rs = np.random.RandomState(5)
+    mk = lambda: jnp.asarray(rs.randn(2, 40, 2, 8).astype("f"))
+    q, k, v = mk(), mk(), mk()
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    off = RA.full_attention(q, k, v, causal=True)
+    assert np.array_equal(np.asarray(off),
+                          np.asarray(_exact_attention(q, k, v, True)))
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    monkeypatch.setenv("MXTPU_FLASH_BLOCK", "16")
+    on = RA.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# routing / registry
+# ---------------------------------------------------------------------------
+
+def test_env_routing(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    assert enabled_kernels() == frozenset()
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    assert fused_enabled("lstm_cell") and fused_enabled("bn_act")
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "lstm_cell, bn_act")
+    assert enabled_kernels() == frozenset({"lstm_cell", "bn_act"})
+    assert not fused_enabled("flash_attention")
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "lstm_cell,bogus_kernel")
+    assert enabled_kernels() == frozenset({"lstm_cell"})
+
+
+def test_roofline_workloads_sane():
+    for name, shape in (("bn_act", dict(n=4, c=8, hw=49)),
+                        ("lstm_cell", dict(b=4, h=32)),
+                        ("flash_attention",
+                         dict(b=2, t=64, heads=2, d=16))):
+        w = RL.workload(name, **shape)
+        assert w["flops"] > 0
+        # the unfused composition always moves MORE bytes — that gap is
+        # the fusion win the roofline bench measures
+        assert w["unfused_bytes"] > w["fused_bytes"] > 0
+    assert RL.bound_side(10**12, 1, 10**12, 10**9) == "compute"
+    assert RL.bound_side(1, 10**12, 10**12, 10**9) == "memory"
+    with pytest.raises(KeyError):
+        RL.workload("nope")
+
+
+# ---------------------------------------------------------------------------
+# executor integration: BN fusion / folding, fused plans, parity with off
+# ---------------------------------------------------------------------------
+
+def _bn_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu", name="r1")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _named_init(ex, skip=("data", "softmax_label")):
+    for name in sorted(ex.arg_dict):
+        if name in skip:
+            continue
+        r = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+        ex.arg_dict[name][:] = \
+            (r.rand(*ex.arg_dict[name].shape).astype("f") - 0.5) * 0.4
+    for name in ex.aux_dict:
+        ex.aux_dict[name][:] = 1.0 if name.endswith("var") else 0.0
+
+
+def _run_bn_net(train):
+    rs = np.random.RandomState(0)
+    net = _bn_net()
+    ex = net.simple_bind(mx.cpu(), data=(4, 3, 8, 8))
+    _named_init(ex)
+    ex.arg_dict["data"][:] = rs.rand(4, 3, 8, 8).astype("f")
+    ex.arg_dict["softmax_label"][:] = rs.randint(0, 10, 4).astype("f")
+    out = ex.forward(is_train=train)[0].asnumpy()
+    grads, aux = {}, {}
+    if train:
+        ex.backward()
+        grads = {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                 if v is not None}
+        aux = {k: v.asnumpy() for k, v in ex.aux_dict.items()}
+    return out, grads, aux
+
+
+def test_executor_bn_fusion_train_parity(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    o_on, g_on, a_on = _run_bn_net(train=True)
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    o_off, g_off, a_off = _run_bn_net(train=True)
+    _xprog_close(o_on, o_off, "forward")
+    for k in g_off:
+        _xprog_close(g_on[k], g_off[k], k)
+    for k in a_off:
+        _xprog_close(a_on[k], a_off[k], k)
+
+
+def test_executor_bn_fold_eval_tolerance(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    o_on, _, _ = _run_bn_net(train=False)
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    o_off, _, _ = _run_bn_net(train=False)
+    np.testing.assert_allclose(o_on, o_off, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_plan_overrides_and_off_restores_plain(monkeypatch):
+    """Plan introspection: the fusion pass installs exactly one fused
+    BN entry + one passthrough Activation entry per pair, and
+    MXTPU_FUSED_KERNELS=0 leaves the plan untouched (the exact pre-PR
+    program)."""
+    from mxnet_tpu.executor import _fuse_bn_plan, _node_plan
+    net = _bn_net()
+    plan = _node_plan(net)
+    refs = [(id(n), i) for n, i in net._outputs]
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    fused = _fuse_bn_plan(plan, refs)
+    overridden = [e for e in fused if e[5] is not None]
+    assert len(overridden) == 2
+    names = sorted(e[0].name for e in overridden)
+    assert names == ["bn1", "r1"]
+    # the BN entry carries the conv's inputs as extra refs (fold path)
+    bn_entry = next(e for e in fused if e[0].name == "bn1")
+    assert len(bn_entry[5][1]) == 3          # conv data, weight, bias
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    assert _fuse_bn_plan(plan, refs) is plan
+    # bn_act alone (no fold): fused entries but no extra conv refs
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "bn_act")
+    act_only = _fuse_bn_plan(plan, refs)
+    bn_entry = next(e for e in act_only if e[0].name == "bn1")
+    assert bn_entry[5] is not None and len(bn_entry[5][1]) == 0
+
+
+def test_bn_output_consumed_twice_not_fused(monkeypatch):
+    """A BatchNorm whose output feeds anything besides its Activation
+    must stay unfused — the fusion is only sound for a private pair."""
+    from mxnet_tpu.executor import _fuse_bn_plan, _node_plan
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "bn_act")
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bnx")
+    act = mx.sym.Activation(bn, act_type="relu", name="rx")
+    net = mx.sym.Group([mx.sym.sum(act), mx.sym.sum(bn)])
+    plan = _node_plan(net)
+    refs = [(id(n), i) for n, i in net._outputs]
+    assert _fuse_bn_plan(plan, refs) is plan
+
+
+# ---------------------------------------------------------------------------
+# LSTM consumers: the fused RNN scan and the symbolic LSTMCell
+# ---------------------------------------------------------------------------
+
+def _run_lstm_lm():
+    from mxnet_tpu.models import lstm_lm
+    rs = np.random.RandomState(6)
+    sym, _, _ = lstm_lm.lstm_lm_sym(6, 50, num_embed=8, num_hidden=8,
+                                    num_layers=2)
+    ex = sym.simple_bind(mx.cpu(), data=(3, 6), softmax_label=(3, 6))
+    _named_init(ex)
+    ex.arg_dict["data"][:] = rs.randint(0, 50, (3, 6)).astype("f")
+    ex.arg_dict["softmax_label"][:] = rs.randint(0, 50, (3, 6)).astype("f")
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    return out, {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                 if v is not None}
+
+
+def test_rnn_op_fused_scan_parity(monkeypatch):
+    """The fused RNN op's lax.scan with the fused cell matches the
+    unfused scan — forward and every gradient (cross-program
+    comparator: see _xprog_close)."""
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    o1, g1 = _run_lstm_lm()
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    o2, g2 = _run_lstm_lm()
+    _xprog_close(o1, o2, "forward")
+    for k in g2:
+        _xprog_close(g1[k], g2[k], k)
+
+
+def _run_lstm_cell_sym():
+    from mxnet_tpu.rnn import rnn_cell as RC
+    rs = np.random.RandomState(7)
+    cell = RC.LSTMCell(16, prefix="l_")
+    outs, _ = cell.unroll(5, inputs=mx.sym.Variable("data"),
+                          merge_outputs=True)
+    net = mx.sym.sum(outs)
+    ex = net.simple_bind(mx.cpu(), data=(2, 5, 8))
+    _named_init(ex, skip=("data",))
+    ex.arg_dict["data"][:] = rs.rand(2, 5, 8).astype("f")
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    grads = {k: v.asnumpy() for k, v in ex.grad_dict.items()
+             if v is not None}
+    return out, grads, net.get_internals().list_outputs()
+
+
+def test_lstm_cell_symbolic_parity_and_graph_shape(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    o1, g1, internals_on = _run_lstm_cell_sym()
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    o2, g2, internals_off = _run_lstm_cell_sym()
+    _xprog_close(o1, o2, "forward")
+    for k in g2:
+        _xprog_close(g1[k], g2[k], k)
+    # graph structure: fused op present when on; =0 restores the exact
+    # pre-PR slice/activation graph
+    assert any("fused" in n for n in internals_on)
+    assert not any("fused" in n for n in internals_off)
+    assert any("slice" in n for n in internals_off)
+
+
+# ---------------------------------------------------------------------------
+# trainer guard carry (the single-fetch change riding with this PR)
+# ---------------------------------------------------------------------------
+
+def test_trainer_guard_counters_are_one_stacked_carry():
+    """The in-graph skip counters travel as ONE i32[3] array so each
+    flush costs a single device->host fetch (three scalar fetches were
+    per-step host work on the dispatch-bound LSTM path)."""
+    from mxnet_tpu.parallel import SPMDTrainer
+    rs = np.random.RandomState(8)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+        name="softmax")
+    tr = SPMDTrainer(net, "sgd", {"learning_rate": 0.1,
+                                  "rescale_grad": 0.25}, mesh=None)
+    tr.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    tr.init_params(mx.initializer.Xavier())
+    X = rs.rand(4, 6).astype("f")
+    y = rs.randint(0, 8, 4).astype("f")
+    try:
+        tr.step(X, y)
+        assert tuple(tr._guard_acc.shape) == (3,)
+        assert tr.skipped_steps == 0
+        tr.step(np.full_like(X, np.nan), y)
+        tr.flush_step_guard()
+        assert tr.skipped_steps == 1
+        assert tr.consecutive_bad_steps == 1
+        tr.step(X, y)
+        tr.flush_step_guard()
+        assert tr.consecutive_bad_steps == 0
+    finally:
+        tr.close()

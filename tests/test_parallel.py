@@ -118,9 +118,9 @@ def test_spmd_trainer_zero_collectives_in_hlo():
     X, y = make_blobs(64, 10, 4)
     data = trainer._shard_batch((X, y))
     import numpy as _np
-    extras = {"guard": (trainer._scalar_acc(0, _np.int32),
-                        trainer._scalar_acc(0, _np.int32),
-                        trainer._scalar_acc(0, _np.int32))}
+    # the step's guard carry: one stacked i32[3] (total, consec, trips)
+    extras = {"guard": trainer._scalar_acc(_np.zeros(3, _np.int32),
+                                           _np.int32)}
     lowered = trainer._step_fn.lower(
         trainer.params, trainer.aux, trainer.opt_state, extras, data,
         _random.peek_key(), jnp.asarray(0.3, jnp.float32),

@@ -103,9 +103,8 @@ def lower_cpu(batch=8, side=64):
     X = np.random.RandomState(0).rand(batch, 3, side, side).astype("f")
     y = np.random.RandomState(1).randint(0, 100, batch).astype("f")
     data = tr._shard_batch((X, y))
-    extras = {"guard": (tr._scalar_acc(0, np.int32),
-                        tr._scalar_acc(0, np.int32),
-                        tr._scalar_acc(0, np.int32))}
+    # the step's guard carry: one stacked i32[3] (total, consec, trips)
+    extras = {"guard": tr._scalar_acc(np.zeros(3, np.int32), np.int32)}
     lowered = tr._step_fn.lower(
         tr.params, tr.aux, tr.opt_state, extras, data, _random.peek_key(),
         jnp.asarray(0.1, jnp.float32), jnp.asarray(0.0, jnp.float32), 1)
