@@ -1403,6 +1403,153 @@ def _analyze_bench():
     return out
 
 
+def _zero3_bench(preset=None):
+    """Fully-sharded training sweep (docs/how_to/sharded_training.md):
+    allreduce vs zero vs zero3 on the standard MLP and a deliberately
+    WIDE model (params dominate activations — the regime zero3 exists
+    for), on the 8-virtual-device CPU mesh.
+
+    Self-proof keys: ``zero3_param_bytes_frac`` must show ~1/world
+    per-device parameter residency (plus the indivisible-param
+    residue), ``zero3_vs_zero_frac`` prices the on-demand gathers
+    against zero's monolithic gather block (acceptance: within 10%),
+    and ``zero3_schedule_ok`` runs trainer.analyze() so the artifact
+    records the PROVEN collective schedule, not an assumption.  Gate
+    keys: ``zero3_steps_s`` (throughput), ``zero3_param_shard_x``
+    (residency leverage — drops to ~1 if sharding silently breaks),
+    ``zero3_wide_mem_x`` (compiled peak-memory leverage on the wide
+    model from ``compiled.memory_analysis()``).
+    """
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import fixtures
+    from mxnet_tpu.parallel import SPMDTrainer, local_mesh
+
+    small = preset == "small"
+    steps = 10 if small else 30
+    warmup = 3 if small else 8
+    world = len(jax.devices())
+    out = {"zero3_world": world}
+
+    def _wide_sym(nh=2048, nc=8):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=nh, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=nc, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def _measure(make_trainer, X, y):
+        res = {}
+        for sync in ("allreduce", "zero", "zero3"):
+            trainer = make_trainer(sync)
+            full = sum(int(np.prod(v.shape)) *
+                       np.dtype(v.dtype).itemsize
+                       for v in trainer.params.values())
+            resident = sum(v.addressable_shards[0].data.nbytes
+                           for v in trainer.params.values())
+            opt_res = sum(x.addressable_shards[0].data.nbytes
+                          for s in trainer.opt_state.values() for x in s)
+            args = trainer._example_args(X, y)
+            compiled = trainer._step_fn.lower(*args).compile()
+            try:
+                ma = compiled.memory_analysis()
+                peak = int(getattr(ma, "argument_size_in_bytes", 0) +
+                           getattr(ma, "temp_size_in_bytes", 0))
+            except Exception:  # noqa: BLE001 — backend without the API
+                peak = None
+            for _ in range(warmup):
+                trainer.step(X, y)
+            small_p = min(trainer.params,
+                          key=lambda k: trainer.params[k].size)
+
+            def sync_dev():
+                np.asarray(
+                    trainer.params[small_p].addressable_shards[0].data)
+
+            sync_dev()
+            tic = time.perf_counter()
+            for _ in range(steps):
+                trainer.step(X, y)
+            sync_dev()
+            elapsed = time.perf_counter() - tic
+            entry = {"steps_s": round(steps / elapsed, 2),
+                     "param_bytes": full,
+                     "param_resident_bytes": resident,
+                     "param_bytes_frac": round(resident / full, 4),
+                     "opt_resident_bytes": opt_res}
+            if peak:
+                entry["peak_bytes"] = peak
+            if sync == "zero3":
+                entry["tier"] = trainer.zero3_tier
+                rep = trainer.analyze(X, y)
+                coll = rep.stats.get("collectives", {})
+                entry["collectives"] = coll
+                entry["schedule_ok"] = bool(
+                    rep.ok and coll.get("reduce-scatter", {}).get("count")
+                    and coll.get("all-gather", {}).get("count"))
+            trainer.close()
+            res[sync] = entry
+        return res
+
+    # standard MLP — the fixture every analyze/lint consumer pins
+    X, y = fixtures.standard_mlp_batch()
+    std = _measure(
+        lambda sync: fixtures.standard_mlp_trainer(grad_sync=sync), X, y)
+    out["zero3_steps_s"] = std["zero3"]["steps_s"]
+    out["zero3_zero_steps_s"] = std["zero"]["steps_s"]
+    out["zero3_allreduce_steps_s"] = std["allreduce"]["steps_s"]
+    out["zero3_vs_zero_frac"] = round(
+        std["zero3"]["steps_s"] / std["zero"]["steps_s"], 3)
+    out["zero3_param_bytes_frac"] = std["zero3"]["param_bytes_frac"]
+    out["zero3_param_shard_x"] = round(
+        1.0 / max(std["zero3"]["param_bytes_frac"], 1e-9), 2)
+    out["zero3_frac_ok"] = bool(
+        std["zero3"]["param_bytes_frac"] <= 1.0 / world + 0.05)
+    out["zero3_tier"] = std["zero3"].get("tier")
+    out["zero3_collectives"] = std["zero3"].get("collectives")
+    out["zero3_schedule_ok"] = std["zero3"].get("schedule_ok")
+
+    # deliberately wide model: params >> activations, batch small
+    nh = 512 if small else 2048
+    din = 128 if small else 512
+    rs = np.random.RandomState(0)
+    Xw = rs.randn(32, din).astype("f")
+    yw = rs.randint(0, 8, 32).astype("f")
+    sym = _wide_sym(nh=nh)
+
+    def _wide_trainer(sync):
+        t = SPMDTrainer(sym, "sgd",
+                        {"learning_rate": 0.05, "momentum": 0.9,
+                         "rescale_grad": 1.0 / 32},
+                        mesh=local_mesh("dp"), grad_sync=sync)
+        t.bind([("data", (32, din))], [("softmax_label", (32,))])
+        mx.random.seed(7)
+        t.init_params(mx.initializer.Xavier())
+        return t
+
+    wide = _measure(_wide_trainer, Xw, yw)
+    out["zero3_wide_steps_s"] = wide["zero3"]["steps_s"]
+    out["zero3_wide_param_bytes_frac"] = \
+        wide["zero3"]["param_bytes_frac"]
+    if wide["zero3"].get("peak_bytes") and \
+            wide["allreduce"].get("peak_bytes"):
+        out["zero3_wide_peak_mb"] = round(
+            wide["zero3"]["peak_bytes"] / 1e6, 2)
+        out["zero3_allreduce_wide_peak_mb"] = round(
+            wide["allreduce"]["peak_bytes"] / 1e6, 2)
+        out["zero3_wide_mem_x"] = round(
+            wide["allreduce"]["peak_bytes"] /
+            wide["zero3"]["peak_bytes"], 2)
+    else:
+        # a backend without compiled.memory_analysis() cannot measure
+        # the key at all — mark it structurally unmeasurable so the
+        # self-gate SKIPS the comparison instead of reporting a
+        # vanished metric (same contract as the 1-core scaling notes)
+        out["zero3_mem_note"] = "unavailable_memory_analysis"
+    return out
+
+
 def _run_mode(mode):
     """One metric, current process.  Prints a partial-JSON line."""
     batch = _env_int("BENCH_BATCH", 32)
@@ -1423,12 +1570,12 @@ def _run_mode(mode):
         mode = "data-service"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
                 "resume", "checkpoint", "analyze", "serve",
-                "data-service", "roofline"):
+                "data-service", "roofline", "zero3"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
-        if mode == "analyze":
-            # the graph audit lints the dp=8 fused step on a virtual mesh
+        if mode in ("analyze", "zero3"):
+            # these lint/shard the dp=8 fused step on a virtual mesh
             flags = os.environ.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
                 os.environ["XLA_FLAGS"] = (
@@ -1438,6 +1585,8 @@ def _run_mode(mode):
         jax.config.update("jax_platforms", "cpu")
     if mode == "analyze":
         out.update(_analyze_bench())
+    elif mode == "zero3":
+        out.update(_zero3_bench())
     elif mode == "roofline":
         out.update(_roofline_bench())
     elif mode == "serve":
@@ -1509,8 +1658,8 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "fed-cpu", "pipeline",
     "compile-probe", "resume", "checkpoint", "analyze", "serve",
-    "roofline", "fed", "compute", "compute-large", "inception-bn",
-    "resnet-152", "lstm",
+    "roofline", "zero3", "fed", "compute", "compute-large",
+    "inception-bn", "resnet-152", "lstm",
 ))
 
 
@@ -1578,16 +1727,20 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
              "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup",
              "data_service_img_s", "data_service_scaling_x",
-             "pipeline_decode_scaling_x", "roofline_*_speedup")
+             "pipeline_decode_scaling_x", "roofline_*_speedup",
+             "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x")
 
-#: scaling-SHAPE keys: flat by construction on a 1-core host (the
-#: decode threads/worker processes have nowhere to scale TO), so when
-#: either artifact carries the matching flat_by_construction note the
-#: comparison is skipped — a 1-core CI box can neither mask nor fake a
-#: scaling regression.  The absolute-throughput keys above still gate.
+#: structurally-unmeasurable keys: each maps to a NOTE key whose
+#: presence (``flat_by_construction*`` on 1-core hosts — the decode
+#: threads/worker processes have nowhere to scale TO — or
+#: ``unavailable*`` when the backend lacks the measurement API) makes
+#: the gate SKIP that one comparison; a host that CAN measure still
+#: gates, so the note can neither mask nor fake a regression.  The
+#: absolute-throughput keys above always gate.
 SCALING_SHAPE_KEYS = {
     "pipeline_decode_scaling_x": "decode_scaling_note",
     "data_service_scaling_x": "data_service_scaling_note",
+    "zero3_wide_mem_x": "zero3_mem_note",
 }
 
 
@@ -1677,12 +1830,12 @@ def gate(new_path, against=None, tolerance=0.10):
         return {"pass": False, "error": "baseline %s holds no parsed "
                 "result" % base_path}
     regressions, checked, skipped = [], [], []
+    structural = ("flat_by_construction", "unavailable")
     for key in sorted(_match_gate_keys(base)):
         note = SCALING_SHAPE_KEYS.get(key)
         if note is not None and (
-                str(base.get(note, "")).startswith("flat_by_construction")
-                or str(new.get(note, "")).startswith(
-                    "flat_by_construction")):
+                str(base.get(note, "")).startswith(structural)
+                or str(new.get(note, "")).startswith(structural)):
             skipped.append(key)
             continue
         old_v = base[key]
@@ -1771,6 +1924,7 @@ def main():
         parts.update(_collect("checkpoint"))
         parts.update(_collect("serve"))
         parts.update(_collect("roofline"))
+        parts.update(_collect("zero3"))
         parts.update(_collect("fed"))
     parts.update(_collect("analyze", timeout=240))
     parts.update(_collect("compute"))
@@ -1839,7 +1993,8 @@ def main():
         if k in parts:
             result[k] = parts[k]
     for k in sorted(parts):
-        if k.startswith("serve_") or k.startswith("roofline_"):
+        if k.startswith("serve_") or k.startswith("roofline_") \
+                or k.startswith("zero3_"):
             result[k] = parts[k]
     if compute is not None:
         if fed is None:

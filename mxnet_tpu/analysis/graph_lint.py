@@ -21,6 +21,12 @@ of the invariants the runtime relies on:
   declared sharding should not need it (replicated params under plain dp
   'allreduce'), at or above a meaningful fraction of the parameter
   bytes — the GSPMD signature of an accidental full-parameter regather.
+- ``graph-collective-schedule``: the inverse direction — a step that
+  DECLARED fully-sharded training (grad_sync='zero3') must actually
+  all-gather ~param bytes and reduce-scatter its gradients; missing
+  gathers or a param-scale all-reduce mean the sharding silently never
+  happened.  ``trainer.analyze()`` under zero3 is thereby the PROOF the
+  collective schedule matches the declared strategy.
 - ``graph-dtype-drift``: dot/conv equations computing in a wider float
   than the declared ``compute_dtype`` — silent f32 math inside a bf16
   step costs ~2x FLOP time on the MXU.
@@ -39,7 +45,8 @@ import re
 from .report import Finding, Report
 
 __all__ = ["iter_eqns", "find_callbacks", "audit_dtype", "audit_donation",
-           "collective_stats", "audit_collectives", "find_unprotected_pallas",
+           "collective_stats", "audit_collectives",
+           "audit_collective_schedule", "find_unprotected_pallas",
            "lint_lowered", "lint_jit", "CALLBACK_PRIMITIVES",
            "COLLECTIVE_OPS", "PALLAS_PRIMITIVES"]
 
@@ -94,6 +101,27 @@ _COLLECTIVE_RE = re.compile(
     r"=\s*(?P<type>(?:\([^)]*\)|[a-z][a-z0-9]*\[[^\]]*\][^\s]*))\s*"
     r"(?P<op>" + "|".join(re.escape(o) for o in COLLECTIVE_OPS) + r")"
     r"(?P<suffix>-start|-done)?\(")
+
+# replica_groups={{0,1},{2,3}} (explicit) or [2,4]<=[8] (iota v2:
+# num_groups, devices_per_group) on the same instruction line
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(?:\{\{(?P<first>[0-9, ]*)\}|"
+    r"\[(?P<groups>\d+),(?P<size>\d+)\]<=)")
+
+
+def _is_degenerate_groups(line):
+    """True when the instruction's replica_groups are singletons (each
+    device alone) — the partitioner's representation of a NO-OP
+    collective that moves zero bytes across devices.  GSPMD emits these
+    to materialize per-device partial values; counting them as traffic
+    would make the schedule audit see phantom all-reduces.  Lines with
+    no replica_groups at all (hand-written fixtures) count as real."""
+    m = _REPLICA_GROUPS_RE.search(line)
+    if m is None:
+        return False
+    if m.group("size") is not None:
+        return int(m.group("size")) <= 1
+    return "," not in (m.group("first") or "")
 
 
 def _eqn_location(eqn):
@@ -312,10 +340,18 @@ def collective_stats(hlo_text):
     context buffers rank below both), and either of the two for the
     size-preserving ops.  A byte figure of 0 with nonzero count means
     shapes were unparseable (report still useful for counts).
+
+    Degenerate instructions — ``replica_groups`` of singletons, the
+    partitioner's zero-traffic way of materializing per-device partial
+    values — are skipped entirely: they move no bytes between devices,
+    and the schedule audit must not mistake them for real traffic.
     """
     stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
-    for m in _COLLECTIVE_RE.finditer(hlo_text):
-        if m.group("suffix") == "-done":
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        if _is_degenerate_groups(line):
             continue
         op = m.group("op")
         stats[op]["count"] += 1
@@ -368,8 +404,73 @@ def audit_collectives(stats, param_bytes=None, expect_allgather=False,
         data={"all_gather": ag, "param_bytes": param_bytes})]
 
 
+def audit_collective_schedule(stats, schedule, expect_gather_bytes,
+                              tolerance=0.25):
+    """``graph-collective-schedule``: under a DECLARED fully-sharded
+    strategy the compiled schedule must actually be sharded.
+
+    ``schedule`` is ``'zero3-manual'`` or ``'zero3-gspmd'`` (None
+    disables the rule); ``expect_gather_bytes`` is the per-step forward
+    gather traffic a correct step must move (the full-size comm-dtype
+    bytes of every dp-sharded parameter — the trainer computes it from
+    base sharding rules and shapes, so a broken override cannot lower
+    the bar).  Checks:
+
+    - all-gather traffic >= (1 - tolerance) x expected — a zero3 step
+      that moves less is NOT gathering its parameters, i.e. they were
+      silently left replicated and the sharding never happened;
+    - a stray full all-reduce: all-reduce traffic at or above HALF the
+      expected gather bytes means gradients left the backward as a
+      full all-reduce instead of reduce-scatter (under the manual tier
+      the only legitimate all-reduces are indivisible-param residue and
+      scalar guard/metric/loss reductions, orders of magnitude below);
+    - manual tier only: at least one real reduce-scatter instruction —
+      the tier emits them by construction, so absence means the step
+      was not built from the declared formulation.  The gspmd tier's
+      gradient reduction is backend-placed (XLA's ReduceScatterCreator
+      rewrites all-reduce+slice on TPU/GPU; CPU keeps the all-reduce
+      form), so that tier asserts the gathers and reports the rest in
+      ``stats`` without flagging.
+    """
+    if not schedule:
+        return []
+    findings = []
+    ag = stats.get("all-gather", {"count": 0, "bytes": 0})
+    rs = stats.get("reduce-scatter", {"count": 0, "bytes": 0})
+    ar = stats.get("all-reduce", {"count": 0, "bytes": 0})
+    expect = int(expect_gather_bytes or 0)
+    if expect and ag["bytes"] < (1.0 - tolerance) * expect:
+        findings.append(Finding(
+            "graph-collective-schedule",
+            "declared %s but the compiled step all-gathers only %d "
+            "bytes/step of the >= %d expected for its sharded "
+            "parameters — the params were left replicated; the "
+            "sharding silently never happened" %
+            (schedule, ag["bytes"], expect),
+            data={"all_gather": ag, "expect_gather_bytes": expect}))
+    if expect and ar["bytes"] >= 0.5 * expect and \
+            schedule == "zero3-manual":
+        findings.append(Finding(
+            "graph-collective-schedule",
+            "declared %s but a param-scale all-reduce (%d bytes/step) "
+            "is in the compiled schedule — gradients are leaving the "
+            "backward as a full all-reduce instead of reduce-scatter" %
+            (schedule, ar["bytes"]),
+            data={"all_reduce": ar, "expect_gather_bytes": expect}))
+    if schedule == "zero3-manual" and expect and not rs["count"]:
+        findings.append(Finding(
+            "graph-collective-schedule",
+            "declared %s but the compiled step contains no "
+            "reduce-scatter — the manual tier emits one per gather "
+            "bucket by construction, so the step was not built from "
+            "the declared formulation" % (schedule,),
+            data={"reduce_scatter": rs}))
+    return findings
+
+
 def lint_lowered(lowered, closed_jaxpr=None, compute_dtype=None,
                  param_bytes=None, expect_allgather=True,
+                 schedule=None, expect_gather_bytes=None,
                  min_donate_bytes=1 << 20, carry_argnums=None,
                  compiled_text=None):
     """Run every graph rule against one lowered step.
@@ -397,6 +498,12 @@ def lint_lowered(lowered, closed_jaxpr=None, compute_dtype=None,
     rep.stats["collectives"] = stats
     rep.extend(audit_collectives(stats, param_bytes=param_bytes,
                                  expect_allgather=expect_allgather))
+    rep.extend(audit_collective_schedule(
+        stats, schedule, expect_gather_bytes))
+    if schedule:
+        rep.stats["schedule"] = {
+            "declared": schedule,
+            "expect_gather_bytes": int(expect_gather_bytes or 0)}
     return rep
 
 

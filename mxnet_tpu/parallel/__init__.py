@@ -25,6 +25,7 @@ Components:
 from .compat import HAS_SHARD_MAP
 from .mesh import build_mesh, default_mesh, local_mesh
 from .trainer import SPMDTrainer
+from . import zero3  # noqa: F401 — EAGER env registration (MXTPU_ZERO3_*)
 from .spmd_module import SPMDModule
 from . import ring_attention
 from .ring_attention import ring_attention as ring_attention_fn

@@ -23,7 +23,8 @@ __all__ = ["SPMDModule"]
 class SPMDModule(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging, mesh=None,
-                 param_shardings=None, data_axis="dp", compute_dtype=None):
+                 param_shardings=None, data_axis="dp", compute_dtype=None,
+                 grad_sync=None):
         super().__init__(logger=logger)
         self._symbol = symbol
         self._data_names = list(data_names)
@@ -32,6 +33,9 @@ class SPMDModule(BaseModule):
         self._param_shardings = param_shardings
         self._data_axis = data_axis
         self._compute_dtype = compute_dtype
+        # 'allreduce' | 'zero' | 'zero3' (None follows MXNET_GRAD_SYNC);
+        # forwarded to the SPMDTrainer built at init_optimizer
+        self._grad_sync = grad_sync
         self._trainer = None
         self._optimizer_spec = ("sgd", {})
 
@@ -73,7 +77,8 @@ class SPMDModule(BaseModule):
             mesh=self._mesh if self._mesh is not None else None,
             data_axis=self._data_axis,
             param_shardings=self._param_shardings,
-            compute_dtype=self._compute_dtype)
+            compute_dtype=self._compute_dtype,
+            grad_sync=self._grad_sync)
         self._trainer.bind(self._data_shapes, self._label_shapes)
         initializer, arg_params, aux_params = self._init_args
         self._trainer.init_params(initializer, arg_params, aux_params)

@@ -40,7 +40,10 @@ SUPPORTED_OPTIMIZERS = ("sgd", "ccsgd", "adam", "rmsprop")
 ENV_GRAD_SYNC = register_env(
     "MXNET_GRAD_SYNC", default="allreduce",
     doc="Gradient sync for the fused dp step: allreduce (replicated "
-        "params) or zero (ZeRO/FSDP weight-sharded data parallelism)")
+        "params), zero (ZeRO weight-sharded data parallelism, one "
+        "gather block at step start) or zero3 (fully sharded: "
+        "layer-grouped on-demand gathers, backward re-gather, "
+        "reduce-scatter gradients)")
 
 #: guard-counter flush cadence when deferred metrics are installed with no
 #: explicit MXTPU_METRIC_INTERVAL (interval 0 = fold metrics on reads
@@ -111,14 +114,36 @@ class SPMDTrainer(object):
         #     rank-0-only idiom, safe under 'allreduce' because
         #     replicated values are read locally) would deadlock; gather
         #     on every rank, then write from rank 0 only.
+        #   'zero3' — fully sharded (ZeRO-3/FSDP): same sharded master
+        #     params + optimizer state as 'zero', but the step gathers
+        #     each parameter GROUP on demand (group boundaries keyed by
+        #     the executor plan's topological order, bucketed per
+        #     MXTPU_ZERO3_GATHER_GROUP layers), the backward RE-GATHERS
+        #     instead of keeping replicated copies alive across the
+        #     fwd/bwd boundary (jax.checkpoint policy dropping the
+        #     tagged gathers), and gradients leave the backward as
+        #     reduce-scatter.  Two tiers (parallel/zero3.py): a manual
+        #     shard_map formulation on pure-dp meshes whose collective
+        #     schedule is guaranteed on every backend, and a GSPMD
+        #     formulation on multi-axis meshes (dp x tp/ep/pp
+        #     composition).  trainer.analyze()'s
+        #     graph-collective-schedule rule PROVES the compiled
+        #     schedule matches the declaration.
         if grad_sync is None:
             grad_sync = get_env(ENV_GRAD_SYNC, "allreduce")
-        if grad_sync not in ("allreduce", "zero"):
-            raise MXNetError("grad_sync must be 'allreduce' or 'zero', "
-                             "got %r" % (grad_sync,))
+        if grad_sync not in ("allreduce", "zero", "zero3"):
+            raise MXNetError(
+                "grad_sync must be 'allreduce', 'zero' or 'zero3', "
+                "got %r" % (grad_sync,))
         self.grad_sync = grad_sync
-        self._zero = grad_sync == "zero" and mesh is not None and \
-            mesh.shape.get(data_axis, 1) > 1
+        # _zero: sharded-master placement (zero AND zero3 share the
+        # _param_spec machinery and the gathering eval path)
+        self._zero = grad_sync in ("zero", "zero3") and mesh is not None \
+            and mesh.shape.get(data_axis, 1) > 1
+        self._zero3 = grad_sync == "zero3" and self._zero
+        self.zero3_tier = None      # set at bind(): 'manual' | 'gspmd'
+        self._zero3_dims = {}       # param -> dp-sharded dim index
+        self._zero3_groups = []     # topo-ordered gather groups
         # remat/mirror: rematerialize the forward inside the backward
         # (reference MXNET_BACKWARD_DO_MIRROR memory mode)
         if remat is None:
@@ -251,8 +276,55 @@ class SPMDTrainer(object):
         self.optimizer.set_lr_mult({})
         self.optimizer.lr_mult.update(user_lr)
         self.optimizer.wd_mult.update(user_wd)
+        if self._zero3:
+            self._plan_zero3()
         self._build_step()
         return self
+
+    def _plan_zero3(self):
+        """Choose the zero3 tier and plan the gather groups (bind time).
+
+        A parameter participates in the grouped gathers when its
+        resolved spec shards EXACTLY the dp axis on one dimension
+        (_param_spec's dp-derived shard or an explicit dp rule);
+        explicit tp/ep/pp rules and indivisible params stay outside the
+        groups (GSPMD handles the former, the latter remain replicated
+        with plain psum gradients — correct either way).
+
+        Tier: 'manual' (shard_map body, guaranteed all-gather/
+        reduce-scatter schedule) needs a pure-dp mesh, a shard_map
+        spelling, batch-leading outputs and at least one shardable
+        param; anything else composes through the 'gspmd' tier.
+        """
+        from ..base import get_env
+        from . import zero3 as z3
+        from .zero3 import ENV_ZERO3_GATHER_GROUP
+        from .compat import HAS_SHARD_MAP
+        shardable = {}
+        for name in self.param_names:
+            spec = self._param_spec(name, self.arg_shapes[name])
+            entries = tuple(spec)
+            if not entries or any(
+                    e not in (None, self.data_axis) for e in entries):
+                continue
+            dims = [i for i, e in enumerate(entries)
+                    if e == self.data_axis]
+            if len(dims) == 1:
+                shardable[name] = dims[0]
+        self._zero3_dims = shardable
+        try:
+            group_layers = int(
+                get_env(ENV_ZERO3_GATHER_GROUP, "1") or 1)
+        except (TypeError, ValueError):
+            group_layers = 1
+        self._zero3_groups = z3.plan_gather_groups(
+            self.symbol, sorted(shardable), group_layers)
+        pure_dp = tuple(self.mesh.axis_names) == (self.data_axis,)
+        batch_leading = all(s and s[0] == self.batch_size
+                            for s in self.out_shapes)
+        self.zero3_tier = "manual" if (
+            pure_dp and HAS_SHARD_MAP and batch_leading and shardable
+        ) else "gspmd"
 
     def init_params(self, initializer, arg_params=None, aux_params=None):
         from ..ndarray import zeros as nd_zeros
@@ -400,12 +472,8 @@ class SPMDTrainer(object):
 
     def _build_step(self):
         eval_fn = self._eval
-        param_names = tuple(self.param_names)
         compute_dtype = self.compute_dtype
         transforms = dict(self.input_transforms)
-        guard = self.step_guard
-        metric_fn = self._metric_fn
-        maxbad = self.max_consecutive_bad_steps
 
         def xform(data):
             if not transforms:
@@ -454,78 +522,17 @@ class SPMDTrainer(object):
             outs, vjp_fn, auxu = jax.vjp(loss_fn, full, has_aux=True)
             heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads, = vjp_fn(heads)
-            if guard:
-                # all-finite over every RAW gradient, folded into the same
-                # XLA program (one fused reduction tree, replicated scalar
-                # under GSPMD) — the in-graph analog of DynamicLossScale /
-                # Orbax-era skip-step guards
-                finite = jnp.asarray(True)
-                for name in param_names:
-                    finite = jnp.logical_and(
-                        finite, jnp.all(jnp.isfinite(grads[name])))
-            new_params, new_state = {}, {}
-            for name in param_names:
-                g = grads[name]
-                if zero:
-                    # constrain each gradient (still compute dtype) to
-                    # its param's dp shard: GSPMD lowers the batch-psum +
-                    # shard slice to a ReduceScatter issued as soon as
-                    # the grad exists during backward
-                    g = jax.lax.with_sharding_constraint(
-                        g, self._sharding(
-                            self._param_spec(name, g.shape)))
-                g = g.astype(params[name].dtype)
-                w, s = self._apply_update(name, params[name], g,
-                                          opt_state[name], lr, wd, t)
-                if guard:
-                    # non-finite step: params AND optimizer state pass
-                    # through unchanged (selects fuse into the update)
-                    w = jnp.where(finite, w, params[name])
-                    s = tuple(jnp.where(finite, sn, so)
-                              for sn, so in zip(s, opt_state[name]))
-                new_params[name] = w
-                new_state[name] = s
-            new_aux = dict(aux)
-            new_aux.update(auxu)
-            new_extras = {}
-            if guard:
-                # BN moving stats computed from a poisoned batch must not
-                # stick either
-                for name, v in auxu.items():
-                    new_aux[name] = jnp.where(finite, v, aux[name])
-                # in-graph skip accounting: totals accumulate, the
-                # consecutive run resets on any good step, and ``trips``
-                # counts runs REACHING the abort threshold — so a bad run
-                # that ends between two deferred flushes still aborts at
-                # the next flush (the peak would otherwise be lost when
-                # consec resets).  The host reads the counters lazily
-                # (flush_step_guard), never per-step — and they travel
-                # as ONE stacked i32[3] carry so each flush costs a
-                # single device->host transfer, not three (three scalar
-                # fetches were measurable per-step host work on the
-                # dispatch-bound LSTM path over a high-RTT device link).
-                g = extras["guard"]
-                total, consec, trips = g[0], g[1], g[2]
-                new_consec = jnp.where(finite, jnp.zeros_like(consec),
-                                       consec + 1)
-                if maxbad > 0:
-                    trips = trips + (new_consec == maxbad).astype(
-                        trips.dtype)
-                new_extras["guard"] = jnp.stack(
-                    [jnp.where(finite, total, total + 1), new_consec,
-                     trips])
-            if metric_fn is not None:
-                # in-graph metric accumulation from this step's own
-                # outputs and (pre-transform) labels; a guard-skipped
-                # step contributes nothing — EXACT parity with the
-                # blocking host path, which drops skipped steps too
-                msum, mcnt = extras["metric"]
-                ds, dc = metric_fn(list(outs), raw_data)
-                if guard:
-                    ds = jnp.where(finite, ds, jnp.zeros_like(ds))
-                    dc = jnp.where(finite, dc, jnp.zeros_like(dc))
-                new_extras["metric"] = (msum + ds, mcnt + dc)
-            return new_params, new_aux, new_state, new_extras, list(outs)
+            if zero:
+                # constrain each gradient (still compute dtype) to its
+                # param's dp shard: GSPMD lowers the batch-psum + shard
+                # slice to a ReduceScatter issued as soon as the grad
+                # exists during backward
+                grads = {name: jax.lax.with_sharding_constraint(
+                    g, self._sharding(self._param_spec(name, g.shape)))
+                    for name, g in grads.items()}
+            return self._step_tail(params, aux, opt_state, extras,
+                                   raw_data, outs, auxu, grads,
+                                   lr, wd, t)
 
         def eval_step(params, aux, data, rng, is_train=False):
             if zero:
@@ -550,6 +557,11 @@ class SPMDTrainer(object):
         # _shard_batch) — GSPMD partitions the step and inserts collectives.
         # Donation lets params/opt-state (and the guard/metric carries in
         # ``extras``) update in place in HBM.
+        if self._zero3:
+            # fully-sharded step: grouped on-demand gathers + backward
+            # re-gather + reduce-scatter grads (parallel/zero3.py); the
+            # eval path above already gathers via the shared zero branch
+            step = self._make_zero3_step(xform, cast)
         self._step_raw = step  # analyzers make_jaxpr the unjitted step
         self._step_fn = jax.jit(step, donate_argnums=self.DONATE_ARGNUMS)
         self._eval_fn = jax.jit(eval_step, static_argnums=(4,))
@@ -561,6 +573,209 @@ class SPMDTrainer(object):
         # look so the steady-state step pays one attribute check.
         self._analyzed_keys = set()
         self._analyze_off = False
+
+    def _step_tail(self, params, aux, opt_state, extras, raw_data, outs,
+                   auxu, grads, lr, wd, t, finite_reduce=None,
+                   metric_reduce=None, aux_reduce=None):
+        """Shared epilogue of EVERY fused-step flavor (allreduce / zero
+        / zero3 both tiers): the all-finite guard over the finalized
+        gradients, the in-graph optimizer update, aux merge, the
+        stacked i32[3] skip counters and deferred-metric accumulation.
+        One copy on purpose — the guard-carry layout and skip
+        accounting were already reshaped once (the i32[3] stack) and
+        must never drift between step flavors.
+
+        The zero3 manual tier runs this inside a shard_map body and
+        passes reducers that agree per-shard values across devices:
+        ``finite_reduce`` (psum-AND of the finite flag — each device
+        only checked its shard), ``metric_reduce`` (psum the local
+        metric deltas — each device saw only its rows) and
+        ``aux_reduce`` (pmean the per-device BN stats — the
+        reference's multi-GPU batch-stat semantics, averaged)."""
+        guard = self.step_guard
+        metric_fn = self._metric_fn
+        maxbad = self.max_consecutive_bad_steps
+        finite = None
+        if guard:
+            # all-finite over every gradient, folded into the same XLA
+            # program (one fused reduction tree) — the in-graph analog
+            # of DynamicLossScale / Orbax-era skip-step guards
+            finite = jnp.asarray(True)
+            for name in self.param_names:
+                finite = jnp.logical_and(
+                    finite, jnp.all(jnp.isfinite(grads[name])))
+            if finite_reduce is not None:
+                finite = finite_reduce(finite)
+        new_params, new_state = {}, {}
+        for name in self.param_names:
+            g = grads[name].astype(params[name].dtype)
+            w, s = self._apply_update(name, params[name], g,
+                                      opt_state[name], lr, wd, t)
+            if guard:
+                # non-finite step: params AND optimizer state pass
+                # through unchanged (selects fuse into the update)
+                w = jnp.where(finite, w, params[name])
+                s = tuple(jnp.where(finite, sn, so)
+                          for sn, so in zip(s, opt_state[name]))
+            new_params[name] = w
+            new_state[name] = s
+        new_aux = dict(aux)
+        new_aux.update(auxu)
+        new_extras = {}
+        if guard:
+            # BN moving stats computed from a poisoned batch must not
+            # stick either
+            for name, v in auxu.items():
+                new_aux[name] = jnp.where(finite, v, aux[name])
+        if aux_reduce is not None:
+            for name in auxu:
+                new_aux[name] = aux_reduce(new_aux[name])
+        if guard:
+            # in-graph skip accounting: totals accumulate, the
+            # consecutive run resets on any good step, and ``trips``
+            # counts runs REACHING the abort threshold — so a bad run
+            # that ends between two deferred flushes still aborts at
+            # the next flush (the peak would otherwise be lost when
+            # consec resets).  The host reads the counters lazily
+            # (flush_step_guard), never per-step — and they travel
+            # as ONE stacked i32[3] carry so each flush costs a
+            # single device->host transfer, not three (three scalar
+            # fetches were measurable per-step host work on the
+            # dispatch-bound LSTM path over a high-RTT device link).
+            g = extras["guard"]
+            total, consec, trips = g[0], g[1], g[2]
+            new_consec = jnp.where(finite, jnp.zeros_like(consec),
+                                   consec + 1)
+            if maxbad > 0:
+                trips = trips + (new_consec == maxbad).astype(
+                    trips.dtype)
+            new_extras["guard"] = jnp.stack(
+                [jnp.where(finite, total, total + 1), new_consec,
+                 trips])
+        if metric_fn is not None:
+            # in-graph metric accumulation from this step's own
+            # outputs and (pre-transform) labels; a guard-skipped
+            # step contributes nothing — EXACT parity with the
+            # blocking host path, which drops skipped steps too
+            msum, mcnt = extras["metric"]
+            ds, dc = metric_fn(list(outs), raw_data)
+            if metric_reduce is not None:
+                ds = metric_reduce(ds)
+                dc = metric_reduce(dc)
+            if guard:
+                ds = jnp.where(finite, ds, jnp.zeros_like(ds))
+                dc = jnp.where(finite, dc, jnp.zeros_like(dc))
+            new_extras["metric"] = (msum + ds, mcnt + dc)
+        return new_params, new_aux, new_state, new_extras, list(outs)
+
+    def _make_zero3_step(self, xform, cast):
+        """The grad_sync='zero3' fused step (both tiers).
+
+        The gathers live INSIDE the loss closure and the vjp is taken
+        with respect to the SHARDS, so the gather's autodiff transpose
+        carries the gradients back: under the manual tier
+        ``all_gather``'s transpose IS ``psum_scatter`` (reduce-scatter
+        by construction); under the gspmd tier the shard constraint's
+        transpose re-pins the cotangent to the shard spec and GSPMD
+        places the reduction.  The whole closure runs under the zero3
+        remat policy: every residual checkpoints normally EXCEPT the
+        tagged gathered parameters, which the backward re-gathers —
+        nothing replicated survives the fwd/bwd boundary, so peak
+        parameter residency stays ~1/world plus one gather group.
+        """
+        import jax
+        from . import zero3 as z3
+        eval_fn = self._eval
+        param_names = tuple(self.param_names)
+        manual = self.zero3_tier == "manual"
+        axis = self.data_axis
+        dp = self.mesh.shape[axis]
+        policy = z3.remat_policy()
+        shard_dim = dict(self._zero3_dims)
+        groups = [list(g) for g in self._zero3_groups]
+        grouped = frozenset(n for g in groups for n in g)
+
+        if manual:
+            gather_grouped = z3.make_manual_gather(
+                groups, shard_dim,
+                {n: tuple(self.arg_shapes[n]) for n in grouped}, dp, axis)
+        else:
+            gather_grouped = z3.make_gspmd_gather(
+                groups,
+                lambda n: self._sharding(
+                    self._param_spec(n, self.arg_shapes[n])),
+                self._sharding(P()))
+
+        def step(params, aux, opt_state, extras, data, rng, lr, wd, t):
+            raw_data = data
+            data = xform(data)
+            if manual:
+                # decorrelate per-device stochastic draws (Dropout):
+                # each dp shard folds its axis index so masks are
+                # independent across the global batch, deterministic
+                # per seed
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+
+            def loss_fn(p):
+                cp = cast(p)
+                full = dict(cp)
+                full.update(gather_grouped({n: cp[n] for n in grouped}))
+                merged = dict(data)
+                merged.update(full)
+                outs, auxu = eval_fn(merged, aux, rng, True)
+                return tuple(outs), auxu
+
+            loss_ck = jax.checkpoint(loss_fn, policy=policy)
+            outs, vjp_fn, auxu = jax.vjp(loss_ck, params, has_aux=True)
+            heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads, = vjp_fn(heads)
+            if manual:
+                # grouped params arrived REDUCE-SCATTERED (all_gather's
+                # transpose); ungrouped (replicated) params hold local
+                # partials — psum them (tiny residue: indivisible dims)
+                grads = {n: (g if n in grouped
+                             else jax.lax.psum(g, axis))
+                         for n, g in grads.items()}
+            else:
+                grads = {n: jax.lax.with_sharding_constraint(
+                    g, self._sharding(self._param_spec(n, g.shape)))
+                    for n, g in grads.items()}
+            return self._step_tail(
+                params, aux, opt_state, extras, raw_data, outs, auxu,
+                grads, lr, wd, t,
+                # manual tier: agree per-shard values across the
+                # shard_map body (each device checked/saw only its
+                # shard/rows; pmean'd BN stats are the reference's
+                # multi-GPU per-device-batch semantics, averaged —
+                # docs/how_to/sharded_training.md)
+                finite_reduce=(lambda f: jax.lax.psum(
+                    f.astype(jnp.int32), axis) >= dp) if manual else None,
+                metric_reduce=(lambda v: jax.lax.psum(v, axis))
+                if manual else None,
+                aux_reduce=(lambda v: jax.lax.pmean(v, axis))
+                if manual else None)
+
+        if not manual:
+            return step
+
+        # manual tier: the body above runs per-device under shard_map —
+        # every collective is explicit, so the schedule cannot depend on
+        # backend partitioner heuristics
+        from .compat import shard_map
+        pspec = {n: (P(*[axis if i == shard_dim[n] else None
+                         for i in range(len(self.arg_shapes[n]))])
+                     if n in grouped else P())
+                 for n in param_names}
+        dspec = {}
+        for name in self.input_names:
+            ndim = len(self.arg_shapes.get(name, ())) or 1
+            dspec[name] = P(axis, *([None] * (ndim - 1)))
+        in_specs = (pspec, P(), pspec, P(), dspec, P(), P(), P(), P())
+        out_specs = (pspec, P(), pspec, P(),
+                     [P(axis, *([None] * (len(s) - 1)))
+                      for s in self.out_shapes])
+        return shard_map(step, self.mesh, in_specs, out_specs,
+                         check_vma=False)
 
     # -- public API --------------------------------------------------------
     def stage_batch(self, *batch_arrays):
@@ -672,6 +887,34 @@ class SPMDTrainer(object):
         self._steps_since_flush += 1
         if self._steps_since_flush >= max(1, self.flush_interval):
             self.flush_step_guard()
+        if self._zero3:
+            # the manual tier shard_maps the step and every tier
+            # dp-shards the batch: an indivisible (unpadded final)
+            # batch must fail with guidance BEFORE the placement layer
+            # throws its own error (iterators pad by default).  Raw
+            # arrays in a multi-process run are the LOCAL batch — the
+            # global dim is local x processes, so the local rows only
+            # need to cover this process's share of the dp axis; a
+            # StagedBatch already holds GLOBAL arrays and checks
+            # against the full axis.
+            import jax
+            from ..io import StagedBatch
+            dp = self.mesh.shape[self.data_axis]
+            arrays = batch_arrays
+            need = dp
+            if len(arrays) == 1 and isinstance(arrays[0], StagedBatch):
+                arrays = tuple(arrays[0].staged.values())
+            elif self._multiproc:
+                need = max(1, dp // max(1, jax.process_count()))
+            for v in arrays:
+                n = np.shape(v)[0] if np.ndim(v) else 0
+                if n % need:
+                    raise MXNetError(
+                        "grad_sync='zero3': batch dim %d does not "
+                        "divide this process's share (%d) of the dp "
+                        "axis (%d) — pad the final batch (iterator "
+                        "default) or use grad_sync='zero'"
+                        % (n, need, dp))
         data = self._resolve_batch(batch_arrays)
         self._num_update += 1
         lr = self.optimizer.lr if self.optimizer.lr_scheduler is None else \
@@ -894,21 +1137,68 @@ class SPMDTrainer(object):
                 if self._zero:
                     import logging
                     logging.getLogger(__name__).info(
-                        "grad_sync='zero': gathering sharded params is a "
+                        "grad_sync=%r: gathering sharded params is a "
                         "COLLECTIVE — all ranks must call get_params/"
                         "get_states together (rank-guarded checkpointing "
-                        "deadlocks; write from rank 0 AFTER the gather)")
-            return np.asarray(self._rep_fn(v).addressable_shards[0].data)
+                        "deadlocks; write from rank 0 AFTER the gather)"
+                        % self.grad_sync)
+            rep = self._rep_fn(v)
+            out = np.asarray(rep.addressable_shards[0].data)
+            # free the replicated device copy NOW: per-parameter
+            # gathering bounds the device-side peak at shards + ONE
+            # full param, instead of shards + the whole f32 master
+            try:
+                rep.delete()
+            except Exception:  # noqa: BLE001 — best-effort release
+                pass
+            return out
         return jax.device_get(v)
 
+    def _host_resident(self, host):
+        """Wrap one gathered host array for get_params WITHOUT pushing
+        it back through the default backend: on an accelerator backend
+        the old ``jnp.asarray(host)`` re-uploaded the full f32 master —
+        every parameter at once — into HBM, exactly the residency
+        zero/zero3 sharding exists to avoid.  The NDArray stays pinned
+        to the host platform; checkpoint/serialization paths only ever
+        read it back with asnumpy()."""
+        import jax
+        if jax.default_backend() != "cpu":
+            try:
+                dev = jax.local_devices(backend="cpu")[0]
+                return jax.device_put(np.asarray(host), dev)
+            except RuntimeError:  # no host platform registered
+                pass
+        return jnp.asarray(np.asarray(host))
+
     def get_params(self):
-        """Gather params/aux to host NDArrays (for checkpointing)."""
+        """Gather params/aux to host NDArrays (for checkpointing).
+        Gathers run ONE PARAMETER AT A TIME (bounded peak memory under
+        grad_sync='zero'/'zero3'; see _gather) and the results stay
+        host-resident."""
         self.flush_step_guard()
-        arg_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
-                      for k, v in self.params.items()}
-        aux_params = {k: NDArray._from_jax(jnp.asarray(self._gather(v)))
-                      for k, v in self.aux.items()}
+        arg_params = {k: NDArray._from_jax(
+            self._host_resident(self._gather(v)))
+            for k, v in self.params.items()}
+        aux_params = {k: NDArray._from_jax(
+            self._host_resident(self._gather(v)))
+            for k, v in self.aux.items()}
         return arg_params, aux_params
+
+    def snapshot_params(self):
+        """Checkpoint-ready host snapshots: ``(arg, aux)`` dicts of
+        frozen ``resilience._HostSnapshot`` values, gathered per
+        parameter (device peak stays bounded under sharded params) and
+        deep-copied once — ``resilience.snapshot_params`` ADOPTS these
+        without another copy, so an async save pays one host copy
+        total instead of gather + NDArray + snapshot."""
+        from ..resilience import _HostSnapshot
+        self.flush_step_guard()
+        arg = {k: _HostSnapshot(np.array(self._gather(v), copy=True))
+               for k, v in self.params.items()}
+        aux = {k: _HostSnapshot(np.array(self._gather(v), copy=True))
+               for k, v in self.aux.items()}
+        return arg, aux
 
     def set_params(self, arg_params, aux_params):
         """Replace parameter values, keeping optimizer state (the
@@ -994,8 +1284,14 @@ class SPMDTrainer(object):
         ``blocking=None`` follows ``MXTPU_CKPT_ASYNC``: the async path
         stalls the step loop only for the gather + host snapshot, the
         background writer does serialize + fsync + manifest — drain with
-        ``manager.wait()``."""
-        arg_params, aux_params = self.get_params()
+        ``manager.wait()``.
+
+        Sharded params (zero/zero3) checkpoint GATHER-ON-SAVE: per-
+        parameter collective gathers feed host snapshots directly (one
+        bounded copy, no full-model device re-upload), and ``restore``
+        re-shards through ``set_params``'s normal placement — sharded
+        and replicated runs restore each other's checkpoints freely."""
+        arg_params, aux_params = self.snapshot_params()
         states = self.get_states()
         return manager.save(step, self.symbol, arg_params, aux_params,
                             optimizer_states=states, blocking=blocking)
@@ -1024,6 +1320,32 @@ class SPMDTrainer(object):
             self._param_spec(n, self.arg_shapes[n]) != P()
             for n in self.param_names)
 
+    def _zero3_expected_gather_bytes(self):
+        """Per-step forward gather traffic a CORRECT zero3 step must
+        move: the full-size bytes (in the comm dtype — compute_dtype
+        for floating params) of every otherwise-replicated param with a
+        dp-divisible dimension.  Computed from the BASE sharding rules
+        and shapes, never from ``_param_spec`` overrides — a subclass
+        that sabotages the sharding cannot also lower the bar the
+        schedule lint holds it to."""
+        if not self._zero3:
+            return None
+        dp = self.mesh.shape[self.data_axis]
+        total = 0
+        for name in self.param_names:
+            shape = self.arg_shapes[name]
+            if _spec_for(name, shape, self.param_shardings) != P():
+                continue
+            if not any(d % dp == 0 and d >= dp for d in shape):
+                continue
+            dtype = np.dtype(self.params[name].dtype) \
+                if self.params else np.dtype(np.float32)
+            if self.compute_dtype is not None and \
+                    np.issubdtype(dtype, np.floating):
+                dtype = self.compute_dtype
+            total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+        return total
+
     def _lint_args(self, args, min_donate_bytes=0):
         """Run the graph lint against this trainer's compiled step with
         the given (fully assembled) argument tuple."""
@@ -1034,11 +1356,16 @@ class SPMDTrainer(object):
         param_bytes = sum(
             int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
             for v in self.params.values())
+        schedule = None
+        if self._zero3:
+            schedule = "zero3-" + (self.zero3_tier or "gspmd")
         return graph_lint.lint_lowered(
             lowered, closed_jaxpr=closed,
             compute_dtype=self.compute_dtype,
             param_bytes=param_bytes,
             expect_allgather=self._expects_allgather(),
+            schedule=schedule,
+            expect_gather_bytes=self._zero3_expected_gather_bytes(),
             min_donate_bytes=min_donate_bytes,
             # the step's carries live in args 0-3 (params/aux/opt_state/
             # extras) BY SIGNATURE — restricting the missing-donation
@@ -1060,6 +1387,13 @@ class SPMDTrainer(object):
         metric reads it), and dtype drift under ``compute_dtype``.
         Traces and compiles the step once; with a warm persistent
         compile cache (MXTPU_COMPILE_CACHE) the XLA work is reused."""
+        args = self._example_args(*batch_arrays)
+        return self._lint_args(args, min_donate_bytes=min_donate_bytes)
+
+    def _example_args(self, *batch_arrays):
+        """The fully assembled argument tuple ``_step_fn`` would see for
+        one batch — what ``analyze`` lints and what ``bench.py zero3``
+        lowers for ``memory_analysis`` without dispatching a step."""
         from .. import random as _random
         if self._step_fn is None or self.params is None:
             raise MXNetError(
@@ -1074,12 +1408,11 @@ class SPMDTrainer(object):
             extras["metric"] = self._metric_acc or (
                 self._scalar_acc(0.0, np.float32),
                 self._scalar_acc(0.0, np.float32))
-        args = (self.params, self.aux, self.opt_state, extras, data,
+        return (self.params, self.aux, self.opt_state, extras, data,
                 _random.peek_key(),
                 jnp.asarray(self.optimizer.lr, jnp.float32),
                 jnp.asarray(self.optimizer.wd, jnp.float32),
                 self._num_update + 1)
-        return self._lint_args(args, min_donate_bytes=min_donate_bytes)
 
     def _maybe_env_analyze(self, args):
         """MXTPU_ANALYZE=1|strict: graph-lint the program the first

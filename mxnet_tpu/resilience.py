@@ -515,9 +515,16 @@ def snapshot_params(params):
     """Deep host copies of a ``{name: array-like}`` dict, wrapped for the
     writer thread.  This copy is the ONLY part of an async save the step
     loop pays for: the values handed to the writer must stay frozen while
-    training mutates (donated) device buffers and in-place host params."""
+    training mutates (donated) device buffers and in-place host params.
+
+    Values that already ARE ``_HostSnapshot``s (SPMDTrainer.
+    snapshot_params gathers sharded params one at a time into them) are
+    adopted as-is — they are frozen private copies by construction, and
+    re-copying here would double the host peak the per-parameter gather
+    path exists to bound."""
     import numpy as np
-    return {k: _HostSnapshot(np.array(_host_value(v), copy=True))
+    return {k: v if isinstance(v, _HostSnapshot)
+            else _HostSnapshot(np.array(_host_value(v), copy=True))
             for k, v in (params or {}).items()}
 
 

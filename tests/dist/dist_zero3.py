@@ -1,0 +1,162 @@
+"""ZeRO-3 distributed drill worker (run under tools/launch.py).
+
+Three phases, selected by DIST_ZERO3_PHASE, drive the fully-sharded
+trainer across REAL processes on the virtual CPU cluster:
+
+- ``baseline``: train the same seeded MLP under grad_sync='allreduce'
+  and 'zero3' (manual tier: bucketed all-gathers, backward re-gather,
+  reduce-scatter grads) for 6 steps each and assert the final params
+  are BIT-identical — the reduce-scatter sums each gradient element in
+  the same per-device order the all-reduce does, and the sharded
+  momentum update is elementwise.  Prints the zero3 param digest.
+- ``kill``: train 3 steps, save a checkpoint through CheckpointManager
+  (gather-on-save: per-parameter collective gathers, rank 0 writes),
+  then every rank SIGKILLs itself mid-run — the launcher must report
+  failure, and the checkpoint on disk is the only survivor.
+- ``resume``: restore from that checkpoint (params re-shard over dp on
+  placement), replay steps 4-6 with the same data stream, and print
+  the digest — the runner asserts it equals the undisturbed baseline,
+  i.e. SIGKILL-resume is bit-identical.
+
+Launch:  DIST_ZERO3_PHASE=baseline python tools/launch.py -n 2 \
+             --platform cpu python tests/dist/dist_zero3.py
+"""
+import hashlib
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from mxnet_tpu import distributed
+
+distributed.initialize()
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.symbol as sym  # noqa: E402
+from mxnet_tpu.parallel import SPMDTrainer  # noqa: E402
+from mxnet_tpu.resilience import CheckpointManager  # noqa: E402
+
+TOTAL_STEPS = 6
+SAVE_AT = 3           # kill phase: save after this many steps...
+KILL_AT = 4           # ...and die before this step completes
+
+
+def build_net():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=64, name="fc1")
+    act = sym.Activation(data=fc1, act_type="relu")
+    fc2 = sym.FullyConnected(data=act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def make_mesh():
+    import jax
+    from jax.sharding import Mesh
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return Mesh(np.asarray(devs), ("dp",))
+
+
+def make_trainer(grad_sync, mesh):
+    t = SPMDTrainer(build_net(), "sgd",
+                    {"learning_rate": 0.3, "momentum": 0.9,
+                     "rescale_grad": 1.0 / 64},
+                    mesh=mesh, grad_sync=grad_sync)
+    t.bind([("data", (32, 10))], [("softmax_label", (32,))])
+    mx.random.seed(33)
+    t.init_params(mx.initializer.Xavier())
+    return t
+
+
+def batches(rank, nworker):
+    """Deterministic per-rank batch stream: global batch i is the same
+    in every phase; each process feeds its rank's rows."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(6 * 64, 10).astype("f")
+    y = rs.randint(0, 4, 6 * 64).astype("f")
+    out = []
+    for i in range(TOTAL_STEPS):
+        gb = slice((i % 6) * 64, (i % 6 + 1) * 64)
+        Xg, yg = X[gb], y[gb]
+        local = slice(rank * 32, (rank + 1) * 32) if nworker == 2 \
+            else slice(rank * (64 // nworker), (rank + 1) * (64 // nworker))
+        out.append((Xg[local], yg[local]))
+    return out
+
+
+def digest(trainer):
+    arg, aux = trainer.get_params()   # collective — all ranks together
+    h = hashlib.sha256()
+    for name in sorted(arg):
+        h.update(arg[name].asnumpy().tobytes())
+    for name in sorted(aux):
+        h.update(aux[name].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def main():
+    phase = os.environ["DIST_ZERO3_PHASE"]
+    kv = mx.kv.create("tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    mesh = make_mesh()
+    data = batches(rank, nworker)
+
+    if phase == "baseline":
+        finals = {}
+        for sync in ("allreduce", "zero3"):
+            t = make_trainer(sync, mesh)
+            if sync == "zero3":
+                assert t.zero3_tier == "manual", t.zero3_tier
+                w = t.params["fc1_weight"]
+                local = w.addressable_shards[0].data.shape
+                assert local[0] == 64 // nworker, local
+            for i in range(TOTAL_STEPS):
+                t.step(*data[i])
+            arg, _ = t.get_params()
+            finals[sync] = {k: v.asnumpy().copy() for k, v in arg.items()}
+            if sync == "zero3":
+                d = digest(t)
+            t.close()
+        for k in finals["allreduce"]:
+            assert np.array_equal(finals["allreduce"][k],
+                                  finals["zero3"][k]), \
+                "zero3 diverged from allreduce at %s" % k
+        print("dist_zero3 rank %d/%d: OK baseline zero3==allreduce "
+              "bitwise digest=%s" % (rank, nworker, d), flush=True)
+        return
+
+    ckpt_dir = os.environ["DIST_ZERO3_CKPT"]
+    if phase == "kill":
+        mgr = CheckpointManager(ckpt_dir)
+        t = make_trainer("zero3", mesh)
+        for i in range(SAVE_AT):
+            t.step(*data[i])
+        t.save_checkpoint(mgr, SAVE_AT, blocking=True)
+        # every rank prints the marker BEFORE dying so the runner can
+        # assert the save landed, then dies hard mid-training
+        print("dist_zero3 rank %d/%d: SAVED at step %d"
+              % (rank, nworker, SAVE_AT), flush=True)
+        t.step(*data[SAVE_AT])  # step 4 runs; its result must be lost
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # unreachable
+
+    if phase == "resume":
+        mgr = CheckpointManager(ckpt_dir)
+        t = make_trainer("zero3", mesh)
+        mx.random.seed(99)  # resume must not depend on ambient RNG
+        restored = t.restore(mgr)
+        assert restored == SAVE_AT, restored
+        for i in range(SAVE_AT, TOTAL_STEPS):
+            t.step(*data[i])
+        print("dist_zero3 rank %d/%d: OK resume digest=%s"
+              % (rank, nworker, digest(t)), flush=True)
+        return
+
+    raise SystemExit("unknown DIST_ZERO3_PHASE %r" % phase)
+
+
+if __name__ == "__main__":
+    main()

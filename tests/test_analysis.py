@@ -778,3 +778,136 @@ def test_report_json_is_stable(tmp_path):
         return payload
 
     assert run(1) == run(2)
+
+
+# ---------------------------------------------------------------------------
+# graph-collective-schedule: the zero3 proof (the rule the tentpole adds)
+# ---------------------------------------------------------------------------
+
+def test_degenerate_replica_groups_are_not_traffic():
+    """Singleton replica_groups — GSPMD's zero-traffic materialization
+    of per-device partials — must not count as collectives; explicit
+    and iota group forms both parse, and fixtures without
+    replica_groups keep counting (backwards compatible)."""
+    hlo = "\n".join((
+        "%ar0 = f32[64,16]{1,0} all-reduce(f32[64,16]{1,0} %d), "
+        "replica_groups=[8,1]<=[8], to_apply=%add",
+        "%ar1 = f32[64,16]{1,0} all-reduce(f32[64,16]{1,0} %d), "
+        "replica_groups={{0},{1},{2},{3},{4},{5},{6},{7}}, to_apply=%add",
+        "%ar2 = f32[32]{0} all-reduce(f32[32]{0} %d), "
+        "replica_groups=[1,8]<=[8], to_apply=%add",
+        "%ar3 = f32[16]{0} all-reduce(f32[16]{0} %d), "
+        "replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add",
+        "%ar4 = f32[8]{0} all-reduce(f32[8]{0} %d), to_apply=%add",
+    ))
+    stats = graph_lint.collective_stats(hlo)
+    # ar0/ar1 are degenerate no-ops; ar2-ar4 are real
+    assert stats["all-reduce"]["count"] == 3, stats
+    assert stats["all-reduce"]["bytes"] == 32 * 4 + 16 * 4 + 8 * 4
+
+
+def test_collective_schedule_flags_unsharded_step():
+    """An allreduce-shaped step DECLARED as zero3-manual fails all
+    three schedule checks: no param-scale gathers, no reduce-scatter,
+    and a full-gradient all-reduce."""
+    mesh = _dp_mesh()
+    w = jax.device_put(jnp.ones((64, 32)), NamedSharding(mesh, P()))
+    x = jax.device_put(jnp.ones((64, 64)),
+                       NamedSharding(mesh, P("dp", None)))
+
+    def allreduce_step(w, x):
+        loss = lambda w: jnp.sum((x @ w) ** 2)  # noqa: E731
+        g = jax.grad(loss)(w)
+        return w - 0.1 * g
+
+    pb = 64 * 32 * 4
+    rep = graph_lint.lint_jit(allreduce_step, w, x,
+                              expect_allgather=True,
+                              min_donate_bytes=1 << 30)
+    # re-lint the same program with the schedule declared
+    lowered = jax.jit(allreduce_step).lower(w, x)
+    rep = graph_lint.lint_lowered(lowered, schedule="zero3-manual",
+                                  expect_gather_bytes=pb,
+                                  min_donate_bytes=1 << 30)
+    msgs = [f.message for f in rep.findings
+            if f.rule == "graph-collective-schedule"]
+    assert len(msgs) == 3, rep.format_text()
+    assert any("left replicated" in m for m in msgs)
+    assert any("all-reduce" in m for m in msgs)
+    assert any("no reduce-scatter" in m for m in msgs)
+    # the gspmd tier tolerates the backend-placed gradient reduction
+    # but still demands the gathers
+    rep2 = graph_lint.lint_lowered(lowered, schedule="zero3-gspmd",
+                                   expect_gather_bytes=pb,
+                                   min_donate_bytes=1 << 30)
+    msgs2 = [f.message for f in rep2.findings
+             if f.rule == "graph-collective-schedule"]
+    assert len(msgs2) == 1 and "left replicated" in msgs2[0]
+
+
+def test_collective_schedule_clean_zero3_and_unaffected_allreduce():
+    """The REAL zero3 step passes the schedule rule; a declared-
+    allreduce step is untouched by it (rule keyed on the declaration)."""
+    X, y = batch()
+    t = make_trainer(grad_sync="zero3")
+    try:
+        rep = t.analyze(X, y)
+        assert rep.ok, rep.format_text()
+        assert rep.stats["schedule"]["declared"] == "zero3-manual"
+        assert rep.stats["collectives"]["reduce-scatter"]["count"] >= 1
+    finally:
+        t.close()
+    t = make_trainer(grad_sync="allreduce")
+    try:
+        rep = t.analyze(X, y)
+        assert rep.ok, rep.format_text()
+        assert "schedule" not in rep.stats
+        assert "graph-collective-schedule" not in rules_of(rep)
+    finally:
+        t.close()
+
+
+class _UnshardedZero3(SPMDTrainer):
+    """Violation fixture: declares zero3 but sabotages the sharding —
+    every param resolves replicated, so nothing gathers and gradients
+    all-reduce at full size.  The expected-gather-bytes bar comes from
+    base rules + shapes, so the override cannot lower it."""
+
+    def _param_spec(self, name, shape):
+        return P()
+
+
+def test_zero3_sabotaged_sharding_flagged():
+    X, y = batch()
+    t = make_trainer(cls=_UnshardedZero3, grad_sync="zero3")
+    try:
+        rep = t.analyze(X, y)
+        assert "graph-collective-schedule" in rules_of(rep), \
+            rep.format_text()
+        assert t._zero3_expected_gather_bytes() > 0
+    finally:
+        t.close()
+
+
+def test_env_analyze_strict_refuses_unsharded_zero3(monkeypatch):
+    """MXTPU_ANALYZE=strict: a zero3 step whose sharding silently
+    never happened refuses to train — the declared schedule is
+    ENFORCED, not logged."""
+    monkeypatch.setenv("MXTPU_ANALYZE", "strict")
+    t = make_trainer(cls=_UnshardedZero3, grad_sync="zero3")
+    try:
+        with pytest.raises(mx.MXNetError,
+                           match="graph-collective-schedule"):
+            t.step(*batch())
+    finally:
+        t.close()
+
+
+def test_env_analyze_strict_accepts_real_zero3(monkeypatch):
+    """...and the genuine zero3 step trains under strict."""
+    monkeypatch.setenv("MXTPU_ANALYZE", "strict")
+    t = make_trainer(grad_sync="zero3")
+    try:
+        t.step(*batch())
+    finally:
+        t.close()

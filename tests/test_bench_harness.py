@@ -260,3 +260,72 @@ def test_gate_skips_scaling_shape_on_1core_hosts(tmp_path):
                      against=_write(tmp_path / "o3.json", base))
     assert not rep["pass"]
     assert rep["regressions"][0]["key"] == "data_service_scaling_x"
+
+
+def test_gate_keys_cover_zero3_metrics(tmp_path):
+    """Satellite: the zero3 sweep's throughput, residency leverage and
+    wide-model memory leverage are gate-guarded — a drop OR a vanished
+    key blocks the run like everything else."""
+    for key in ("zero3_steps_s", "zero3_param_shard_x",
+                "zero3_wide_mem_x"):
+        assert key in bench.GATE_KEYS
+    base = dict(BASE, zero3_steps_s=250.0, zero3_param_shard_x=7.8,
+                zero3_wide_mem_x=1.7)
+    # residency leverage collapsing to ~1 (sharding silently broken)
+    new = dict(base, zero3_param_shard_x=1.0)
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "zero3_param_shard_x"
+    # a vanished zero3 key blocks too
+    gone = {k: v for k, v in base.items() if k != "zero3_steps_s"}
+    rep = bench.gate(_write(tmp_path / "n2.json", gone),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "zero3_steps_s"
+
+
+def test_zero3_bench_small_preset_self_proof():
+    """The zero3 mode's self-proof on the small preset: ~1/world
+    per-device parameter residency, a PROVEN collective schedule
+    (reduce-scatter present, param-scale gathers — trainer.analyze
+    inside the bench), and throughput keys for all three grad_sync
+    modes so the gate can watch them round over round."""
+    import jax
+    out = bench._zero3_bench(preset="small")
+    world = len(jax.devices())
+    assert out["zero3_world"] == world
+    for key in ("zero3_steps_s", "zero3_zero_steps_s",
+                "zero3_allreduce_steps_s", "zero3_wide_steps_s"):
+        assert out[key] > 0, key
+    assert out["zero3_frac_ok"] is True
+    assert out["zero3_param_bytes_frac"] <= 1.0 / world + 0.05
+    assert out["zero3_param_shard_x"] > world * 0.7
+    assert out["zero3_tier"] == "manual"
+    assert out["zero3_schedule_ok"] is True
+    assert out["zero3_collectives"]["reduce-scatter"]["count"] >= 1
+    # wide model: sharded residency exact, compiled peak memory below
+    # the replicated baseline (memory_analysis-backed when available)
+    assert out["zero3_wide_param_bytes_frac"] <= 1.0 / world + 0.05
+    if "zero3_wide_mem_x" in out:
+        assert out["zero3_wide_mem_x"] > 1.0
+
+
+def test_gate_skips_zero3_mem_key_when_unmeasurable(tmp_path):
+    """zero3_wide_mem_x needs compiled.memory_analysis(); a backend
+    without it marks the key structurally unmeasurable
+    (zero3_mem_note=unavailable_*) and the gate SKIPS the comparison
+    instead of reporting a vanished metric — but an artifact that
+    simply DROPS the key with no note still blocks."""
+    base = dict(BASE, zero3_wide_mem_x=1.7)
+    gone = {k: v for k, v in base.items() if k != "zero3_wide_mem_x"}
+    noted = dict(gone, zero3_mem_note="unavailable_memory_analysis")
+    rep = bench.gate(_write(tmp_path / "noted.json", noted),
+                     against=_write(tmp_path / "old.json", base))
+    assert rep["pass"], rep
+    assert "zero3_wide_mem_x" in rep.get(
+        "skipped_flat_by_construction", [])
+    rep = bench.gate(_write(tmp_path / "gone.json", gone),
+                     against=_write(tmp_path / "old2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "zero3_wide_mem_x"
