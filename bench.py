@@ -1075,10 +1075,14 @@ def _lstm_bench(batch, seq_len, steps, warmup, trials):
                                             s1 + steps, trials)
 
 
-def _save_serving_models(tmp):
+def _save_serving_models(tmp, deep=False):
     """Write the two bench serving checkpoints: the standard MLP
     (models/mlp.py shape) and a resnet-shaped small-image net (cifar
-    branch of models/resnet.py) -> {name: (prefix, epoch, sample_shape)}."""
+    branch of models/resnet.py) -> {name: (prefix, epoch, sample_shape)}.
+    ``deep=True`` swaps resnet-20 for resnet-56 (the fleet mode: a
+    graph deep enough that bring-up is compile-dominated and a forward
+    heavy enough that replica compute, not HTTP plumbing, is the
+    scaling bottleneck)."""
     import mxnet_tpu as mx
     from mxnet_tpu import models
     from mxnet_tpu.model import save_checkpoint
@@ -1088,7 +1092,7 @@ def _save_serving_models(tmp):
     for name, sym, sample in (
             ("mlp", models.get_symbol("mlp", num_classes=10), (784,)),
             ("resnet", models.get_symbol("resnet", num_classes=10,
-                                         num_layers=20,
+                                         num_layers=56 if deep else 20,
                                          image_shape=(3, 32, 32)),
              (3, 32, 32))):
         shapes = {"data": (1,) + sample}
@@ -1109,10 +1113,13 @@ def _save_serving_models(tmp):
     return out
 
 
-def _serve_load(port, model, sample, concurrency, seconds, warmup_s=0.5):
+def _serve_load(port, model, sample, concurrency, seconds, warmup_s=0.5,
+                npy=False):
     """Closed-loop load: ``concurrency`` threads, each its own keep-alive
     client, firing back-to-back requests for ``seconds`` after a warmup
-    window.  Returns (qps, p50_ms, p99_ms, shed, errors)."""
+    window.  ``npy=True`` sends x-npy bodies (C-speed serialization —
+    the fleet rows use it so the CLIENT's JSON encode cost cannot mask
+    replica scaling).  Returns (qps, p50_ms, p99_ms, shed, errors)."""
     import threading
 
     from mxnet_tpu.serving import ServeClient
@@ -1130,7 +1137,7 @@ def _serve_load(port, model, sample, concurrency, seconds, warmup_s=0.5):
             while not stop.is_set():
                 tic = time.perf_counter()
                 try:
-                    status, _ = cli.predict(model, x)
+                    status, _ = cli.predict(model, x, npy=npy)
                 except Exception:  # noqa: BLE001 — connection-level loss
                     status = -1
                 dt = (time.perf_counter() - tic) * 1e3
@@ -1357,6 +1364,231 @@ def _serve_parity(port, specs):
     return True
 
 
+def _fleet_manifest(specs, buckets, replicas=1):
+    """The bench models as a real :class:`FleetManifest` (the same
+    object the CLI builds — no parallel spec format to drift)."""
+    from mxnet_tpu.fleet import FleetManifest
+    return FleetManifest(
+        {name: {"target": "%s:%d" % (prefix, epoch),
+                "shapes": {"data": list(sample)}}
+         for name, (prefix, epoch, sample) in specs.items()},
+        replicas=replicas, buckets=buckets, device_sets="cpu")
+
+
+def _fleet_warm_run(specs, buckets, cache_dir, timeout=600):
+    """One ``tools/serve.py --warmup-only`` bring-up over every bench
+    model with ``MXTPU_COMPILE_CACHE=cache_dir``; returns the parsed
+    ``warmup_s`` (trace+compile — or, against a built AOT store,
+    executable-load — time only; process imports excluded, so the
+    number is exactly what the warm store removes)."""
+    import subprocess
+
+    from mxnet_tpu.fleet.warm import WARMUP_RE
+
+    argv = _fleet_manifest(specs, buckets).serve_argv(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "serve.py"),
+        port=0, warmup_only=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_COMPILE_CACHE=cache_dir)
+    res = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=timeout)
+    if res.returncode != 0:
+        raise RuntimeError("warmup-only run failed (rc %d):\n%s"
+                           % (res.returncode, res.stderr[-2000:]))
+    m = WARMUP_RE.search(res.stderr)
+    if not m:
+        raise RuntimeError("warmup-only run printed no warmup_s:\n%s"
+                           % res.stderr[-2000:])
+    return float(m.group(1))
+
+
+def _fleet_up(specs, buckets, store, run_dir, replicas, extra_env=None,
+              timeout=600):
+    """Boot a fleet (router + ``replicas`` daemons) on an ephemeral
+    port; returns ``(proc, port)`` once the port file appears."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    port_file = os.path.join(run_dir, "router.port")
+    cmd = [sys.executable, os.path.join(here, "tools", "fleet.py"),
+           "serve", "--replicas", str(replicas), "--device-sets", "cpu",
+           "--buckets", buckets, "--warm-store", store,
+           "--run-dir", run_dir, "--port", "0",
+           "--port-file", port_file]
+    for name, (prefix, epoch, sample) in specs.items():
+        cmd += ["--model", "%s=%s:%d" % (name, prefix, epoch),
+                "--input-shape",
+                "%s:data=%s" % (name, ",".join(map(str, sample)))]
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise RuntimeError("fleet died during bring-up: %s"
+                               % proc.stderr.read()[-2000:])
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError("fleet never wrote its port file")
+        time.sleep(0.1)
+    return proc, int(open(port_file).read().split(":")[1])
+
+
+def _fleet_bench(seconds=2.5):
+    """The ``bench.py fleet`` mode (docs/how_to/fleet.md): the three
+    fleet claims, measured, not assumed.
+
+    - ``fleet_warm_start_x`` = cold-compile bring-up / AOT-warm
+      bring-up: the cold run traces and XLA-compiles every (model,
+      bucket) forward against an EMPTY cache; the warm run is a fresh
+      process warming from the built AOT executable store
+      (deserialized compiled programs — no trace, no compile; exactly
+      a respawned replica's warmup).  Bar: >= 3x (``fleet_warm_ok``).
+    - ``fleet_qps_x`` = 2-replica fleet QPS / 1-replica fleet QPS on
+      the compute-heavy resnet model (npy bodies so client
+      serialization cannot mask it; a low spill bar so the second
+      replica actually takes overflow — the spill policy IS what is
+      being scaled; best-of-2 over 4s windows for gate-grade
+      stability).  Bar: >= 1.6x on a host with enough cores to run
+      clients + router + two replicas concurrently; smaller hosts emit
+      ``fleet_scaling_note`` (the mxdata 1-core honesty rule: the gate
+      skips the SHAPE key via SCALING_SHAPE_KEYS, absolute keys still
+      gate).
+    - ``fleet_route_overhead_ms`` = router p50 - direct-to-replica p50
+      at concurrency 1 on the resnet-shaped model (compute-heavy enough
+      that the hop is measurable against a stable base).  Bar:
+      overhead < 15% of the direct p50 (``fleet_route_ok``).  The GATE
+      key is the monotone ratio ``fleet_route_eff`` = direct/router p50
+      (higher is better, like every gate key; it collapses when the
+      router hop bloats).
+    """
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from mxnet_tpu.serving import ServeClient
+
+    buckets = "1,2,4,8"
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    out = {}
+    proc = None
+    try:
+        specs = _save_serving_models(tmp, deep=True)
+        store = os.path.join(tmp, "warm_store")
+        cold_dir = os.path.join(tmp, "cold_cache")
+        os.makedirs(store)
+        os.makedirs(cold_dir)
+
+        # --- AOT warm store: cold vs warm bring-up -----------------------
+        from mxnet_tpu.fleet import build_warm_store
+        built = build_warm_store(_fleet_manifest(specs, buckets), store)
+        out["fleet_warm_build_s"] = built["warmup_s"]
+        # cold replica: empty cache, no store — trace + XLA compile all
+        cold_s = _fleet_warm_run(specs, buckets, cold_dir)
+        # warm replica: fresh process against the built store —
+        # deserialize the compiled executables
+        warm_s = _fleet_warm_run(specs, buckets, store)
+        out["fleet_warm_cold_s"] = round(cold_s, 3)
+        out["fleet_warm_warm_s"] = round(warm_s, 3)
+        out["fleet_warm_start_x"] = round(cold_s / max(warm_s, 1e-6), 2)
+        out["fleet_warm_ok"] = bool(out["fleet_warm_start_x"] >= 3.0)
+
+        fleet_env = {
+            # spill early so the second replica takes real overflow
+            "MXTPU_FLEET_SPILL_QUEUE": "4",
+            "MXTPU_FLEET_HEARTBEAT_S": "0.25",
+            "MXTPU_SERVE_MAX_WAIT_MS": "2",
+        }
+
+        # --- 1-replica fleet: baseline QPS + route overhead --------------
+        # the scaling rows drive the resnet-shaped model: its forward
+        # is compute-heavy enough that replica COMPUTE, not the python
+        # HTTP plumbing (client encode, router hop), is what saturates
+        # — the scaling number then measures replicas, not the proxy.
+        # (The converse is real and measured: the router is ONE python
+        # process, so sub-ms dispatch-bound models cap at its ~1.2k/s
+        # proxy ceiling regardless of replica count — scale-out buys
+        # throughput for compute-bound work, the docs say so.)
+        # Best-of-2 over 4s windows: single short windows put ±15%
+        # scheduler noise on a gate key with a 10% tolerance.
+        def _scaling_row(port):
+            return max(_serve_load(port, "resnet", specs["resnet"][2],
+                                   32, 4.0, npy=True)
+                       for _ in range(2))
+
+        run1 = os.path.join(tmp, "run1")
+        proc, port = _fleet_up(specs, buckets, store, run1, 1,
+                               extra_env=fleet_env)
+        qps1, _, _, _, _ = _scaling_row(port)
+        out["fleet_qps_1"] = qps1
+        _, router_p50, _, _, _ = _serve_load(
+            port, "resnet", specs["resnet"][2], 1, seconds, npy=True)
+        status, stats = ServeClient("127.0.0.1", port).stats()
+        direct_port = None
+        if status == 200:
+            for rep in stats.get("replicas", {}).values():
+                direct_port = rep.get("port")
+        if direct_port:
+            _, direct_p50, _, _, _ = _serve_load(
+                direct_port, "resnet", specs["resnet"][2], 1, seconds,
+                npy=True)
+            if router_p50 and direct_p50:
+                out["fleet_route_p50_ms"] = router_p50
+                out["fleet_direct_p50_ms"] = direct_p50
+                out["fleet_route_overhead_ms"] = round(
+                    router_p50 - direct_p50, 3)
+                out["fleet_route_eff"] = round(direct_p50 / router_p50,
+                                               3)
+                out["fleet_route_ok"] = bool(
+                    router_p50 - direct_p50 < 0.15 * direct_p50)
+        proc.send_signal(_signal.SIGTERM)
+        out["fleet_drain_rc_1"] = proc.wait(timeout=90)
+        proc = None
+
+        # --- 2-replica fleet: the scale-out claim ------------------------
+        run2 = os.path.join(tmp, "run2")
+        proc, port = _fleet_up(specs, buckets, store, run2, 2,
+                               extra_env=fleet_env)
+        qps2, _, p99_2, shed2, err2 = _scaling_row(port)
+        out["fleet_qps_2"] = qps2
+        if p99_2 is not None:
+            out["fleet_qps_2_p99_ms"] = p99_2
+        if shed2:
+            out["fleet_qps_2_shed"] = shed2
+        if err2:
+            out["fleet_qps_2_errors"] = err2
+        status, stats = ServeClient("127.0.0.1", port).stats()
+        if status == 200:
+            out["fleet_spilled"] = stats["router"]["counters"].get(
+                "spilled", 0)
+            out["fleet_routed"] = stats["router"]["counters"].get(
+                "routed", 0)
+        if qps1:
+            out["fleet_qps_x"] = round(qps2 / qps1, 2)
+        ncores = os.cpu_count() or 1
+        out["fleet_ncores"] = ncores
+        if ncores < 4:
+            # clients + router + 2 replicas are 4 concurrent python
+            # processes: with fewer cores the scaling row is flat by
+            # construction — the gate skips the SHAPE key, a capable
+            # host still gates it (tests/test_bench_harness.py)
+            out["fleet_scaling_note"] = \
+                "flat_by_construction_%dcore" % ncores
+        elif "fleet_qps_x" in out:
+            out["fleet_qps_ok"] = bool(out["fleet_qps_x"] >= 1.6)
+        proc.send_signal(_signal.SIGTERM)
+        out["fleet_drain_rc"] = proc.wait(timeout=90)
+        proc = None
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _train_flops(sym_name):
     """Analytic training FLOPs per image (3x forward; contrib/flops.py)."""
     from mxnet_tpu import models
@@ -1569,7 +1801,7 @@ def _run_mode(mode):
     if mode in ("data_service", "data-service"):
         mode = "data-service"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume", "checkpoint", "analyze", "serve",
+                "resume", "checkpoint", "analyze", "serve", "fleet",
                 "data-service", "roofline", "zero3"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
@@ -1591,6 +1823,8 @@ def _run_mode(mode):
         out.update(_roofline_bench())
     elif mode == "serve":
         out.update(_serve_bench())
+    elif mode == "fleet":
+        out.update(_fleet_bench())
     elif mode == "decode":
         out.update(_decode_bench())
     elif mode == "data-service":
@@ -1658,7 +1892,7 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "fed-cpu", "pipeline",
     "compile-probe", "resume", "checkpoint", "analyze", "serve",
-    "roofline", "zero3", "fed", "compute", "compute-large",
+    "fleet", "roofline", "zero3", "fed", "compute", "compute-large",
     "inception-bn", "resnet-152", "lstm",
 ))
 
@@ -1728,7 +1962,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup",
              "data_service_img_s", "data_service_scaling_x",
              "pipeline_decode_scaling_x", "roofline_*_speedup",
-             "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x")
+             "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
+             "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff")
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -1741,6 +1976,9 @@ SCALING_SHAPE_KEYS = {
     "pipeline_decode_scaling_x": "decode_scaling_note",
     "data_service_scaling_x": "data_service_scaling_note",
     "zero3_wide_mem_x": "zero3_mem_note",
+    # clients + router + 2 replicas need >= 4 cores to scale; smaller
+    # hosts note it and only the SHAPE key is exempted
+    "fleet_qps_x": "fleet_scaling_note",
 }
 
 
@@ -1923,6 +2161,7 @@ def main():
         parts.update(_collect("resume"))
         parts.update(_collect("checkpoint"))
         parts.update(_collect("serve"))
+        parts.update(_collect("fleet", timeout=600))
         parts.update(_collect("roofline"))
         parts.update(_collect("zero3"))
         parts.update(_collect("fed"))
@@ -1994,7 +2233,7 @@ def main():
             result[k] = parts[k]
     for k in sorted(parts):
         if k.startswith("serve_") or k.startswith("roofline_") \
-                or k.startswith("zero3_"):
+                or k.startswith("zero3_") or k.startswith("fleet_"):
             result[k] = parts[k]
     if compute is not None:
         if fed is None:

@@ -116,6 +116,9 @@ from . import module
 from . import module as mod
 from . import predict
 from . import serving
+# multi-replica serving fleet (jax-free package; imported for env
+# registry completeness, like serving)
+from . import fleet
 from . import test_utils
 from . import analysis
 # fused Pallas/lax kernels (registers the _FusedLSTMCell op and the

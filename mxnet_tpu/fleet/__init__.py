@@ -1,0 +1,49 @@
+"""mxfleet: a multi-replica serving fleet on the mxserve stack
+(docs/how_to/fleet.md).
+
+mxserve (``mxnet_tpu/serving/``) is ONE daemon: one process, one warm
+``ModelPool``, one public port — its QPS ceiling is one Python
+dispatcher and one device client.  This package composes N of those
+daemons into one serving system:
+
+- :mod:`.manifest` — the fleet manifest (models, replica count, device
+  placement) + each model's stable HOME replica.
+- :mod:`.controller` — replica lifecycle: spawns N real
+  ``tools/serve.py`` processes, each pinned to its own device subset
+  (``JAX_PLATFORMS``/visible-chip env, CPU-core affinity on the CPU
+  tier), supervised by the ``tools/supervise.py`` exit-code discipline
+  (85/87 relaunch with ``MXTPU_RESUME=1``; any other death respawns
+  within a streak budget; drains relaunch nothing).
+- :mod:`.router` — the routing front end that owns the public port:
+  route-by-model to the home replica, SPILL to the least-loaded
+  replica when the home's queue/SLO signal crosses the bar (the
+  ``/stats`` surface PR 6 built is the routing input), heartbeat-age
+  eviction off ``/healthz``, fail-once-never-retry on a dead replica,
+  SIGTERM drain that fences new work then drains every replica, and
+  fleet-level p50/p99/shed aggregation on ``/stats``.
+- :mod:`.warm` — the AOT warm store: pre-compile every (model, bucket)
+  forward into ``MXTPU_COMPILE_CACHE`` so a fresh or respawned replica
+  warms from disk instead of from XLA (``fleet_warm_start_x`` in
+  ``bench.py fleet`` measures the win; >= 3x is the bar).
+
+``tools/fleet.py`` is the CLI (``serve`` + ``warmup`` subcommands);
+``bench.py fleet`` is the load generator and self-proof.  All four
+``MXTPU_FLEET_*`` knobs are registered EAGERLY at their owner modules
+below (the PR-7 lazy-registration lesson); this package never imports
+jax — the router and controller are pure-host processes by design.
+"""
+from .manifest import (FleetManifest, parse_shape_specs,
+                       replica_device_env, default_serve_py,
+                       ENV_FLEET_REPLICAS)
+from .controller import Replica, ReplicaController
+from .router import (FleetRouter, NoHealthyReplica, ReplicaDead,
+                     ENV_FLEET_SPILL_QUEUE, ENV_FLEET_HEARTBEAT_S,
+                     ENV_FLEET_EVICT_S)
+from .warm import build_warm_store, warm_store_manifest
+
+__all__ = ["FleetManifest", "parse_shape_specs", "replica_device_env",
+           "default_serve_py", "Replica", "ReplicaController",
+           "FleetRouter", "NoHealthyReplica", "ReplicaDead",
+           "build_warm_store", "warm_store_manifest",
+           "ENV_FLEET_REPLICAS", "ENV_FLEET_SPILL_QUEUE",
+           "ENV_FLEET_HEARTBEAT_S", "ENV_FLEET_EVICT_S"]

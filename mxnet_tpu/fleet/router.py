@@ -1,0 +1,554 @@
+"""Routing front end: ONE public HTTP port in front of N replica
+daemons.
+
+The Clipper split, scaled out: the router owns admission and placement,
+the replicas own weights and batching.  Policy per request:
+
+- **route by model**: each model's HOME replica (a stable function of
+  the manifest — ``FleetManifest.home``) takes its traffic by default,
+  concentrating a model's buckets where they stay hot.
+- **spill**: when the home's reported queue depth for the model (the
+  ``/stats`` surface mxserve already exposes, plus the router's own
+  in-flight count toward that replica) reaches
+  ``MXTPU_FLEET_SPILL_QUEUE``, or its estimated wait crosses the SLO
+  bar, the request goes to the least-loaded healthy replica instead —
+  every replica holds the whole warm pool, so spilling needs no model
+  load.
+- **health**: a poll thread GETs ``/healthz`` + ``/stats`` from every
+  replica each ``MXTPU_FLEET_HEARTBEAT_S``; a replica whose last
+  successful heartbeat is older than ``MXTPU_FLEET_EVICT_S`` is EVICTED
+  from routing until it answers again (a respawned replica rejoins the
+  moment its new port file appears and a probe succeeds).
+
+IDEMPOTENCY STANCE: a request in flight to a replica that dies fails
+ONCE, visibly, with HTTP 502 — the router NEVER resends it.  The body
+may have reached the dead replica's batcher and been dispatched; a
+blind resend would execute a non-idempotent predict twice (double
+stats, two bucket slots, and for any side-effectful consumer a real
+double-fire).  Retry is the CLIENT's decision, who knows whether its
+request is idempotent.  NEW traffic reroutes immediately (the dead
+replica stops being routable on eviction, and every forwarding error
+biases the next route away from it).
+
+Shutdown: SIGTERM fences new work (503 on the public port), waits for
+the router's in-flight forwards, then forwards the drain to every
+replica through the controller (each drains to rc 0 — the mxserve
+contract), then stops.  ``/stats`` aggregates the per-replica counters
+plus the router-measured fleet-level p50/p99.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..base import MXNetError, get_env, register_env
+from ..serving.frontend import Stats
+
+__all__ = ["FleetRouter", "NoHealthyReplica", "ReplicaDead",
+           "ENV_FLEET_SPILL_QUEUE", "ENV_FLEET_HEARTBEAT_S",
+           "ENV_FLEET_EVICT_S"]
+
+ENV_FLEET_SPILL_QUEUE = register_env(
+    "MXTPU_FLEET_SPILL_QUEUE", default=8,
+    doc="Queue depth (replica-reported + router in-flight) at a model's "
+        "home replica beyond which the router spills the request to the "
+        "least-loaded healthy replica")
+ENV_FLEET_HEARTBEAT_S = register_env(
+    "MXTPU_FLEET_HEARTBEAT_S", default=1.0,
+    doc="Router health-poll period: every replica's /healthz + /stats "
+        "are probed this often (also the staleness bound on the routing "
+        "signal)")
+ENV_FLEET_EVICT_S = register_env(
+    "MXTPU_FLEET_EVICT_S", default=5.0,
+    doc="Heartbeat age beyond which a replica is evicted from routing "
+        "(it rejoins on the next successful probe — e.g. after the "
+        "controller respawned it warm from the AOT store)")
+
+
+class NoHealthyReplica(MXNetError):
+    """No routable replica for the request (HTTP 503)."""
+
+
+class ReplicaDead(MXNetError):
+    """The forward to the chosen replica failed at the transport level
+    (HTTP 502; NEVER retried — see the idempotency stance above)."""
+
+
+class _ReplicaView(object):
+    """The router's picture of one replica (updated by the health loop
+    + forwarding outcomes)."""
+
+    __slots__ = ("id", "addr", "last_ok", "stats", "inflight", "probes",
+                 "errors")
+
+    def __init__(self, rid):
+        self.id = rid
+        self.addr = None            # (host, port) once known
+        self.last_ok = None         # monotonic of last good /healthz
+        self.stats = None           # last /stats payload
+        self.inflight = 0           # router-side forwards in flight
+        self.probes = 0
+        self.errors = 0
+
+
+class FleetRouter(object):
+    """``endpoints``: a :class:`~.controller.ReplicaController` (live
+    port discovery + drain forwarding) or a static ``{id: (host,
+    port)}`` dict (tests, external replicas)."""
+
+    def __init__(self, endpoints, manifest, host="127.0.0.1", port=0,
+                 spill_queue=None, heartbeat_s=None, evict_s=None,
+                 slo_ms=0.0, request_timeout=60.0):
+        self.manifest = manifest
+        self.host, self.port = host, int(port)
+        self.spill_queue = int(get_env(ENV_FLEET_SPILL_QUEUE)
+                               if spill_queue is None else spill_queue)
+        self.heartbeat_s = float(get_env(ENV_FLEET_HEARTBEAT_S)
+                                 if heartbeat_s is None else heartbeat_s)
+        self.evict_s = float(get_env(ENV_FLEET_EVICT_S)
+                             if evict_s is None else evict_s)
+        self.slo_ms = float(slo_ms or 0.0)
+        self.request_timeout = float(request_timeout)
+        self.stats = Stats()
+        self.draining = False
+        self._controller = None
+        self._static = None
+        if hasattr(endpoints, "ports"):
+            self._controller = endpoints
+            n = len(endpoints.replicas)
+        else:
+            self._static = {rid: tuple(addr)
+                            for rid, addr in dict(endpoints).items()}
+            n = len(self._static)
+        if n < 1:
+            raise MXNetError("a fleet needs at least one replica")
+        self._views = {}
+        for rid in (self._static if self._static is not None
+                    else range(n)):
+            self._views[rid] = _ReplicaView(rid)
+        self._order = sorted(self._views)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._server = None
+        self._stopped = threading.Event()
+        self._stop_health = threading.Event()
+        self._health_thread = None
+        #: serve/drain handshake: a drain that arrives BEFORE the
+        #: accept loop starts marks _aborted so serve_forever returns
+        #: immediately instead of serving a drained fleet forever;
+        #: once _serving, the drain uses server.shutdown().  The lock
+        #: makes the two transitions atomic — without it a drain could
+        #: check "not serving yet" in the same instant the accept loop
+        #: starts, and neither side would stop the server.
+        self._life_lock = threading.Lock()
+        self._serving = False
+        self._aborted = False
+        self.replica_rcs = None     # {id: rc} after a drain
+
+    # -- replica discovery + health ---------------------------------------
+    def _addresses(self):
+        if self._static is not None:
+            return dict(self._static)
+        return {rid: ("127.0.0.1", port) if port is not None else None
+                for rid, port in self._controller.ports().items()}
+
+    def _probe_one(self, view, addr):
+        """One /healthz (+ /stats) round trip; returns True when the
+        replica answered healthy."""
+        import http.client
+        conn = http.client.HTTPConnection(
+            addr[0], addr[1], timeout=max(0.2, min(self.heartbeat_s,
+                                                   2.0)))
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return False
+            payload = json.loads(body.decode("utf-8"))
+            if payload.get("status") == "draining":
+                # a draining replica takes no work — evict it NOW, not
+                # after the heartbeat age runs out (a rolling restart
+                # would otherwise bounce 503s off it for evict_s)
+                with self._lock:
+                    view.last_ok = None
+                return False
+            conn.request("GET", "/stats")
+            resp = conn.getresponse()
+            sbody = resp.read()
+            stats = json.loads(sbody.decode("utf-8")) \
+                if resp.status == 200 else None
+        except Exception:  # noqa: BLE001 — any transport failure = miss
+            return False
+        finally:
+            conn.close()
+        with self._lock:
+            view.addr = addr
+            view.last_ok = time.monotonic()
+            if stats is not None:
+                view.stats = stats
+        return True
+
+    def probe(self):
+        """One full probe pass (the health loop's body; also called
+        synchronously at start so the first routed request never races
+        the first heartbeat)."""
+        addrs = self._addresses()
+        for rid, view in self._views.items():
+            view.probes += 1
+            addr = addrs.get(rid)
+            if addr is None:
+                continue            # no port file yet (spawning)
+            self._probe_one(view, addr)
+        return self.healthy()
+
+    def _health_loop(self):
+        while not self._stop_health.wait(self.heartbeat_s):
+            try:
+                self.probe()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
+    def healthy(self):
+        """Routable replica ids: probed OK within the eviction window."""
+        now = time.monotonic()
+        with self._lock:
+            return [rid for rid in self._order
+                    if self._views[rid].last_ok is not None
+                    and now - self._views[rid].last_ok <= self.evict_s
+                    and self._views[rid].addr is not None]
+
+    # -- routing policy ----------------------------------------------------
+    def _load(self, view, model=None):
+        """Routing load signal: replica-reported queue depth (per model
+        when asked, total otherwise) + the router's own in-flight count
+        toward it (the fast-moving half of the signal)."""
+        depth = 0
+        if view.stats:
+            depths = view.stats.get("queue_depth") or {}
+            depth = depths.get(model, 0) if model is not None \
+                else sum(depths.values())
+        return depth + view.inflight
+
+    def route(self, model):
+        """Pick the replica for one request; raises
+        :class:`NoHealthyReplica` when nothing is routable.  Returns
+        ``(replica_id, reason)`` with ``reason`` one of ``None`` (the
+        healthy home took it), ``"spilled"`` (the home was healthy but
+        past its depth/SLO bar — the LOAD policy moved it) or
+        ``"rerouted"`` (the home was not routable — failover, counted
+        separately so the spill counter stays evidence of load spill,
+        not of dead homes)."""
+        if model not in self.manifest.models:
+            raise MXNetError("no model %r in the fleet manifest "
+                             "(have: %s)" % (model, self.manifest.names()))
+        candidates = self.healthy()
+        if not candidates:
+            raise NoHealthyReplica(
+                "no healthy replica for %r (fleet of %d, all evicted "
+                "or starting)" % (model, len(self._views)))
+        home = self._order[self.manifest.home(model) % len(self._order)]
+        with self._lock:
+            if home in candidates:
+                hview = self._views[home]
+                depth = self._load(hview, model)
+                est = ((hview.stats or {}).get("est_wait_ms") or {}) \
+                    .get(model, 0.0)
+                if depth < self.spill_queue and \
+                        (self.slo_ms <= 0 or est <= self.slo_ms):
+                    return home, None
+            # spill/reroute: least-loaded healthy replica, ties broken
+            # AWAY from the home — a home past its bar sheds overflow
+            # when loads tie (that is what the bar means), but a
+            # deeper-loaded alternative never wins just for not being
+            # the home (spill balances load, it must not invert it)
+            best = min(candidates,
+                       key=lambda rid: (self._load(self._views[rid]),
+                                        rid == home, rid))
+        if best == home:
+            return best, None
+        return best, "spilled" if home in candidates else "rerouted"
+
+    # -- forwarding --------------------------------------------------------
+    #: retire a pooled keep-alive connection idle longer than this:
+    #: the replica handler's socket timeout closes ITS side after 10s
+    #: (serving/frontend.py), and a request written onto such a socket
+    #: fails at getresponse() — which this router must treat as a dead
+    #: replica (fail once, never resend).  Refreshing before the
+    #: replica's deadline keeps idle gaps from minting spurious 502s.
+    CONN_IDLE_S = 5.0
+
+    def _connection(self, rid, addr, fresh=False):
+        """Per-(handler-)thread keep-alive connection to a replica."""
+        import http.client
+        pool = getattr(self._local, "conns", None)
+        if pool is None:
+            pool = self._local.conns = {}
+        key = (rid, addr)
+        now = time.monotonic()
+        entry = pool.get(key)
+        if entry is not None and not fresh and \
+                now - entry[1] <= self.CONN_IDLE_S:
+            conn = entry[0]
+        else:
+            if entry is not None:
+                entry[0].close()
+            conn = http.client.HTTPConnection(
+                addr[0], addr[1], timeout=self.request_timeout)
+        pool[key] = (conn, now)
+        return conn
+
+    def forward(self, rid, method, path, body=None, headers=None):
+        """One proxied request -> ``(status, raw_body, content_type)``.
+        A transport failure raises :class:`ReplicaDead` — exactly once,
+        no resend (idempotency stance)."""
+        with self._lock:
+            addr = self._views[rid].addr
+        if addr is None:
+            raise ReplicaDead("replica %d has no known address" % rid)
+        try:
+            conn = self._connection(rid, addr)
+            try:
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+            except Exception:
+                # the keep-alive socket may have idled out between
+                # requests; ONE fresh connection for the SEND phase only
+                # (nothing reached the replica yet — not a resend)
+                conn = self._connection(rid, addr, fresh=True)
+                conn.request(method, path, body=body,
+                             headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            ctype = resp.getheader("Content-Type") or "application/json"
+            return resp.status, data, ctype
+        except Exception as e:  # noqa: BLE001 — transport-level loss
+            pool = getattr(self._local, "conns", None)
+            dead = pool.pop((rid, addr), None) if pool else None
+            if dead is not None:
+                try:
+                    dead[0].close()
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            with self._lock:
+                self._views[rid].errors += 1
+            raise ReplicaDead(
+                "replica %d died mid-request (%s: %s); NOT retried — "
+                "resending a non-idempotent predict could execute it "
+                "twice" % (rid, type(e).__name__, e))
+
+    def proxy_predict(self, model, body, headers):
+        """The full per-request path: fence -> route -> forward ->
+        account.  Returns ``(status, raw_body, content_type)``."""
+        if self.draining:
+            return 503, json.dumps(
+                {"error": "fleet is draining"}).encode("utf-8"), \
+                "application/json"
+        try:
+            rid, reason = self.route(model)
+        except NoHealthyReplica as e:
+            self.stats.inc("no_replica")
+            return 503, json.dumps(
+                {"error": str(e)}).encode("utf-8"), "application/json"
+        except MXNetError as e:     # unknown model
+            return 404, json.dumps(
+                {"error": str(e)}).encode("utf-8"), "application/json"
+        with self._lock:
+            self._views[rid].inflight += 1
+        tic = time.monotonic()
+        try:
+            status, data, ctype = self.forward(
+                rid, "POST", "/predict/%s" % model, body=body,
+                headers=headers)
+        except ReplicaDead as e:
+            self.stats.inc("replica_errors")
+            return 502, json.dumps(
+                {"error": str(e), "replica": rid,
+                 "retried": False}).encode("utf-8"), "application/json"
+        finally:
+            with self._lock:
+                self._views[rid].inflight -= 1
+        self.stats.inc("routed")
+        if reason is not None:
+            self.stats.inc(reason)      # "spilled" | "rerouted"
+        self.stats.record_latency((time.monotonic() - tic) * 1000.0)
+        return status, data, ctype
+
+    # -- observation -------------------------------------------------------
+    def stats_payload(self):
+        """Fleet-level aggregation: router counters + router-measured
+        p50/p99 (every request crosses the router, so its window IS the
+        fleet latency distribution) + summed per-replica shed/served
+        counters + the per-replica table."""
+        healthy = set(self.healthy())
+        fleet_counters = {}
+        replicas = {}
+        ctrl = {r["id"]: r for r in self._controller.snapshot()} \
+            if self._controller is not None else {}
+        now = time.monotonic()
+        with self._lock:
+            for rid in self._order:
+                view = self._views[rid]
+                entry = {"healthy": rid in healthy,
+                         "port": view.addr[1] if view.addr else None,
+                         "inflight": view.inflight,
+                         "forward_errors": view.errors,
+                         "heartbeat_age_s":
+                             round(now - view.last_ok, 3)
+                             if view.last_ok is not None else None}
+                if view.stats:
+                    entry["queue_depth"] = view.stats.get("queue_depth")
+                    entry["est_wait_ms"] = view.stats.get("est_wait_ms")
+                    for k, v in (view.stats.get("counters")
+                                 or {}).items():
+                        fleet_counters[k] = fleet_counters.get(k, 0) + v
+                entry.update(ctrl.get(rid, {}))
+                replicas[rid] = entry
+        payload = {"router": self.stats.snapshot(),
+                   "replicas": replicas,
+                   "fleet": {"counters": fleet_counters,
+                             "models": self.manifest.names(),
+                             "replicas_total": len(self._order),
+                             "replicas_healthy": len(healthy)},
+                   "draining": self.draining}
+        # fleet p50/p99 = the router's own end-to-end window
+        payload["fleet"]["latency_ms"] = payload["router"]["latency_ms"]
+        return payload
+
+    def healthz_payload(self):
+        healthy = self.healthy()
+        return {"status": "draining" if self.draining else "ok",
+                "replicas": len(self._order),
+                "replicas_healthy": len(healthy),
+                "healthy_ids": healthy}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Bind the public port, run one synchronous probe pass, start
+        the health loop.  Returns self (``self.port`` holds the real
+        port)."""
+        if self._server is not None:
+            return self
+        router = self
+
+        class Handler(_Handler):
+            rt = router
+
+        self._server = ThreadingHTTPServer((self.host, self.port),
+                                           Handler)
+        self._server.daemon_threads = False
+        self._server.block_on_close = True
+        self.port = self._server.server_address[1]
+        self.probe()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="mxfleet-health", daemon=True)
+        self._health_thread.start()
+        return self
+
+    def serve_forever(self):
+        self.start()
+        with self._life_lock:
+            if self._aborted:       # drained before the loop started
+                self._server.server_close()
+                self._stopped.set()
+                return
+            self._serving = True
+        try:
+            self._server.serve_forever(poll_interval=0.1)
+        finally:
+            self._server.server_close()
+            self._stopped.set()
+
+    def serve_in_background(self):
+        self.start()
+        t = threading.Thread(target=self.serve_forever,
+                             name="mxfleet-http", daemon=True)
+        t.start()
+        return self
+
+    def drain_and_stop(self, timeout=60.0):
+        """SIGTERM path: fence new work, wait out the router's own
+        in-flight forwards, drain every replica through the controller,
+        stop.  Idempotent."""
+        self.draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(v.inflight == 0 for v in self._views.values()):
+                    break
+            time.sleep(0.05)
+        self._stop_health.set()
+        if self._controller is not None:
+            self.replica_rcs = self._controller.drain(
+                timeout=max(1.0, deadline - time.monotonic()))
+        with self._life_lock:
+            serving = self._serving
+            if not serving:
+                self._aborted = True
+        if serving and self._server is not None:
+            self._server.shutdown()
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)):
+        def _on_signal(signum, frame):
+            threading.Thread(target=self.drain_and_stop,
+                             name="mxfleet-drain", daemon=True).start()
+        for sig in signals:
+            signal.signal(sig, _on_signal)
+        return self
+
+    def wait_stopped(self, timeout=None):
+        return self._stopped.wait(timeout)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Thin proxy handler onto the owning :class:`FleetRouter` (``rt``
+    class attr, set by ``start()``)."""
+
+    rt = None
+    protocol_version = "HTTP/1.1"
+    #: same rationale as the mxserve handler: bound idle keep-alive
+    #: reads so block_on_close joins cannot wedge the drain
+    timeout = 10.0
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply_raw(self, status, body, ctype):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply(self, status, payload):
+        self._reply_raw(status, json.dumps(payload).encode("utf-8"),
+                        "application/json")
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, self.rt.healthz_payload())
+        elif self.path == "/stats":
+            self._reply(200, self.rt.stats_payload())
+        else:
+            self._reply(404, {"error": "unknown path %r" % self.path})
+
+    def do_POST(self):
+        if not self.path.startswith("/predict/"):
+            self._reply(404, {"error": "unknown path %r" % self.path})
+            return
+        model = self.path[len("/predict/"):].strip("/")
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        fwd_headers = {"Content-Type":
+                       self.headers.get("Content-Type")
+                       or "application/json"}
+        for h in ("X-MXTPU-Priority", "X-MXTPU-Deadline-Ms"):
+            if self.headers.get(h) is not None:
+                fwd_headers[h] = self.headers[h]
+        status, data, ctype = self.rt.proxy_predict(model, body,
+                                                    fwd_headers)
+        self._reply_raw(status, data, ctype)

@@ -17,11 +17,11 @@ a traffic-serving daemon:
 ``tools/serve.py`` is the CLI daemon; ``bench.py``'s ``serve`` mode is
 the load generator.
 """
-from .batcher import (BucketBatcher, Draining, QueueFull, parse_buckets,
-                      pick_bucket, pad_to_bucket)
+from .batcher import (BucketBatcher, DeadlineExpired, Draining, QueueFull,
+                      parse_buckets, pick_bucket, pad_to_bucket)
 from .pool import ModelPool, PooledModel
 from .frontend import ServeClient, ServingFrontend, Stats
 
-__all__ = ["BucketBatcher", "Draining", "QueueFull", "parse_buckets",
-           "pick_bucket", "pad_to_bucket", "ModelPool", "PooledModel",
-           "ServeClient", "ServingFrontend", "Stats"]
+__all__ = ["BucketBatcher", "DeadlineExpired", "Draining", "QueueFull",
+           "parse_buckets", "pick_bucket", "pad_to_bucket", "ModelPool",
+           "PooledModel", "ServeClient", "ServingFrontend", "Stats"]

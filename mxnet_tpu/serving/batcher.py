@@ -14,6 +14,19 @@ latency.  Only when the queue is smaller than the largest bucket does a
 max-wait timer (``MXTPU_SERVE_MAX_WAIT_MS``, measured from the OLDEST
 queued request) hold the batch open for stragglers.
 
+PRIORITY + DEADLINES (the anti-starvation half of the SLO story): a
+request may carry ``priority`` (higher dispatches first; default 0) and
+``deadline_ms`` (a per-request latency budget).  The dispatcher fills
+each bucket highest-priority-first — FIFO *within* a priority level, so
+equal-priority traffic keeps the exact historical order — and a queued
+request whose deadline passes before dispatch is EXPIRED with
+:class:`DeadlineExpired` (HTTP 429, ``shed_deadline`` on ``/stats``)
+instead of being served as dead work the client already gave up on.
+Strictly-FIFO dispatch let one slow tenant hold every later request's
+latency hostage; priority ordering bounds that blast radius without
+touching the bit-exactness contract (a request's result never depends
+on its co-batched rows — only WHEN it runs changes).
+
 BIT-EXACTNESS CONTRACT: a request's result depends only on its own
 bytes and the bucket shape it ran at — never on batch fill, its row
 position, or co-batched requests.  (XLA re-tiles reductions per batch
@@ -27,18 +40,19 @@ NaN/Inf paths the real rows didn't have.
 """
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
-from collections import deque
 
 import numpy as np
 
 from ..base import MXNetError, get_env, register_env
 from ..resilience import faults
 
-__all__ = ["BucketBatcher", "QueueFull", "Draining", "parse_buckets",
-           "pick_bucket", "pad_to_bucket", "ENV_SERVE_BUCKETS",
-           "ENV_SERVE_MAX_WAIT_MS"]
+__all__ = ["BucketBatcher", "QueueFull", "Draining", "DeadlineExpired",
+           "parse_buckets", "pick_bucket", "pad_to_bucket",
+           "ENV_SERVE_BUCKETS", "ENV_SERVE_MAX_WAIT_MS"]
 
 ENV_SERVE_BUCKETS = register_env(
     "MXTPU_SERVE_BUCKETS", default="1,2,4,8,16,32",
@@ -64,6 +78,12 @@ class QueueFull(MXNetError):
 
 class Draining(MXNetError):
     """Admission refused: the daemon is draining for shutdown."""
+
+
+class DeadlineExpired(MXNetError):
+    """The request's deadline passed before its batch dispatched (HTTP
+    429, ``shed_deadline``) — the client has already given up, so
+    serving it would burn a bucket slot on dead work."""
 
 
 def parse_buckets(spec=None):
@@ -139,12 +159,22 @@ class _Future(object):
 
 
 class _Request(object):
-    __slots__ = ("inputs", "future", "enqueued_at")
+    __slots__ = ("inputs", "future", "enqueued_at", "priority",
+                 "deadline", "seq")
 
-    def __init__(self, inputs):
+    def __init__(self, inputs, priority=0, deadline=None, seq=0):
         self.inputs = inputs
         self.future = _Future()
         self.enqueued_at = time.monotonic()
+        self.priority = int(priority)
+        self.deadline = deadline            # absolute monotonic, or None
+        self.seq = seq
+
+    def heap_key(self):
+        """Dispatch order: highest priority first, FIFO (arrival seq)
+        within a priority level — the historical strict-FIFO order is
+        the seq tiebreak, so equal-priority traffic is untouched."""
+        return (-self.priority, self.seq)
 
 
 class BucketBatcher(object):
@@ -169,7 +199,14 @@ class BucketBatcher(object):
         self.watchdog = watchdog            # owns admission control)
         self.stats = stats
         self._cv = threading.Condition()
-        self._queue = deque()
+        #: heap of (heap_key, _Request): highest priority first, FIFO
+        #: within a level (seq tiebreak)
+        self._queue = []
+        self._seq = itertools.count()
+        #: queued requests carrying a deadline — the common
+        #: deadline-less workload keeps the dispatcher's expiry check
+        #: O(1) instead of scanning the heap every wake
+        self._deadlines = 0
         self._inflight = 0
         self._draining = False
         self._closing = False
@@ -198,10 +235,23 @@ class BucketBatcher(object):
             return 0.0
         return depth / float(self.buckets[-1]) * ema * 1000.0
 
-    def submit(self, inputs):
+    def submit(self, inputs, priority=0, deadline_ms=None):
         """Queue one request (``{input_name: per-sample float32 array}``,
-        NO batch dimension) -> future.  Raises :class:`Draining` during
-        shutdown and :class:`QueueFull` at the queue bound."""
+        NO batch dimension) -> future.  ``priority``: higher dispatches
+        first (default 0 — all-equal keeps strict FIFO).  ``deadline_ms``:
+        latency budget; a request still queued when it runs out is shed
+        with :class:`DeadlineExpired` (a non-positive budget sheds
+        immediately).  Raises :class:`Draining` during shutdown and
+        :class:`QueueFull` at the queue bound."""
+        deadline = None
+        if deadline_ms is not None:
+            if float(deadline_ms) <= 0:
+                if self.stats is not None:
+                    self.stats.inc("shed_deadline")
+                raise DeadlineExpired(
+                    "model %r: deadline budget %.1fms already spent"
+                    % (self.name, float(deadline_ms)))
+            deadline = time.monotonic() + float(deadline_ms) / 1000.0
         shapes = {k: tuple(np.shape(v)) for k, v in inputs.items()}
         with self._cv:
             if self._draining:
@@ -216,8 +266,11 @@ class BucketBatcher(object):
                 raise MXNetError(
                     "request shapes %s do not match the model's %s"
                     % (shapes, self._sample_shapes))
-            req = _Request(inputs)
-            self._queue.append(req)
+            req = _Request(inputs, priority=priority, deadline=deadline,
+                           seq=next(self._seq))
+            heapq.heappush(self._queue, (req.heap_key(), req))
+            if deadline is not None:
+                self._deadlines += 1
             self._cv.notify_all()
         return req.future
 
@@ -234,24 +287,82 @@ class BucketBatcher(object):
                     self._inflight = 0
                     self._cv.notify_all()
 
+    def _expire_locked(self):
+        """Drop queued requests whose deadline has passed (call with
+        ``_cv`` held): their futures raise :class:`DeadlineExpired` and
+        ``shed_deadline`` counts them — dispatching them would spend a
+        bucket slot on work the client has already abandoned."""
+        if not self._deadlines:
+            return                  # O(1) for deadline-less traffic
+        now = time.monotonic()
+        if not any(r.deadline is not None and r.deadline <= now
+                   for _, r in self._queue):
+            return
+        live, dead = [], []
+        for entry in self._queue:
+            req = entry[1]
+            if req.deadline is not None and req.deadline <= now:
+                dead.append(req)
+            else:
+                live.append(entry)
+        heapq.heapify(live)
+        self._queue = live
+        self._deadlines -= len(dead)
+        for req in dead:
+            req.future.set_error(DeadlineExpired(
+                "model %r: deadline passed after %.1fms queued"
+                % (self.name, (now - req.enqueued_at) * 1000.0)))
+            if self.stats is not None:
+                self.stats.inc("shed_deadline")
+
+    #: anti-starvation floor: a queued request older than
+    #: ``max(8 x max_wait, STARVATION_S)`` seconds claims one slot of
+    #: the next batch UNCONDITIONALLY, priority notwithstanding.
+    #: Without it, sustained higher-priority arrivals at >= service
+    #: rate could hold a low-priority request in the queue forever
+    #: (the max-wait timer forces *a* dispatch, not *its* dispatch) —
+    #: priorities delay work, they must never starve it.  One slot per
+    #: batch gives the aged head-of-line guaranteed progress while the
+    #: rest of the bucket still fills highest-priority-first.
+    STARVATION_S = 0.25
+
     def _next_batch(self):
         """Block for the first request, then hold the batch open until
         the largest bucket fills or the oldest request ages past
-        max_wait (draining skips the wait — flush what is queued)."""
+        max_wait (draining skips the wait — flush what is queued).
+        Selection order is the heap's: priority desc, arrival FIFO
+        within a level — except that a request past the starvation
+        bound rides first (see :data:`STARVATION_S`); past-deadline
+        entries are expired, never dispatched."""
         cap = self.buckets[-1]
         with self._cv:
-            while not self._queue:
-                if self._closing:
-                    return None
-                self._cv.wait(0.1)
-            oldest = self._queue[0].enqueued_at
-            while len(self._queue) < cap and not self._draining:
+            while True:
+                self._expire_locked()
+                if not self._queue:
+                    if self._closing:
+                        return None
+                    self._cv.wait(0.1)
+                    continue
+                # max-wait is measured from the OLDEST queued request
+                # regardless of its priority — a low-priority straggler
+                # cannot be deferred past the wait bound
+                oldest = min(r.enqueued_at for _, r in self._queue)
                 left = self.max_wait - (time.monotonic() - oldest)
-                if left <= 0:
+                if len(self._queue) >= cap or self._draining or left <= 0:
                     break
                 self._cv.wait(min(left, 0.02))
-            batch = [self._queue.popleft()
-                     for _ in range(min(len(self._queue), cap))]
+            take = min(len(self._queue), cap)
+            batch = []
+            eldest = min(self._queue, key=lambda e: e[1].enqueued_at)
+            bound = max(8.0 * self.max_wait, self.STARVATION_S)
+            if time.monotonic() - eldest[1].enqueued_at > bound:
+                self._queue.remove(eldest)
+                heapq.heapify(self._queue)
+                batch.append(eldest[1])
+            while len(batch) < take:
+                batch.append(heapq.heappop(self._queue)[1])
+            self._deadlines -= sum(1 for r in batch
+                                   if r.deadline is not None)
             self._inflight = len(batch)
         return batch
 
@@ -305,7 +416,8 @@ class BucketBatcher(object):
         with self._cv:
             self._draining = True
             if not drain:
-                dropped, self._queue = list(self._queue), deque()
+                dropped, self._queue = [r for _, r in self._queue], []
+                self._deadlines = 0
             else:
                 dropped = []
             self._cv.notify_all()

@@ -28,7 +28,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..base import MXNetError, get_env, register_env
-from .batcher import BucketBatcher, Draining, QueueFull, parse_buckets
+from .batcher import (BucketBatcher, DeadlineExpired, Draining, QueueFull,
+                      parse_buckets)
 
 __all__ = ["ServingFrontend", "ServeClient", "Stats",
            "ENV_SERVE_MAX_QUEUE", "ENV_SERVE_SLO_MS"]
@@ -60,7 +61,8 @@ class Stats(object):
     def __init__(self, window=4096):
         self._lock = threading.Lock()
         self._counters = {"accepted": 0, "completed": 0, "errors": 0,
-                          "shed_queue": 0, "shed_slo": 0, "rejected": 0}
+                          "shed_queue": 0, "shed_slo": 0,
+                          "shed_deadline": 0, "rejected": 0}
         self._latencies = deque(maxlen=window)
         self._batches = 0
         self._rows = 0
@@ -206,11 +208,14 @@ class ServingFrontend(object):
                                     "%.0fms" % (est, self.slo_ms))
         return True, 200, None
 
-    def handle_predict(self, model, inputs, entry=None):
+    def handle_predict(self, model, inputs, entry=None, priority=0,
+                       deadline_ms=None):
         """Admission + batch + wait; returns ``(status, payload_dict)``.
         Usable without the HTTP layer (tests, in-process serving).
         ``entry`` skips the pool lookup when the caller (the HTTP
-        handler's 404 check) already resolved it."""
+        handler's 404 check) already resolved it.  ``priority`` and
+        ``deadline_ms`` pass through to :meth:`BucketBatcher.submit`
+        (deadline expiry answers 429 ``shed_deadline``)."""
         if entry is None:
             entry = self.pool.get(model)
         if entry.sample_shapes is not None:
@@ -226,11 +231,21 @@ class ServingFrontend(object):
         ok, status, reason = self._admit(b)
         if not ok:
             return status, {"error": reason, "model": model}
-        self.stats.inc("accepted")
         tic = time.monotonic()
         try:
-            fut = b.submit(inputs)
+            fut = b.submit(inputs, priority=priority,
+                           deadline_ms=deadline_ms)
+            # counted only once the request actually entered the queue
+            # — a submit-time shed (spent deadline, drain/bound race)
+            # must not inflate `accepted` the way shed_queue/shed_slo
+            # don't (the accepted-vs-completed ledger on /stats)
+            self.stats.inc("accepted")
             outs = fut.result(timeout=self.request_timeout)
+        except DeadlineExpired as e:
+            # shed, not failed: the batcher already counted
+            # shed_deadline — same 429 contract as shed_queue/shed_slo
+            return 429, {"error": str(e), "model": model,
+                         "reason": "shed_deadline"}
         except (Draining, QueueFull) as e:
             # lost the race with a drain/bound between admit and submit
             self.stats.inc("rejected")
@@ -252,6 +267,13 @@ class ServingFrontend(object):
         payload = self.stats.snapshot()
         payload["models"] = self.pool.names()
         payload["queue_depth"] = self.queue_depths()
+        with self._lock:
+            batchers = dict(self._batchers)
+        # the routing signal a fleet front end spills on: per-model
+        # estimated queue wait (docs/how_to/fleet.md)
+        payload["est_wait_ms"] = {
+            name: round(b.estimate_wait_ms(), 3)
+            for name, b in batchers.items()}
         payload["draining"] = self.draining
         payload["buckets"] = list(self.buckets)
         return payload
@@ -361,6 +383,18 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": "unknown path %r" % self.path})
 
+    def _qos(self, payload=None):
+        """(priority, deadline_ms) from the ``X-MXTPU-Priority`` /
+        ``X-MXTPU-Deadline-Ms`` headers, overridden by same-named JSON
+        body fields (``priority`` / ``deadline_ms``) when present."""
+        priority = self.headers.get("X-MXTPU-Priority")
+        deadline = self.headers.get("X-MXTPU-Deadline-Ms")
+        if payload is not None and isinstance(payload, dict):
+            priority = payload.get("priority", priority)
+            deadline = payload.get("deadline_ms", deadline)
+        return (int(priority) if priority is not None else 0,
+                float(deadline) if deadline is not None else None)
+
     def _parse_inputs(self, entry):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
@@ -369,7 +403,8 @@ class _Handler(BaseHTTPRequestHandler):
             import io as _pyio
             arr = np.load(_pyio.BytesIO(body), allow_pickle=False)
             return {entry.input_names[0]:
-                    np.ascontiguousarray(arr, dtype=np.float32)}
+                    np.ascontiguousarray(arr, dtype=np.float32)}, \
+                self._qos()
         payload = json.loads(body.decode("utf-8"))
         raw = payload.get("inputs", payload)
         inputs = {}
@@ -379,7 +414,7 @@ class _Handler(BaseHTTPRequestHandler):
         if set(inputs) != set(entry.input_names):
             raise ValueError("need inputs %s, got %s"
                              % (entry.input_names, sorted(raw)))
-        return inputs
+        return inputs, self._qos(payload)
 
     def do_POST(self):
         if not self.path.startswith("/predict/"):
@@ -392,12 +427,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": str(e)})
             return
         try:
-            inputs = self._parse_inputs(entry)
+            inputs, (priority, deadline_ms) = self._parse_inputs(entry)
         except Exception as e:  # noqa: BLE001 — malformed client body
             self._reply(400, {"error": "bad request body: %s" % (e,)})
             return
-        status, payload = self.fe.handle_predict(model, inputs,
-                                                 entry=entry)
+        status, payload = self.fe.handle_predict(
+            model, inputs, entry=entry, priority=priority,
+            deadline_ms=deadline_ms)
         self._reply(status, payload)
 
 
@@ -454,11 +490,19 @@ class ServeClient(object):
             payload = {"raw": data.decode("utf-8", "replace")}
         return resp.status, payload
 
-    def predict(self, model, inputs, npy=False):
+    def predict(self, model, inputs, npy=False, priority=None,
+                deadline_ms=None):
         """``inputs``: {name: per-sample array} (or a bare array for the
-        single-input case).  Returns ``(status, payload)``."""
+        single-input case).  ``priority``/``deadline_ms`` ride as
+        ``X-MXTPU-*`` headers (work on both body formats).  Returns
+        ``(status, payload)``."""
         if not isinstance(inputs, dict):
             inputs = {"data": inputs}
+        qos = {}
+        if priority is not None:
+            qos["X-MXTPU-Priority"] = str(int(priority))
+        if deadline_ms is not None:
+            qos["X-MXTPU-Deadline-Ms"] = str(float(deadline_ms))
         if npy:
             import io as _pyio
             (name, arr), = inputs.items()
@@ -466,12 +510,13 @@ class ServeClient(object):
             np.save(buf, np.asarray(arr, dtype=np.float32))
             return self._request(
                 "POST", "/predict/%s" % model, body=buf.getvalue(),
-                headers={"Content-Type": "application/x-npy"})
+                headers={"Content-Type": "application/x-npy", **qos})
         body = json.dumps(
             {"inputs": {k: np.asarray(v).tolist()
                         for k, v in inputs.items()}}).encode("utf-8")
-        return self._request("POST", "/predict/%s" % model, body=body,
-                             headers={"Content-Type": "application/json"})
+        return self._request(
+            "POST", "/predict/%s" % model, body=body,
+            headers={"Content-Type": "application/json", **qos})
 
     def healthz(self):
         return self._request("GET", "/healthz")

@@ -15,7 +15,16 @@ Weights stay device-resident inside each model's ``predict.Predictor``
 (bound executors per bucket shape).  ``MXTPU_SERVE_DTYPE=bfloat16``
 casts floating-point weights at load time (half the HBM + memory
 bandwidth per forward; inputs stay f32 and XLA promotes), the classic
-weight-cast serving mode.
+weight-cast serving mode.  ``MXTPU_SERVE_DTYPE=int8`` goes further:
+dense/conv weights are quantized per OUTPUT CHANNEL with a symmetric
+scale (``q = round(w / s)`` clipped to ±127, ``s = max|w| / 127`` over
+the channel — ≤0.4% relative weight error by construction), the int8
+tensors + f32 scales are what lives in device memory (~1/4 the bytes),
+and dequantization ``q.astype(f32) * s`` happens INSIDE the compiled
+forward right at the consuming matmul/conv, where XLA fuses it into
+the dot operand — weight-only quantization, activations stay f32.
+Non-eligible params (biases, BN stats, 1-D tensors) follow the same
+cast path bfloat16 uses.  Accuracy contract: docs/how_to/serving.md.
 
 ``analyze()`` runs the mxlint graph rules over a bucket forward —
 donation/dtype/callback/collective hygiene applies to inference graphs
@@ -35,9 +44,108 @@ __all__ = ["ModelPool", "PooledModel", "ENV_SERVE_DTYPE"]
 ENV_SERVE_DTYPE = register_env(
     "MXTPU_SERVE_DTYPE", default="float32",
     doc="Serving weight dtype: `bfloat16` casts floating-point weights "
-        "at load time (weight-cast serving; inputs stay f32)")
+        "at load time (weight-cast serving; inputs stay f32); `int8` "
+        "quantizes dense/conv weights per output channel (symmetric "
+        "scale, dequant inside the compiled forward at the matmul) — "
+        "tolerance contract in docs/how_to/serving.md")
 
 _CASTABLE = ("float32", "float64")
+
+
+def quantize_int8(weight):
+    """Per-output-channel symmetric int8 quantization of one weight.
+
+    Axis 0 is the output channel for both FullyConnected ``(out, in)``
+    and Convolution ``(out, in, kh, kw)`` weights (the reference
+    layout).  Returns ``(q int8, scale f32)`` with ``scale`` shaped
+    ``(out, 1, ...)`` so ``q * scale`` broadcasts back; an all-zero
+    channel gets scale 1 (its q rows are zero anyway) so dequant never
+    divides by zero."""
+    w = np.asarray(weight.asnumpy() if hasattr(weight, "asnumpy")
+                   else weight, dtype=np.float32)
+    reduce_axes = tuple(range(1, w.ndim))
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _int8_eligible(name, value):
+    """Weight-only quantization targets: the 2-D+ ``*weight`` tensors
+    (dense/conv kernels).  Biases, BN gamma/beta/moving stats and
+    embeddings-as-1-D stay in float — they are small and scale-critical."""
+    dt = np.dtype(getattr(value, "dtype", np.float32)).name
+    return (name.endswith("weight") and dt in _CASTABLE
+            and len(getattr(value, "shape", ())) >= 2)
+
+
+class _Int8Forward(object):
+    """The int8 serving executor: device-resident int8 weights + f32
+    per-channel scales, one jitted forward per input-shape signature.
+    The traced program's first ops dequantize each quantized weight
+    (``q.astype(f32) * scale``) so XLA fuses the dequant straight into
+    the consuming dot/conv operand — device memory holds the int8
+    bytes, the f32 weight exists only as a fusion temp.  Mirrors
+    ``predict.Predictor``'s per-shape program cache, so the bucket
+    bit-exactness contract holds unchanged: one program per bucket
+    shape, rows independent of fill/position/co-tenants."""
+
+    def __init__(self, model):
+        import jax.numpy as jnp
+        from ..executor import _build_eval
+        from .aot import dev_array
+
+        self._sym = model.symbol
+        self._eval = _build_eval(model.symbol)
+        self._q, self._plain = {}, {}
+        for k, v in model.arg_params.items():
+            if k in model._wt_scales:
+                self._q[k] = jnp.asarray(np.asarray(v))     # int8 bytes
+            else:
+                self._plain[k] = dev_array(v)
+        self._scales = {k: jnp.asarray(s)
+                        for k, s in model._wt_scales.items()}
+        self._aux = {k: dev_array(v)
+                     for k, v in model.aux_params.items()}
+        self._cache = {}            # shape signature -> jitted forward
+
+    def _build(self, shapes):
+        import jax
+        import jax.numpy as jnp
+        from .aot import eval_closure, graph_fills
+        # zero-fills AND the eval-closure body are shared with the AOT
+        # exporter (serving/aot.py) — the two forward builders must
+        # never drift on fill/rng/train-flag semantics; only the
+        # in-graph dequant below is int8-specific
+        fill, aux_fill = graph_fills(
+            self._sym, shapes,
+            set(self._q) | set(self._plain), self._aux)
+        run = eval_closure(self._eval, fill, aux_fill, sorted(shapes))
+
+        def infer(q, scales, plain, auxs, *inputs):
+            merged = {k: q[k].astype(jnp.float32) * scales[k] for k in q}
+            merged.update(plain)
+            return run(merged, auxs, inputs)
+
+        return jax.jit(infer)
+
+    def forward(self, inputs, shapes):
+        import jax.numpy as jnp
+        sig = tuple(sorted(shapes.items()))
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build({k: tuple(v) for k, v in shapes.items()})
+            self._cache[sig] = fn
+        args = [jnp.asarray(np.asarray(inputs[n], dtype=np.float32))
+                for n in sorted(shapes)]
+        outs = fn(self._q, self._scales, self._plain, self._aux, *args)
+        return [np.asarray(o) for o in outs]
+
+    def resident_weight_bytes(self):
+        """Device bytes held by the quantized weights (int8 + scales) —
+        the observability hook the memory tests pin at ~1/4 of f32."""
+        return sum(int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+                   for d in (self._q, self._scales) for v in d.values())
 
 
 class PooledModel(object):
@@ -54,6 +162,14 @@ class PooledModel(object):
             else sym_mod.load_json(symbol)
         self.dtype = dtype if dtype is not None else get_env(ENV_SERVE_DTYPE)
         self.ctx = ctx
+        #: name -> per-channel scale for int8-quantized weights (empty
+        #: for every other dtype; filled by ``_cast``)
+        self._wt_scales = {}
+        self._int8 = None
+        #: bucket -> AOT-loaded compiled forward (serving/aot.py) and
+        #: the device param/aux lists it is called with
+        self._aot = {}
+        self._aot_args = None
         self.arg_params = self._cast(arg_params or {})
         self.aux_params = self._cast(aux_params or {})
         #: {input_name: per-sample shape} once declared or first served
@@ -75,7 +191,17 @@ class PooledModel(object):
             return dict(params)
         out = {}
         for k, v in params.items():
-            if np.dtype(v.dtype).name in _CASTABLE:
+            if self.dtype == "int8":
+                # weight-only quantization: dense/conv kernels go int8
+                # per channel, everything else (biases, BN stats) rides
+                # the float path unchanged — the bf16-compose rule
+                if _int8_eligible(k, v):
+                    q, s = quantize_int8(v)
+                    out[k] = q
+                    self._wt_scales[k] = s
+                else:
+                    out[k] = v
+            elif np.dtype(v.dtype).name in _CASTABLE:
                 out[k] = v.astype(self.dtype)
             else:
                 out[k] = v
@@ -88,12 +214,12 @@ class PooledModel(object):
 
     def forward(self, inputs, n_valid=None):
         """One batch forward at the given (bucket) shapes -> list of
-        per-output numpy arrays.  Shapes repeat -> the Predictor's
-        cached executor; a new shape compiles once (and is graph-linted
-        when ``MXTPU_ANALYZE`` is set).  ``n_valid`` (how many leading
-        rows are real vs padding) is accepted for batcher-runner
-        compatibility; the whole padded batch always runs."""
-        from .. import predict
+        per-output numpy arrays.  Shapes repeat -> the Predictor's (or
+        the int8 path's) cached executor; a new shape compiles once
+        (and is graph-linted when ``MXTPU_ANALYZE`` is set).
+        ``n_valid`` (how many leading rows are real vs padding) is
+        accepted for batcher-runner compatibility; the whole padded
+        batch always runs."""
         shapes = {k: tuple(np.shape(v)) for k, v in inputs.items()}
         new_sig = self._cur_shapes != shapes
         if new_sig:
@@ -101,20 +227,36 @@ class PooledModel(object):
             # refusal must stay sticky across retries, not be skipped
             # because the shape "already ran"
             self._maybe_env_analyze(shapes)
-        if self._pred is None:
-            self._pred = predict.Predictor(self.symbol, self._blob(),
-                                           shapes, ctx=self.ctx)
-        elif new_sig:
-            self._pred.reshape(shapes)
-        self._cur_shapes = shapes
-        self._pred.forward(**inputs)
+        aot_fn = self._aot_forward_for(shapes)
+        if aot_fn is not None:
+            import jax.numpy as jnp
+            pv, av = self._aot_args
+            xs = [jnp.asarray(np.asarray(inputs[n], dtype=np.float32))
+                  for n in sorted(shapes)]
+            self._cur_shapes = shapes
+            outs = [np.asarray(o) for o in aot_fn(pv, av, *xs)]
+        elif self._wt_scales:
+            if self._int8 is None:
+                self._int8 = _Int8Forward(self)
+            self._cur_shapes = shapes
+            outs = self._int8.forward(inputs, shapes)
+        else:
+            from .. import predict
+            if self._pred is None:
+                self._pred = predict.Predictor(self.symbol, self._blob(),
+                                               shapes, ctx=self.ctx)
+            elif new_sig:
+                self._pred.reshape(shapes)
+            self._cur_shapes = shapes
+            self._pred.forward(**inputs)
+            outs = [self._pred.get_output(i)
+                    for i in range(len(self.output_names))]
         if self.sample_shapes is None:
             # commit only AFTER a successful forward: a malformed first
             # request must never pin wrong shapes and brick the model
             # for every correct request that follows
             self.sample_shapes = {k: s[1:] for k, s in shapes.items()}
-        return [self._pred.get_output(i)
-                for i in range(len(self.output_names))]
+        return outs
 
     def warmup(self, buckets):
         """Compile (and fault in) one forward per bucket ahead of
@@ -131,6 +273,100 @@ class PooledModel(object):
                      for k, s in self.sample_shapes.items()}
             self.forward(dummy)
         return self
+
+    # -- AOT executable store (serving/aot.py; docs/how_to/fleet.md) -------
+    def _aot_forward_for(self, shapes):
+        """The loaded AOT executable matching these exact batch shapes,
+        or None (Predictor/int8 path).  Key fact: one executable per
+        bucket shape — the same program-identity discipline as the
+        Predictor's per-shape cache, so the bit-stability contract is
+        unchanged."""
+        if not self._aot or self.sample_shapes is None:
+            return None
+        b = next(iter(shapes.values()))[0]
+        fn = self._aot.get(b)
+        if fn is None:
+            return None
+        want = {k: (b,) + tuple(s) for k, s in self.sample_shapes.items()}
+        return fn if shapes == want else None
+
+    def export_aot(self, buckets, store_dir):
+        """Compile this model's forward for every bucket and serialize
+        the executables into ``store_dir`` (the fleet warm-store build;
+        weight-free artifacts — see serving/aot.py).  int8 pools keep
+        their in-process path (the dequant program is rebuilt per
+        process) — not exportable yet, documented in fleet.md."""
+        from . import aot
+        if self.sample_shapes is None:
+            raise MXNetError("model %r: declare sample_shapes before "
+                             "export_aot()" % self.name)
+        if self._wt_scales:
+            raise MXNetError("model %r: int8 pools cannot export AOT "
+                             "artifacts (dequant program is built "
+                             "in-process)" % self.name)
+        store = aot.AotStore(store_dir)
+        meta = aot.entry_meta(self)
+        for b in buckets:
+            compiled, args = aot.build_forward(
+                self.symbol, self.arg_params, self.aux_params,
+                self.sample_shapes, b)
+            store.save(self.name, b, compiled, meta)
+            if self._aot_args is None:
+                self._aot_args = args
+        return store
+
+    def load_aot(self, store_dir, buckets=None):
+        """Load this model's compiled forwards from an AOT store ->
+        number of buckets loaded (0 = nothing usable: absent store,
+        meta mismatch, foreign platform — the caller falls back to
+        :meth:`warmup`).  One loaded program is validated with a real
+        call so a corrupt store surfaces at bring-up, not first
+        traffic."""
+        from . import aot
+        store = aot.AotStore(store_dir)
+        if self._wt_scales:
+            return 0                    # int8: in-process path only
+        if store.verify(self.name, aot.entry_meta(self)) is None:
+            return 0
+        have = store.buckets(self.name)
+        wanted = sorted(int(b) for b in buckets) if buckets else have
+        loaded = {}
+        for b in wanted:
+            if b not in have:
+                continue
+            fn = store.load(self.name, b)
+            if fn is None:
+                continue
+            loaded[b] = fn
+        if not loaded:
+            return 0
+        if self._aot_args is None:
+            from .aot import dev_array
+            self._aot_args = (
+                [dev_array(self.arg_params[n])
+                 for n in sorted(self.arg_params)],
+                [dev_array(self.aux_params[n])
+                 for n in sorted(self.aux_params)])
+        # fault-in + integrity: one real forward through the smallest
+        # loaded bucket (an executable that cannot run must not serve)
+        b0 = min(loaded)
+        rs = np.random.RandomState(0)
+        try:
+            pv, av = self._aot_args
+            xs = [np.asarray(rs.rand(b0, *self.sample_shapes[k]),
+                             dtype=np.float32)
+                  for k in sorted(self.sample_shapes)]
+            outs = loaded[b0](pv, av, *xs)
+            if np.shape(np.asarray(outs[0]))[0] != b0:
+                raise MXNetError("wrong validation output shape")
+        except Exception as e:  # noqa: BLE001 — stale/corrupt store
+            _log().warning("AOT store %s: validation call failed for "
+                           "%r (%s: %s) — falling back to trace warmup",
+                           store_dir, self.name, type(e).__name__, e)
+            self._aot_args = None
+            return 0
+        self._aot.update(loaded)
+        return len(loaded)
 
     # -- static analysis ---------------------------------------------------
     def analyze(self, bucket=1):
@@ -153,6 +389,10 @@ class PooledModel(object):
                         else jnp.asarray(v)) for k, v in d.items()}
 
         params, auxs = _raw(self.arg_params), _raw(self.aux_params)
+        for k, s in self._wt_scales.items():
+            # the int8 path serves dequantized weights — lint the math
+            # that actually runs, not the raw int8 bytes
+            params[k] = params[k].astype(jnp.float32) * jnp.asarray(s)
         shapes = {k: (int(bucket),) + tuple(s)
                   for k, s in self.sample_shapes.items()}
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)

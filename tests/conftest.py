@@ -93,7 +93,10 @@ def pytest_collection_modifyitems(config, items):
                 # serving: per-request forwards through the tunneled
                 # link + CPU-pinned daemon subprocesses; the CPU tier
                 # runs the full suite
-                "test_serving")
+                "test_serving",
+                # fleet: router/controller logic against CPU-pinned
+                # fake replicas and daemon subprocesses — same story
+                "test_fleet")
     for item in items:
         if any(k in str(item.fspath) for k in needs_mesh):
             item.add_marker(skip)

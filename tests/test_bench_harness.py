@@ -329,3 +329,64 @@ def test_gate_skips_zero3_mem_key_when_unmeasurable(tmp_path):
                      against=_write(tmp_path / "old2.json", base))
     assert not rep["pass"]
     assert rep["regressions"][0]["key"] == "zero3_wide_mem_x"
+
+
+def test_gate_keys_cover_fleet_metrics(tmp_path):
+    """PR-11 satellite: the fleet's scale-out ratio, AOT warm-start
+    leverage and route efficiency are gate-guarded (all three are
+    higher-is-better ratios, per the gate's contract) — a drop OR a
+    vanished key blocks the run."""
+    for key in ("fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff"):
+        assert key in bench.GATE_KEYS
+    base = dict(BASE, fleet_qps_x=1.8, fleet_warm_start_x=8.3,
+                fleet_route_eff=0.91)
+    # warm-start leverage collapsing (the AOT store silently broken)
+    new = dict(base, fleet_warm_start_x=1.1)
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "fleet_warm_start_x"
+    # a bloated router hop drops the efficiency ratio
+    new = dict(base, fleet_route_eff=0.5)
+    rep = bench.gate(_write(tmp_path / "n2.json", new),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "fleet_route_eff"
+    # a vanished fleet key blocks too
+    gone = {k: v for k, v in base.items() if k != "fleet_qps_x"}
+    rep = bench.gate(_write(tmp_path / "n3.json", gone),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "fleet_qps_x"
+
+
+def test_gate_skips_fleet_scaling_on_small_hosts(tmp_path):
+    """fleet_qps_x needs clients + router + 2 replicas running
+    concurrently; a host without the cores emits fleet_scaling_note
+    and the gate skips the SHAPE key (PR-7 SCALING_SHAPE_KEYS
+    machinery) — a note-less collapse still blocks."""
+    assert bench.SCALING_SHAPE_KEYS["fleet_qps_x"] == \
+        "fleet_scaling_note"
+    base = dict(BASE, fleet_qps_x=1.8, fleet_warm_start_x=8.3)
+    flat = dict(base, fleet_qps_x=1.0,
+                fleet_scaling_note="flat_by_construction_2core")
+    rep = bench.gate(_write(tmp_path / "new.json", flat),
+                     against=_write(tmp_path / "old.json", base))
+    assert rep["pass"], rep
+    assert "fleet_qps_x" in rep["skipped_flat_by_construction"]
+    # the absolute warm-start key still gates on a noted host
+    worse = dict(flat, fleet_warm_start_x=2.0)
+    rep = bench.gate(_write(tmp_path / "n2.json", worse),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "fleet_warm_start_x"
+    # no note -> a scaling collapse IS a regression
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, fleet_qps_x=1.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "fleet_qps_x"
+
+
+def test_fleet_mode_is_known_and_in_the_pipeline_set():
+    assert "fleet" in bench.KNOWN_MODES

@@ -1048,3 +1048,166 @@ def test_data_service_drill_hung_worker_heartbeat_respawn(
     for i, (a, b) in enumerate(zip(ref, got)):
         np.testing.assert_array_equal(a[0], b[0], err_msg="data %d" % i)
         np.testing.assert_array_equal(a[1], b[1], err_msg="labels %d" % i)
+
+
+# ---------------------------------------------------------------------------
+# fleet drills (mxnet_tpu/fleet/): replicas are real serve.py daemons
+# behind the real router — SIGKILL one mid-traffic and prove eviction,
+# fail-once-never-retry, warm rejoin from the AOT store, and a clean
+# fleet-wide SIGTERM drain (docs/how_to/fleet.md).
+# ---------------------------------------------------------------------------
+
+FLEET = os.path.join(REPO, "tools", "fleet.py")
+
+
+@pytest.mark.chaos
+def test_fleet_drill_sigkill_replica_evict_reroute_rejoin_drain(
+        tmp_path):
+    """The ISSUE-11 drill, end to end on real daemons:
+
+    1. a 2-replica fleet serves traffic (the warm store is built on
+       the way up);
+    2. SIGKILL the model's HOME replica mid-traffic — requests in
+       flight to it fail ONCE with 502/``retried: false`` (the
+       idempotency stance) and are visible to their clients, never
+       silently resent;
+    3. the router evicts the dead replica on heartbeat age and new
+       traffic reroutes to the survivor (200s continue);
+    4. the controller respawns the victim, which rejoins WARM — its
+       relaunch log shows the AOT-store load, not a compile;
+    5. fleet-wide SIGTERM drains every replica to rc 0 and the fleet
+       exits 0.  No request ever goes unanswered (zero client-level
+       hangs/exceptions).
+    """
+    import threading
+
+    from mxnet_tpu.serving import ServeClient
+
+    prefix = _save_serve_mlp(tmp_path)
+    store = str(tmp_path / "store")
+    run_dir = str(tmp_path / "run")
+    port_file = str(tmp_path / "port")
+    env = dict(os.environ,
+               MXTPU_FLEET_HEARTBEAT_S="0.3",
+               MXTPU_FLEET_EVICT_S="1.2",
+               MXTPU_SERVE_MAX_WAIT_MS="1")
+    proc = subprocess.Popen(
+        [sys.executable, FLEET, "serve",
+         "--model", "mlp=%s:1" % prefix,
+         "--input-shape", "mlp:data=32", "--replicas", "2",
+         "--device-sets", "cpu", "--buckets", "1,2,4",
+         "--warm-store", store, "--run-dir", run_dir,
+         "--port", "0", "--port-file", port_file],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = _wait_port_file(port_file, proc, deadline_s=300)
+        results = []                 # (status, payload) per request
+        exceptions = []
+        stop = threading.Event()
+
+        def traffic():
+            cli = ServeClient("127.0.0.1", port, timeout=30)
+            x = np.zeros(32, "f")
+            try:
+                while not stop.is_set():
+                    try:
+                        results.append(cli.predict("mlp", x, npy=True))
+                    except Exception as e:  # noqa: BLE001 — a DROPPED
+                        exceptions.append(e)  # response, contract-fatal
+                    time.sleep(0.01)
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=traffic) for _ in range(2)]
+        for t in threads:
+            t.start()
+
+        def _ok_count():
+            return sum(1 for s, _ in results if s == 200)
+
+        deadline = time.monotonic() + 60
+        while _ok_count() < 20 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _ok_count() >= 20, "fleet never served baseline traffic"
+
+        # -- kill the HOME replica (one model -> home is replica 0) --
+        cli = ServeClient("127.0.0.1", port, timeout=30)
+        status, stats = cli.stats()
+        assert status == 200
+        victim = stats["replicas"]["0"]
+        assert victim["pid"], stats
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        # eviction: within heartbeat+evict the fleet reports 1 healthy
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            status, h = cli.healthz()
+            if status == 200 and h["replicas_healthy"] == 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("dead replica was never evicted")
+
+        # traffic keeps flowing (rerouted to the survivor)
+        base = _ok_count()
+        deadline = time.monotonic() + 30
+        while _ok_count() < base + 20 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _ok_count() >= base + 20, "traffic did not reroute"
+
+        # respawn + WARM rejoin: healthy goes back to 2 and the
+        # victim's relaunch warmed from the AOT store, not a compile
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            status, h = cli.healthz()
+            if status == 200 and h["replicas_healthy"] == 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("respawned replica never rejoined")
+        status, stats = cli.stats()
+        assert stats["replicas"]["0"]["restarts"] >= 1
+        log0 = open(os.path.join(run_dir, "replica-0.log")).read()
+        assert "from the AOT store" in log0.split(
+            "warmup-only")[-1], "respawn did not warm from the store"
+
+        # the rejoined home serves again
+        base = _ok_count()
+        deadline = time.monotonic() + 30
+        while _ok_count() < base + 10 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _ok_count() >= base + 10
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        cli.close()
+
+        # -- the idempotency ledger ----------------------------------
+        # every request got exactly one answer; the only non-200s are
+        # the dead replica's in-flight/eviction-window set, every one
+        # marked un-retried — and the router's error counter matches
+        # the client-visible failures (a hidden retry would break the
+        # equality from either side)
+        assert not exceptions, "dropped responses: %r" % exceptions[:3]
+        failed = [(s, p) for s, p in results if s != 200]
+        for s, p in failed:
+            assert s in (502, 503), (s, p)
+            if s == 502:
+                assert p.get("retried") is False
+        status, stats = ServeClient("127.0.0.1", port).stats()
+        n502 = sum(1 for s, _ in failed if s == 502)
+        assert stats["router"]["counters"].get("replica_errors", 0) \
+            == n502
+
+        # -- fleet-wide SIGTERM: every replica drains to rc 0 --------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        stderr = proc.stderr.read()
+        assert rc == 0, stderr[-3000:]
+        assert "replica exit codes {0: 0, 1: 0}" in stderr
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

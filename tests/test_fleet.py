@@ -1,0 +1,561 @@
+"""mxfleet unit tests: manifest geometry, device pinning, the router's
+routing/spill/eviction/idempotency policies against FAKE replicas
+(stdlib HTTP servers — no jax, no daemons), the controller's relaunch
+discipline against dummy children, and the warm-store build against a
+stub serve binary.  The real-daemon composition lives in
+tests/test_chaos.py (SIGKILL drill) and ``bench.py fleet``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu.base import MXNetError  # noqa: E402
+from mxnet_tpu.fleet import (  # noqa: E402
+    FleetManifest, FleetRouter, ReplicaController, build_warm_store,
+    replica_device_env, warm_store_manifest)
+
+pytestmark = pytest.mark.serve
+
+
+# ---------------------------------------------------------------------------
+# manifest + device pinning
+# ---------------------------------------------------------------------------
+
+def test_manifest_from_flags_and_file_roundtrip(tmp_path):
+    man = FleetManifest.from_flags(
+        ["mlp=/ckpts/mlp:3", "resnet=/ckpts/rdir"],
+        ["mlp:data=784", "resnet:data=3,32,32"],
+        replicas=2, buckets="1,2,4", device_sets="cpu")
+    assert man.names() == ["mlp", "resnet"]
+    assert man.models["mlp"]["target"] == "/ckpts/mlp:3"
+    assert man.models["resnet"]["shapes"] == {"data": (3, 32, 32)}
+    path = man.save(str(tmp_path / "fleet.json"))
+    back = FleetManifest.from_file(path)
+    assert back.to_doc() == man.to_doc()
+    assert back.replicas == 2 and back.buckets == "1,2,4"
+
+
+def test_manifest_home_is_stable_mod_replicas():
+    man = FleetManifest.from_flags(
+        ["a=/x:1", "b=/x:1", "c=/x:1"], ["data=4"], replicas=2)
+    assert [man.home(m) for m in ("a", "b", "c")] == [0, 1, 0]
+    with pytest.raises(MXNetError):
+        man.home("nope")
+
+
+def test_manifest_validation():
+    with pytest.raises(MXNetError):
+        FleetManifest({})                       # no models
+    with pytest.raises(MXNetError):
+        FleetManifest({"m": "/x:1"}, replicas=0)
+    with pytest.raises(MXNetError):
+        FleetManifest.from_flags(["justaname"], [])
+
+
+def test_manifest_serve_argv_is_the_serve_py_contract():
+    man = FleetManifest.from_flags(
+        ["mlp=/ckpts/mlp:3"], ["mlp:data=784"], replicas=1,
+        buckets="1,2")
+    argv = man.serve_argv("/repo/tools/serve.py", port_file="/run/p")
+    s = " ".join(argv)
+    assert "--model mlp=/ckpts/mlp:3" in s
+    assert "--input-shape mlp:data=784" in s
+    assert "--buckets 1,2" in s and "--port-file /run/p" in s
+    assert "--warmup" in s and "--warmup-only" not in s
+    only = man.serve_argv("/repo/tools/serve.py", warmup_only=True)
+    assert "--warmup-only" in " ".join(only)
+
+
+def test_replica_device_env_specs():
+    assert replica_device_env(None, 0) == {}
+    assert replica_device_env("cpu", 3) == {"JAX_PLATFORMS": "cpu"}
+    env0 = replica_device_env("tpu:0,1;2,3", 0)
+    env1 = replica_device_env("tpu:0,1;2,3", 1)
+    assert env0["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env1["TPU_VISIBLE_CHIPS"] == "2,3"
+    assert env0["JAX_PLATFORMS"] == "tpu"
+    # wrap-around: more replicas than chip sets co-tenant
+    assert replica_device_env("tpu:0;1", 2)["TPU_VISIBLE_CHIPS"] == "0"
+    # single-chip sets pin the 1x1x1 process topology too
+    single = replica_device_env("tpu:0;1", 1)
+    assert single["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    with pytest.raises(MXNetError):
+        replica_device_env("gpu:0", 0)
+
+
+# ---------------------------------------------------------------------------
+# the router, against fake replicas
+# ---------------------------------------------------------------------------
+
+class _FakeReplica(object):
+    """A stdlib HTTP server speaking the mxserve surface: /healthz,
+    /stats (scriptable queue depths / est waits), /predict/<m> (records
+    and answers).  ``die()`` closes the listener (connection-refused
+    from then on); ``revive()`` rebinds the SAME port."""
+
+    def __init__(self):
+        self.received = []
+        self.depths = {}
+        self.est_wait = {}
+        self.counters = {"completed": 0, "shed_queue": 0}
+        self.draining = False
+        self._lock = threading.Lock()
+        self._server = None
+        self._thread = None
+        self.port = None
+        self._bind(0)
+
+    def _bind(self, port):
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {
+                        "status": "draining" if fake.draining else "ok"})
+                elif self.path == "/stats":
+                    with fake._lock:
+                        self._reply(200, {
+                            "queue_depth": dict(fake.depths),
+                            "est_wait_ms": dict(fake.est_wait),
+                            "counters": dict(fake.counters)})
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with fake._lock:
+                    fake.received.append((self.path, body))
+                    fake.counters["completed"] += 1
+                self._reply(200, {"fake": fake.port,
+                                  "path": self.path})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def die(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def revive(self):
+        self._bind(self.port)
+
+    def close(self):
+        try:
+            self.die()
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+
+
+def _mk_router(fakes, models=("a", "b"), **kw):
+    man = FleetManifest.from_flags(
+        ["%s=/x:1" % m for m in models], ["data=4"],
+        replicas=len(fakes))
+    endpoints = {i: ("127.0.0.1", f.port) for i, f in enumerate(fakes)}
+    kw.setdefault("heartbeat_s", 0.15)
+    kw.setdefault("evict_s", 0.6)
+    kw.setdefault("spill_queue", 4)
+    router = FleetRouter(endpoints, man, port=0, **kw)
+    return router
+
+
+@pytest.fixture
+def two_fakes():
+    fakes = [_FakeReplica(), _FakeReplica()]
+    yield fakes
+    for f in fakes:
+        f.close()
+
+
+def _predict(router, model, n=1):
+    out = []
+    for _ in range(n):
+        out.append(router.proxy_predict(
+            model, json.dumps({"inputs": {"data": [0, 0, 0, 0]}})
+            .encode(), {"Content-Type": "application/json"}))
+    return out
+
+
+def test_router_routes_each_model_to_its_home(two_fakes):
+    router = _mk_router(two_fakes)
+    assert router.probe() == [0, 1]
+    _predict(router, "a", 3)        # home: replica 0
+    _predict(router, "b", 2)        # home: replica 1
+    assert len(two_fakes[0].received) == 3
+    assert len(two_fakes[1].received) == 2
+    assert all(p == "/predict/a" for p, _ in two_fakes[0].received)
+    assert all(p == "/predict/b" for p, _ in two_fakes[1].received)
+    assert router.stats.snapshot()["counters"]["routed"] == 5
+    assert router.stats.snapshot()["counters"].get("spilled", 0) == 0
+
+
+def test_router_spills_when_home_queue_crosses_the_bar(two_fakes):
+    two_fakes[0].depths = {"a": 10}         # home of "a" is saturated
+    router = _mk_router(two_fakes, spill_queue=4)
+    router.probe()
+    _predict(router, "a", 3)
+    assert len(two_fakes[1].received) == 3  # spilled to the idle one
+    assert len(two_fakes[0].received) == 0
+    assert router.stats.snapshot()["counters"]["spilled"] == 3
+
+
+def test_router_spills_on_slo_estimate(two_fakes):
+    two_fakes[0].est_wait = {"a": 500.0}    # deep estimated wait
+    router = _mk_router(two_fakes, slo_ms=100.0)
+    router.probe()
+    _predict(router, "a", 2)
+    assert len(two_fakes[1].received) == 2
+    assert router.stats.snapshot()["counters"]["spilled"] == 2
+
+
+def test_router_evicts_on_heartbeat_age_then_rejoins(two_fakes):
+    router = _mk_router(two_fakes)
+    router.serve_in_background()
+    try:
+        assert sorted(router.healthy()) == [0, 1]
+        two_fakes[0].die()
+        deadline = time.monotonic() + 5
+        while 0 in router.healthy():
+            assert time.monotonic() < deadline, "never evicted"
+            time.sleep(0.05)
+        # new traffic for replica-0-homed "a" reroutes to the survivor
+        # — counted as FAILOVER (rerouted), not as load spill
+        (status, _, _), = _predict(router, "a")
+        assert status == 200
+        assert len(two_fakes[1].received) == 1
+        counters = router.stats.snapshot()["counters"]
+        assert counters["rerouted"] == 1
+        assert counters.get("spilled", 0) == 0
+        # the respawned replica rejoins on the next successful probe
+        two_fakes[0].revive()
+        deadline = time.monotonic() + 5
+        while 0 not in router.healthy():
+            assert time.monotonic() < deadline, "never rejoined"
+            time.sleep(0.05)
+        _predict(router, "a")
+        assert len(two_fakes[0].received) == 1      # home again
+    finally:
+        router.drain_and_stop(timeout=5)
+
+
+def test_router_dead_replica_fails_once_never_retried(two_fakes):
+    """The idempotency stance: a forward hitting a dead replica surfaces
+    ONE 502 to that client — the router must not resend the request to
+    another replica (the body may already have executed)."""
+    router = _mk_router(two_fakes)
+    router.probe()
+    two_fakes[0].die()              # dies AFTER probing healthy
+    status, body, _ = _predict(router, "a")[0]
+    assert status == 502
+    payload = json.loads(body.decode())
+    assert payload["retried"] is False
+    assert "NOT retried" in payload["error"]
+    # nobody else received it
+    assert len(two_fakes[1].received) == 0
+    assert router.stats.snapshot()["counters"]["replica_errors"] == 1
+
+
+def test_router_no_healthy_replica_is_503(two_fakes):
+    router = _mk_router(two_fakes)  # never probed -> nothing routable
+    status, body, _ = _predict(router, "a")[0]
+    assert status == 503
+    assert router.stats.snapshot()["counters"]["no_replica"] == 1
+
+
+def test_router_unknown_model_is_404(two_fakes):
+    router = _mk_router(two_fakes)
+    router.probe()
+    status, _, _ = router.proxy_predict("nope", b"{}", {})
+    assert status == 404
+
+
+def test_router_drain_fences_new_work(two_fakes):
+    router = _mk_router(two_fakes)
+    router.probe()
+    router.draining = True
+    status, _, _ = _predict(router, "a")[0]
+    assert status == 503
+    assert len(two_fakes[0].received) == 0
+
+
+def test_router_stats_aggregates_replica_counters(two_fakes):
+    two_fakes[0].counters = {"completed": 5, "shed_queue": 2}
+    two_fakes[1].counters = {"completed": 7, "shed_queue": 1}
+    router = _mk_router(two_fakes)
+    router.probe()
+    payload = router.stats_payload()
+    assert payload["fleet"]["counters"]["completed"] == 12
+    assert payload["fleet"]["counters"]["shed_queue"] == 3
+    assert payload["fleet"]["replicas_healthy"] == 2
+    assert set(payload["replicas"]) == {0, 1}
+    assert payload["replicas"][0]["healthy"] is True
+    # fleet p50/p99 is the router-measured end-to-end window
+    assert payload["fleet"]["latency_ms"] == \
+        payload["router"]["latency_ms"]
+
+
+def test_router_http_surface_end_to_end(two_fakes):
+    """The public port speaks the mxserve client protocol: /healthz,
+    /stats, /predict/<m> proxied with headers intact."""
+    from mxnet_tpu.serving import ServeClient
+    router = _mk_router(two_fakes)
+    router.serve_in_background()
+    try:
+        cli = ServeClient("127.0.0.1", router.port, timeout=10)
+        status, payload = cli.healthz()
+        assert status == 200 and payload["status"] == "ok"
+        status, payload = cli.predict(
+            "a", np.zeros(4, "f"), npy=True, priority=1,
+            deadline_ms=4000)
+        assert status == 200 and payload["fake"] == two_fakes[0].port
+        status, stats = cli.stats()
+        assert status == 200
+        assert stats["router"]["counters"]["routed"] == 1
+        cli.close()
+        # QoS headers crossed the proxy to the replica? the fake can't
+        # see headers in its reply, but the forward path is shared with
+        # the body — assert the body arrived bit-intact
+        path, body = two_fakes[0].received[0]
+        assert path == "/predict/a"
+        arr = np.load(__import__("io").BytesIO(body),
+                      allow_pickle=False)
+        assert arr.shape == (4,)
+    finally:
+        router.drain_and_stop(timeout=5)
+
+
+def test_router_draining_replica_is_not_routable(two_fakes):
+    two_fakes[0].draining = True
+    router = _mk_router(two_fakes)
+    router.probe()
+    assert router.healthy() == [1]
+
+
+# ---------------------------------------------------------------------------
+# the controller, against dummy children
+# ---------------------------------------------------------------------------
+
+_CHILD = r"""
+import json, os, signal, sys, time
+port_file, state_file = sys.argv[1], sys.argv[2]
+runs = 0
+if os.path.exists(state_file):
+    with open(state_file) as f:
+        runs = json.load(f)["runs"]
+with open(state_file, "w") as f:
+    json.dump({"runs": runs + 1,
+               "resume": os.environ.get("MXTPU_RESUME")}, f)
+codes = json.loads(os.environ.get("CHILD_EXIT_PLAN", "[]"))
+if runs < len(codes):
+    sys.exit(codes[runs])
+with open(port_file + ".tmp", "w") as f:
+    f.write("127.0.0.1:1234")
+os.replace(port_file + ".tmp", port_file)
+def _term(sig, frame):
+    sys.exit(0)
+signal.signal(signal.SIGTERM, _term)
+time.sleep(600)
+"""
+
+
+def _mk_controller(tmp_path, n=1, exit_plan=(), **kw):
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    man = FleetManifest.from_flags(["m=/x:1"], ["data=4"], replicas=n)
+    kw.setdefault("backoff", 0.05)
+    ctl = ReplicaController(man, str(tmp_path / "run"),
+                            serve_py=str(child),
+                            extra_env={"CHILD_EXIT_PLAN":
+                                       json.dumps(list(exit_plan))},
+                            **kw)
+    # dummy children take (port_file, state_file) positionally instead
+    # of the serve.py flag soup
+    for rep in ctl.replicas:
+        rep.argv = [sys.executable, str(child), rep.port_file,
+                    str(tmp_path / ("state-%d.json" % rep.id))]
+    return ctl
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, "timed out: %s" % msg
+        time.sleep(0.05)
+
+
+def test_controller_spawns_reads_ports_and_drains(tmp_path):
+    ctl = _mk_controller(tmp_path, n=2)
+    ctl.start()
+    try:
+        ports = ctl.wait_ready(timeout=20)
+        assert set(ports) == {0, 1}
+        assert all(p == 1234 for p in ports.values())
+        snap = {r["id"]: r for r in ctl.snapshot()}
+        assert snap[0]["state"] == "serving"
+        assert snap[0]["pid"] is not None
+        rcs = ctl.drain(timeout=10)
+        assert rcs == {0: 0, 1: 0}
+        assert all(r.state == "drained" for r in ctl.replicas)
+    finally:
+        ctl.kill()
+
+
+def test_controller_relaunches_watchdog_exit_with_resume_env(tmp_path):
+    """Exit 87 (watchdog) is the supervise.py discipline: relaunch with
+    MXTPU_RESUME=1 in the child env."""
+    ctl = _mk_controller(tmp_path, exit_plan=[87])
+    ctl.start()
+    try:
+        ctl.wait_ready(timeout=20)
+        assert ctl.replicas[0].restarts == 1
+        state = json.loads(
+            (tmp_path / "state-0.json").read_text())
+        assert state["runs"] == 2
+        assert state["resume"] == "1"
+    finally:
+        ctl.kill()
+
+
+def test_controller_respawns_plain_death_without_resume(tmp_path):
+    """A SIGKILL-style death (arbitrary rc) respawns too — capacity
+    loss, not job failure — but WITHOUT the resume env."""
+    ctl = _mk_controller(tmp_path, exit_plan=[1])
+    ctl.start()
+    try:
+        ctl.wait_ready(timeout=20)
+        state = json.loads((tmp_path / "state-0.json").read_text())
+        assert state["runs"] == 2
+        assert state["resume"] is None
+    finally:
+        ctl.kill()
+
+
+def test_controller_restart_budget_exhausts_to_failed(tmp_path):
+    ctl = _mk_controller(tmp_path, exit_plan=[1, 1, 1, 1, 1, 1],
+                         max_restarts=2)
+    ctl.start()
+    try:
+        _wait(lambda: ctl.replicas[0].state == "failed",
+              msg="budget exhaustion")
+        state = json.loads((tmp_path / "state-0.json").read_text())
+        # initial + 2 relaunches, then the budget stops the bleeding
+        assert state["runs"] == 3
+    finally:
+        ctl.kill()
+
+
+def test_controller_affinity_partitions_cores():
+    sets = ReplicaController._affinity_sets(2)
+    cores = sorted(os.sched_getaffinity(0))
+    if len(cores) < 4:
+        assert sets == [None, None]     # nothing to partition
+    else:
+        assert len(sets) == 2
+        assert sets[0] and sets[1]
+        assert not (sets[0] & sets[1])
+        assert sets[0] | sets[1] == set(cores)
+
+
+# ---------------------------------------------------------------------------
+# the AOT warm store, against a stub serve binary
+# ---------------------------------------------------------------------------
+
+_STUB_SERVE = r"""
+import os, sys
+assert "--warmup-only" in sys.argv
+cache = os.environ.get("MXTPU_COMPILE_CACHE")
+assert cache, "warm store build must set MXTPU_COMPILE_CACHE"
+with open(os.path.join(cache, "compiled.bin"), "w") as f:
+    f.write("programs")
+sys.stderr.write("mxserve: warmup_s=1.234\n")
+"""
+
+
+def test_build_warm_store_runs_serve_and_writes_marker(tmp_path):
+    stub = tmp_path / "stub_serve.py"
+    stub.write_text(_STUB_SERVE)
+    man = FleetManifest.from_flags(["m=/x:1"], ["m:data=4"],
+                                   replicas=1, buckets="1,2")
+    store = str(tmp_path / "store")
+    doc = build_warm_store(man, store, serve_py=str(stub))
+    assert doc["warmup_s"] == 1.234
+    assert doc["models"] == ["m"]
+    assert os.path.exists(os.path.join(store, "compiled.bin"))
+    assert warm_store_manifest(store)["buckets"] == "1,2"
+    # idempotent: a second build is a no-op returning the marker
+    os.unlink(os.path.join(store, "compiled.bin"))
+    doc2 = build_warm_store(man, store, serve_py=str(stub))
+    assert doc2["warmup_s"] == 1.234
+    assert not os.path.exists(os.path.join(store, "compiled.bin"))
+    # force rebuilds
+    doc3 = build_warm_store(man, store, serve_py=str(stub), force=True)
+    assert os.path.exists(os.path.join(store, "compiled.bin"))
+
+
+def test_build_warm_store_failure_surfaces(tmp_path):
+    stub = tmp_path / "bad_serve.py"
+    stub.write_text("import sys; sys.stderr.write('boom'); sys.exit(3)")
+    man = FleetManifest.from_flags(["m=/x:1"], ["m:data=4"], replicas=1)
+    with pytest.raises(MXNetError, match="boom"):
+        build_warm_store(man, str(tmp_path / "store2"),
+                         serve_py=str(stub))
+
+
+# ---------------------------------------------------------------------------
+# tools/fleet.py is jax-free (the supervise.py import discipline)
+# ---------------------------------------------------------------------------
+
+def test_fleet_cli_never_imports_jax(tmp_path):
+    """The router/controller process must not spin up an XLA client (it
+    would steal the device from its replicas) — poisoned-jax proof, the
+    mxlint CLI idiom."""
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('fleet CLI must not import jax')")
+    stub = tmp_path / "stub_serve.py"
+    stub.write_text(_STUB_SERVE)
+    env = dict(os.environ,
+               PYTHONPATH=str(tmp_path) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet.py"),
+         "warmup", "--model", "m=/x:1", "--input-shape", "m:data=4",
+         "--warm-store", str(tmp_path / "store")],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path))
+    # the warm store build execs tools/serve.py (which DOES import
+    # mxnet_tpu -> jax in the CHILD) — with poisoned jax the child
+    # fails, but the PARENT must have gotten that far jax-free: the
+    # failure surfaces as the parent's clean wrap of the child's
+    # poisoned-import error, not as the parent's own ImportError
+    assert res.returncode == 1
+    assert "fleet CLI must not import jax" in res.stderr
+    assert "fleet: error: warm-store build failed" in res.stderr

@@ -776,3 +776,410 @@ def test_bench_serve_models_save_and_load(tmp_path):
             {"data": np.random.RandomState(0).rand(1, *sample)
              .astype("f")})[0]
         assert out.shape == (1, 10) and np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# priority + deadline dispatch (PR 11 satellite)
+# ---------------------------------------------------------------------------
+
+def _tagged_batcher(order, buckets=(1,), **kw):
+    """A batcher whose runner records each dispatched row's tag and a
+    gate that holds the FIRST dispatch open so a queue can build."""
+    from mxnet_tpu.serving.batcher import BucketBatcher
+    gate = threading.Event()
+    first = threading.Event()
+
+    def runner(inputs, n):
+        vals = np.asarray(inputs["data"])
+        if not first.is_set():
+            first.set()
+            assert gate.wait(10), "test gate never released"
+        order.extend(vals[:n, 0].tolist())
+        return [vals]
+
+    b = BucketBatcher(runner, buckets=buckets, max_wait_ms=0, **kw)
+    return b, gate, first
+
+
+def test_priority_dispatches_highest_first_fifo_within_level():
+    order = []
+    b, gate, first = _tagged_batcher(order)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)           # queue builds behind this one
+        for tag, pri in ((1.0, 0), (2.0, 5), (3.0, 1), (4.0, 5)):
+            futs.append(b.submit({"data": np.full((2,), tag, "f")},
+                                 priority=pri))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        # priority desc; FIFO within the two p=5 entries (2 before 4)
+        assert order == [0.0, 2.0, 4.0, 3.0, 1.0]
+    finally:
+        b.close()
+
+
+def test_equal_priority_keeps_exact_fifo_order():
+    """The regression pin: all-default-priority traffic must keep the
+    historical strict-FIFO dispatch order bit for bit."""
+    order = []
+    b, gate, first = _tagged_batcher(order)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)
+        for tag in (1.0, 2.0, 3.0, 4.0):
+            futs.append(b.submit({"data": np.full((2,), tag, "f")}))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        assert order == [0.0, 1.0, 2.0, 3.0, 4.0]
+    finally:
+        b.close()
+
+
+def test_priority_traffic_keeps_bit_exactness_contract():
+    """Reordering changes WHEN a request runs, never WHAT it returns:
+    mixed-priority traffic is bit-identical to the unbatched reference
+    forward at the same bucket shape (bucket pinned to 1 here — the
+    contract is per bucket SHAPE, and cross-shape deltas are the
+    documented reason buckets exist)."""
+    pool, sym, args, auxs = make_pool()
+    entry = pool.get("m")
+    from mxnet_tpu.serving.batcher import BucketBatcher
+    b = BucketBatcher(entry.forward, buckets=(1,), max_wait_ms=1)
+    try:
+        rs = np.random.RandomState(3)
+        xs = [rs.rand(32).astype("f") for _ in range(6)]
+        futs = [b.submit({"data": x, }, priority=i % 3)
+                for i, x in enumerate(xs)]
+        got = [f.result(timeout=30)[0] for f in futs]
+        ref = ref_predictor(sym, args, auxs, (1, 32))
+        for x, out in zip(xs, got):
+            expected = ref.forward(data=x[None]).get_output(0)[0]
+            assert np.array_equal(out, expected)
+    finally:
+        b.close()
+
+
+def test_deadline_expires_queued_entries_as_shed_deadline():
+    from mxnet_tpu.serving import DeadlineExpired, Stats
+    order = []
+    stats = Stats()
+    b, gate, first = _tagged_batcher(order, stats=stats)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)
+        doomed = b.submit({"data": np.full((2,), 1.0, "f")},
+                          deadline_ms=30)
+        kept = b.submit({"data": np.full((2,), 2.0, "f")})
+        time.sleep(0.15)                # the deadline passes queued
+        gate.set()
+        futs[0].result(timeout=10)
+        kept.result(timeout=10)
+        with pytest.raises(DeadlineExpired):
+            doomed.result(timeout=10)
+        assert 1.0 not in order         # dead work never dispatched
+        assert stats.snapshot()["counters"]["shed_deadline"] == 1
+    finally:
+        b.close()
+
+
+def test_deadline_already_spent_sheds_at_submit():
+    from mxnet_tpu.serving import DeadlineExpired, Stats
+    stats = Stats()
+    order = []
+    b, gate, first = _tagged_batcher(order, stats=stats)
+    gate.set()
+    try:
+        with pytest.raises(DeadlineExpired):
+            b.submit({"data": np.zeros(2, "f")}, deadline_ms=0)
+        assert stats.snapshot()["counters"]["shed_deadline"] == 1
+    finally:
+        b.close()
+
+
+def test_frontend_deadline_is_429_and_stats_expose_est_wait():
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, buckets=(1, 2), max_wait_ms=1)
+    status, payload = fe.handle_predict(
+        "m", {"data": np.zeros(32, "f")}, deadline_ms=-1.0)
+    assert status == 429
+    assert payload["reason"] == "shed_deadline"
+    # a served request keeps working with qos args
+    status, payload = fe.handle_predict(
+        "m", {"data": np.zeros(32, "f")}, priority=3, deadline_ms=5000)
+    assert status == 200
+    stats = fe.stats_payload()
+    assert stats["counters"]["shed_deadline"] == 1
+    assert "est_wait_ms" in stats and "m" in stats["est_wait_ms"]
+    fe.drain_and_stop()
+
+
+def test_http_qos_headers_reach_the_batcher():
+    """priority/deadline ride X-MXTPU-* headers (and JSON body fields)
+    through the HTTP layer; an already-spent deadline answers 429 with
+    shed_deadline end to end."""
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, port=0, buckets=(1, 2), max_wait_ms=1)
+    fe.serve_in_background()
+    try:
+        cli = ServeClient("127.0.0.1", fe.port, timeout=30)
+        status, payload = cli.predict("m", np.zeros(32, "f"),
+                                      priority=2, deadline_ms=8000)
+        assert status == 200
+        status, payload = cli.predict("m", np.zeros(32, "f"),
+                                      deadline_ms=-5)
+        assert status == 429 and payload["reason"] == "shed_deadline"
+        # body fields override headers (JSON route)
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        body = json.dumps({"inputs": {"data": [0.0] * 32},
+                           "deadline_ms": -1}).encode()
+        conn.request("POST", "/predict/m", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert json.loads(resp.read())["reason"] == "shed_deadline"
+        conn.close()
+        cli.close()
+    finally:
+        fe.drain_and_stop()
+
+
+# ---------------------------------------------------------------------------
+# int8 weight quantization (PR 11 satellite)
+# ---------------------------------------------------------------------------
+
+def test_quantize_int8_per_channel_properties():
+    from mxnet_tpu.serving.pool import quantize_int8
+    rs = np.random.RandomState(0)
+    w = rs.uniform(-2.0, 2.0, (8, 16)).astype("f")
+    w[3] *= 0.01                        # a tiny channel gets its own scale
+    w[5] = 0.0                          # an all-zero channel
+    q, s = quantize_int8(w)
+    assert q.dtype == np.int8 and s.shape == (8, 1)
+    assert np.abs(q).max() <= 127
+    # symmetric: no zero point — w ~ q * s within half a step per channel
+    assert np.all(np.abs(w - q * s) <= s / 2 + 1e-8)
+    assert s[5, 0] == 1.0 and np.all(q[5] == 0)
+    # per-channel: the tiny channel's scale is ~100x finer
+    assert s[3, 0] < s[0, 0] / 10
+    # conv layout: scale broadcasts over (O, I, kH, kW)
+    wc = rs.uniform(-1, 1, (4, 3, 3, 3)).astype("f")
+    qc, sc = quantize_int8(wc)
+    assert sc.shape == (4, 1, 1, 1)
+    assert np.all(np.abs(wc - qc * sc) <= sc / 2 + 1e-8)
+
+
+@pytest.mark.parametrize("sym_fn,sample",
+                         [(mlp_sym, (32,)), (conv_sym, (3, 8, 8))])
+def test_pool_int8_parity_within_tolerance(sym_fn, sample):
+    """The accuracy contract (docs/how_to/serving.md): int8 weight-only
+    serving tracks f32 within a small tolerance — and is NOT bit-equal
+    (the quantization actually engaged)."""
+    sym = sym_fn()
+    args, auxs = init_params(sym, (1,) + sample)
+    p32 = ModelPool()
+    p32.add("m", sym, dict(args), dict(auxs),
+            sample_shapes={"data": sample})
+    p8 = ModelPool(dtype="int8")
+    e8 = p8.add("m", sym, dict(args), dict(auxs),
+                sample_shapes={"data": sample})
+    assert e8._wt_scales, "no weight was quantized"
+    x = np.random.RandomState(5).rand(8, *sample).astype("f")
+    o32 = p32.get("m").forward({"data": x})[0]
+    o8 = e8.forward({"data": x})[0]
+    assert not np.array_equal(o32, o8)
+    np.testing.assert_allclose(o8, o32, atol=2e-2, rtol=5e-2)
+
+
+def test_pool_int8_device_bytes_are_quarter_f32():
+    pool, _, _, _ = make_pool(dtype="int8")
+    entry = pool.get("m")
+    entry.forward({"data": np.zeros((1, 32), "f")})
+    f32_bytes = sum(
+        int(np.prod(np.shape(v))) * 4
+        for k, v in entry.arg_params.items() if k in entry._wt_scales)
+    resident = entry._int8.resident_weight_bytes()
+    # int8 payload + f32 per-channel scales: ~1/4 + epsilon
+    assert resident < 0.3 * f32_bytes
+
+
+def test_pool_int8_keeps_bucket_bit_stability_contract():
+    """One program per bucket shape holds for the int8 path too: a
+    row's result is independent of fill and co-batched rows."""
+    pool, _, _, _ = make_pool(dtype="int8")
+    entry = pool.get("m")
+    rs = np.random.RandomState(2)
+    x = rs.rand(8, 32).astype("f")
+    alone = entry.forward(
+        {"data": np.concatenate([x[:1]] * 8)})[0][0]
+    cohort = entry.forward({"data": x})[0][0]
+    assert np.array_equal(alone, cohort)
+
+
+def test_pool_int8_composes_with_batcher_and_analyze():
+    from mxnet_tpu.serving.batcher import BucketBatcher
+    pool, _, _, _ = make_pool(dtype="int8")
+    entry = pool.get("m")
+    b = BucketBatcher(entry.forward, buckets=(1, 2, 4), max_wait_ms=1)
+    try:
+        rs = np.random.RandomState(1)
+        xs = [rs.rand(32).astype("f") for _ in range(3)]
+        futs = [b.submit({"data": x}) for x in xs]
+        got = [f.result(timeout=30)[0] for f in futs]
+        for x, out in zip(xs, got):
+            direct = entry.forward(
+                {"data": np.stack([x])})[0][0]
+            assert out.shape == direct.shape
+        # the inference lint runs on the math actually served
+        # (dequantized weights)
+        assert entry.analyze(bucket=2).ok
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# AOT executable store (PR 11 tentpole; serving/aot.py)
+# ---------------------------------------------------------------------------
+
+def test_aot_export_load_bit_parity_with_predictor(tmp_path):
+    """THE warm-store correctness claim: a replica that warms by
+    deserializing stored executables serves bit-identically to one
+    that traced and compiled its own."""
+    pool, sym, args, auxs = make_pool()
+    entry = pool.get("m")
+    entry.export_aot([1, 2, 4], str(tmp_path / "aot"))
+    fresh = ModelPool()
+    loaded = fresh.add("m", sym, dict(args), dict(auxs),
+                       sample_shapes={"data": (32,)})
+    assert loaded.load_aot(str(tmp_path / "aot")) == 3
+    rs = np.random.RandomState(4)
+    for n in (1, 2, 4):
+        x = rs.rand(n, 32).astype("f")
+        out_aot = loaded.forward({"data": x})[0]
+        out_pred = entry.forward({"data": x})[0]
+        assert np.array_equal(out_aot, out_pred), "bucket %d" % n
+    # a non-bucket shape transparently falls back to the Predictor path
+    x = rs.rand(3, 32).astype("f")
+    assert loaded.forward({"data": x})[0].shape == (3, 10)
+
+
+def test_aot_store_meta_mismatch_falls_back(tmp_path, caplog):
+    import logging
+    pool, sym, args, auxs = make_pool()
+    pool.get("m").export_aot([1], str(tmp_path / "aot"))
+    other = ModelPool()
+    entry = other.add("m", sym, dict(args), dict(auxs),
+                      sample_shapes={"data": (16,)})   # different shape
+    with caplog.at_level(logging.WARNING):
+        assert entry.load_aot(str(tmp_path / "aot")) == 0
+    assert "meta mismatch" in caplog.text
+    # absent store: quiet zero
+    assert entry.load_aot(str(tmp_path / "nowhere")) == 0
+
+
+def test_aot_store_corrupt_artifact_falls_back(tmp_path, caplog):
+    import logging
+    pool, sym, args, auxs = make_pool()
+    entry = pool.get("m")
+    store = entry.export_aot([1], str(tmp_path / "aot"))
+    # rot the executable bytes; load must warn and refuse, not serve it
+    path = str(tmp_path / "aot" / "m-b1.exec")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    blob = blob[:len(blob) // 2]
+    with open(path, "wb") as f:
+        f.write(blob)
+    fresh = ModelPool()
+    loaded = fresh.add("m", sym, dict(args), dict(auxs),
+                       sample_shapes={"data": (32,)})
+    with caplog.at_level(logging.WARNING):
+        assert loaded.load_aot(str(tmp_path / "aot")) == 0
+    assert not loaded._aot
+    # serving still works — through the classic path
+    assert loaded.forward(
+        {"data": np.zeros((1, 32), "f")})[0].shape == (1, 10)
+
+
+def test_aot_int8_pool_refuses_export_and_load(tmp_path):
+    pool, sym, args, auxs = make_pool()
+    pool.get("m").export_aot([1], str(tmp_path / "aot"))
+    p8 = ModelPool(dtype="int8")
+    e8 = p8.add("m", sym, dict(args), dict(auxs),
+                sample_shapes={"data": (32,)})
+    with pytest.raises(MXNetError, match="int8"):
+        e8.export_aot([1], str(tmp_path / "aot2"))
+    assert e8.load_aot(str(tmp_path / "aot")) == 0
+
+
+def test_serve_daemon_warms_from_aot_store(tmp_path):
+    """End to end through tools/serve.py: build the store with
+    --warmup-only --export-aot, then a daemon launched against the same
+    cache warms by LOADING and serves bit-identically to a storeless
+    daemon."""
+    sym, args, prefix = _save_mlp(tmp_path)
+    store = str(tmp_path / "cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXTPU_COMPILE_CACHE=store)
+    res = subprocess.run(
+        [sys.executable, SERVE, "--model", "mlp=%s:1" % prefix,
+         "--input-shape", "data=32", "--port", "0",
+         "--buckets", "1,2,4", "--warmup-only", "--export-aot"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "exported AOT executables" in res.stderr
+    assert os.path.isdir(os.path.join(store, "aot"))
+    proc, port = _spawn_daemon(tmp_path, prefix, "--warmup",
+                               "--buckets", "1,2,4",
+                               env_extra={"MXTPU_COMPILE_CACHE": store})
+    try:
+        # the daemon's stderr says it warmed from the store
+        x = np.random.RandomState(6).rand(32).astype("f")
+        cli = ServeClient("127.0.0.1", port, timeout=30)
+        status, payload = cli.predict("mlp", x)
+        assert status == 200
+        got = np.asarray(payload["outputs"][0], dtype=np.float32)
+        blob = {("arg:%s" % k): v for k, v in args.items()}
+        pred = predict.Predictor(sym, blob, {"data": (1, 32)})
+        expected = pred.forward(data=x[None]).get_output(0)[0]
+        assert np.array_equal(got, expected)
+        cli.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    assert "from the AOT store" in proc.stderr.read()
+
+
+def test_sustained_high_priority_cannot_starve_low():
+    """The anti-starvation bound (review finding): under a continuous
+    self-refilling stream of priority-9 arrivals, a priority-0 request
+    older than the starvation bound claims a batch slot and completes
+    WHILE the flood is still running — not after it ends."""
+    from mxnet_tpu.serving.batcher import BucketBatcher
+    state = {"refills": 0, "low_seen_at": None, "b": None}
+
+    def runner(inputs, n):
+        vals = np.asarray(inputs["data"])
+        if 1.0 in vals[:n, 0]:
+            state["low_seen_at"] = state["refills"]
+        elif state["refills"] < 200 and state["low_seen_at"] is None:
+            state["refills"] += 1
+            state["b"].submit({"data": np.full((2,), 9.0, "f")},
+                              priority=9)
+        time.sleep(0.02)        # keep the queue permanently non-empty
+        return [vals]
+
+    b = state["b"] = BucketBatcher(runner, buckets=(1,), max_wait_ms=0)
+    try:
+        b.submit({"data": np.full((2,), 9.0, "f")}, priority=9)
+        low = b.submit({"data": np.full((2,), 1.0, "f")}, priority=0)
+        low.result(timeout=30)
+        # served DURING the flood (which only stops once low is seen):
+        # a few batches in — after the ~0.25s starvation bound — but
+        # long before the 200-refill flood would have drained
+        assert state["low_seen_at"] is not None
+        assert 3 <= state["low_seen_at"] < 150, state
+    finally:
+        b.close()

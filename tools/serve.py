@@ -92,6 +92,19 @@ def main(argv=None):
     parser.add_argument("--warmup", action="store_true",
                         help="compile every bucket per model before "
                              "accepting traffic (needs --input-shape)")
+    parser.add_argument("--warmup-only", action="store_true",
+                        help="warm every (model, bucket) forward, print "
+                             "`mxserve: warmup_s=<s>`, exit 0 WITHOUT "
+                             "serving (the fleet bring-up measurement; "
+                             "docs/how_to/fleet.md)")
+    parser.add_argument("--export-aot", action="store_true",
+                        help="BUILD the AOT executable store: compile "
+                             "every (model, bucket) forward and "
+                             "serialize the executables under "
+                             "MXTPU_COMPILE_CACHE/aot (pair with "
+                             "--warmup-only; replicas launched with "
+                             "the same cache dir then warm by LOADING "
+                             "instead of compiling)")
     args = parser.parse_args(argv)
     if not args.model:
         parser.error("at least one --model is required")
@@ -118,7 +131,16 @@ def main(argv=None):
     frontend.install_signal_handlers()
     frontend.start()
 
-    if args.warmup:
+    if args.warmup or args.warmup_only or args.export_aot:
+        import time as _time
+
+        from mxnet_tpu.base import get_env as _get_env
+        from mxnet_tpu.base import ENV_COMPILE_CACHE as _ENV_CC
+        from mxnet_tpu.serving.aot import aot_dir_for_cache
+
+        cache_dir = _get_env(_ENV_CC)
+        aot_dir = aot_dir_for_cache(cache_dir) if cache_dir else None
+        tic = _time.monotonic()
         buckets = parse_buckets(args.buckets)
         for name in pool.names():
             if frontend.draining:     # SIGTERM mid-warmup: stop compiling
@@ -128,9 +150,42 @@ def main(argv=None):
                 sys.stderr.write("mxserve: cannot warm %r — no "
                                  "--input-shape declared\n" % name)
                 continue
-            entry.warmup(buckets)
-            sys.stderr.write("mxserve: warmed %r over buckets %s\n"
-                             % (name, list(buckets)))
+            if args.export_aot:
+                # the store BUILDER: compile + serialize each bucket's
+                # executable (no Predictor warmup — this process never
+                # serves)
+                if aot_dir is None:
+                    raise SystemExit("--export-aot needs "
+                                     "MXTPU_COMPILE_CACHE set")
+                entry.export_aot(buckets, aot_dir)
+                sys.stderr.write("mxserve: exported AOT executables "
+                                 "for %r over buckets %s\n"
+                                 % (name, list(buckets)))
+                continue
+            loaded = entry.load_aot(aot_dir, buckets) if aot_dir else 0
+            if loaded:
+                sys.stderr.write("mxserve: warmed %r from the AOT "
+                                 "store (%d/%d buckets)\n"
+                                 % (name, loaded, len(buckets)))
+            if loaded < len(buckets):
+                # no store / partial store / meta mismatch: classic
+                # trace-and-compile warmup for what is missing
+                entry.warmup([b for b in buckets
+                              if b not in entry._aot])
+                sys.stderr.write("mxserve: warmed %r over buckets %s\n"
+                                 % (name, [b for b in buckets
+                                           if b not in entry._aot]))
+        # the bring-up number bench.py fleet compares cold vs AOT-warm
+        # (process start/imports excluded — this is the compile cost
+        # the warm store removes)
+        sys.stderr.write("mxserve: warmup_s=%.3f\n"
+                         % (_time.monotonic() - tic))
+    if args.warmup_only:
+        # no serve_forever ran, so there is nothing to drain — the
+        # bound (never-advertised) socket dies with the process
+        sys.stderr.write("mxserve: warmup-only — exiting 0\n")
+        sys.stderr.flush()
+        return 0
     sys.stderr.write("mxserve: listening on %s:%d (models: %s)\n"
                      % (frontend.host, frontend.port, pool.names()))
     sys.stderr.flush()
