@@ -232,6 +232,15 @@ ENV_DATA_WORKERS = register_env(
     doc="N>0 routes ImageRecordIter through the multi-process "
         "shared-memory data service with N decode worker processes "
         "(same as data_service=True; docs/how_to/performance.md)")
+# Registered here for the same cross-module reason: image.py routes
+# through the NETWORK tier when it is set.
+ENV_DATA_SERVERS = register_env(
+    "MXTPU_DATA_SERVERS", default="",
+    doc="Comma list of host:port data servers (tools/data_server.py): "
+        "routes every eligible ImageRecordIter through the "
+        "network-tier data service (same as "
+        "data_service='host:port,...'); unset falls back to the local "
+        "service / in-process pipelines (docs/how_to/performance.md)")
 # Registered here (not in kernels/) because it is read across modules:
 # ops/nn.py's RNN scan, rnn/rnn_cell.py's LSTMCell, executor.py's
 # BN+activation fusion pass and parallel/ring_attention.py all consult it
@@ -240,5 +249,5 @@ ENV_FUSED_KERNELS = register_env(
     "MXTPU_FUSED_KERNELS", default="1",
     doc="Fused-kernel routing (mxnet_tpu/kernels/): 1 = all fused "
         "kernels on (default), 0 = exact pre-fusion graphs, or a "
-        "comma list from {bn_act, bn_fold, lstm_cell, flash_attention} "
-        "to enable individually (docs/how_to/kernels.md)")
+        "comma list from {bn_act, bn_fold, lstm_cell, flash_attention, "
+        "augment} to enable individually (docs/how_to/kernels.md)")

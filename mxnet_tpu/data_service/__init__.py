@@ -18,21 +18,31 @@ Layering (worker processes must never import jax — see
 - :mod:`._worker_main` — the worker entrypoint script (loads the
   jax-free package leaves by path).
 - :mod:`.service` — ``DataService`` (coordinator: spawn, collect,
-  heartbeat-monitor, respawn, stats) and ``DataServiceIter`` (the
-  ``DataIter`` facade).  Imported lazily so the jax-free modules stay
-  loadable without the package.
+  heartbeat-monitor, respawn, stats; jax-free — it also runs inside
+  ``tools/data_server.py`` on remote decode hosts).
+- :mod:`.net` — the network tier (jax-free): ``BatchServer`` streams a
+  DataService's published ring slots over TCP as length-prefixed
+  crc-checked frames; ``NetDataService`` is the consumer-side
+  collector over N such servers, with heartbeat-age eviction and
+  reconnect-resume of dead connections.
+- :mod:`.iter` — ``DataServiceIter`` (the ``DataIter`` facade over
+  either service flavor; jax side).  Imported lazily so the jax-free
+  modules stay loadable without the package.
 
 Use it through ``mx.io``-style iterators:
 ``ImageRecordIter(..., data_service=True)`` (or ``MXTPU_DATA_WORKERS=N``)
-routes transparently; see docs/how_to/performance.md ("Scaling the
-input pipeline").
+routes through the local service;
+``ImageRecordIter(..., data_service='host:port,host:port')`` (or
+``MXTPU_DATA_SERVERS``) through the network tier; see
+docs/how_to/performance.md ("Scaling the input pipeline").
 """
 from __future__ import annotations
 
 from ..base import register_env
 from .common import chunk_seed  # noqa: F401 — shared with image.py
 
-__all__ = ["DataService", "DataServiceIter", "chunk_seed"]
+__all__ = ["DataService", "DataServiceIter", "NetDataService",
+           "BatchServer", "chunk_seed"]
 
 # Registered here (the package root, imported eagerly via image.py's
 # chunk_seed import) rather than in service.py, which loads lazily —
@@ -50,13 +60,39 @@ ENV_DATA_HEARTBEAT = register_env(
     "MXTPU_DATA_HEARTBEAT_S", default=30.0,
     doc="Seconds without a data-service worker heartbeat before the "
         "collector declares it hung and respawns it")
+ENV_DATA_NET_TIMEOUT = register_env(
+    "MXTPU_DATA_NET_TIMEOUT_S", default=30.0,
+    doc="Seconds without any frame (batches or heartbeats) from a data "
+        "server before the consumer evicts the connection and "
+        "reconnects (resume is exactly-once at the last consumed "
+        "batch)")
+ENV_DATA_NET_RETRIES = register_env(
+    "MXTPU_DATA_NET_RETRIES", default=10,
+    doc="Consecutive reconnect attempts per data server before the "
+        "network-tier consumer gives up (the streak resets on every "
+        "delivered batch — the local-service respawn-budget lesson)")
+ENV_DATA_NET_RECONNECT = register_env(
+    "MXTPU_DATA_NET_RECONNECT_S", default=0.5,
+    doc="Delay between data-server reconnect attempts (the remote "
+        "host's supervisor needs time to respawn a killed server)")
+ENV_DATA_NET_FRAME_BYTES = register_env(
+    "MXTPU_DATA_NET_FRAME_BYTES", default=1 << 30,
+    doc="Upper bound on one network-tier frame payload; a header "
+        "announcing more is treated as a torn/corrupt frame and the "
+        "connection is re-established rather than consumed")
 
 
 def __getattr__(name):
-    # service.py pulls in io/resilience (trainer-process modules); keep
-    # it lazy so importing the package for `common` stays cheap and
-    # cycle-free during mxnet_tpu's own import
-    if name in ("DataService", "DataServiceIter"):
-        from . import service
-        return getattr(service, name)
+    # service/net/iter pull in resilience (and iter the jax-side io);
+    # keep them lazy so importing the package for `common` stays cheap
+    # and cycle-free during mxnet_tpu's own import
+    if name == "DataService":
+        from .service import DataService
+        return DataService
+    if name == "DataServiceIter":
+        from .iter import DataServiceIter
+        return DataServiceIter
+    if name in ("NetDataService", "BatchServer"):
+        from . import net
+        return getattr(net, name)
     raise AttributeError(name)

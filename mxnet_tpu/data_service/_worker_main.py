@@ -206,9 +206,17 @@ def _run_epoch(cfg, ring_, reader, decoder, faults, common, unpack,
     dtype = common.np_dtype(cfg["dtype"])
     order = cfg["_order"].seek(epoch)
     shard = common.worker_batches(order, bs, int(cfg["rank"]),
-                                  int(cfg["num_workers"]))
+                                  int(cfg["num_workers"]),
+                                  int(cfg.get("stream_offset", 0)),
+                                  int(cfg.get("stream_stride", 1)))
     valid = np.empty(bs, np.uint8)
     coord_pid = int(cfg["coordinator_pid"])
+    # posix_fadvise readahead keyed off the epoch order: declare the
+    # exact record sequence this epoch's (resumed) shard will read so
+    # the OS stays MXTPU_DATA_READAHEAD records ahead of the cursor
+    reader.set_read_plan(
+        k for j, (_g, keys) in enumerate(shard) if j >= int(skip)
+        for k in keys)
 
     def abandoned():
         # the coordinator is gone (we got reparented away from it —

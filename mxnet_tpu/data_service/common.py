@@ -38,6 +38,7 @@ IMAGENET_STD = (58.395, 57.12, 57.375)
 
 __all__ = [
     "chunk_seed", "epoch_order", "worker_batches", "num_batches",
+    "stream_batches", "jsonable_aug",
     "IMAGENET_MEAN", "IMAGENET_STD", "np_dtype", "open_native_pipe",
     "CTRL_WORDS", "CTRL_HEAD", "CTRL_TAIL", "CTRL_HB_MS", "CTRL_ACK_EPOCH",
     "CTRL_STALL_MS", "CTRL_ABORT_EPOCH", "CTRL_STOP", "CTRL_BATCHES",
@@ -121,16 +122,41 @@ def num_batches(n_records, batch_size):
     return (int(n_records) + int(batch_size) - 1) // int(batch_size)
 
 
-def worker_batches(order, batch_size, rank, num_workers):
+def worker_batches(order, batch_size, rank, num_workers,
+                   stream_offset=0, stream_stride=1):
     """This worker's shard for one epoch: ``[(global_batch_idx,
     [keys...]), ...]`` — batch ``i`` holds records
     ``order[i*B:(i+1)*B]`` and belongs to worker ``i % num_workers``,
     so the union over ranks is exactly the epoch's record stream in
-    order, for any worker count."""
+    order, for any worker count.
+
+    ``stream_offset``/``stream_stride`` carve an OUTER shard first (the
+    network tier: server ``s`` of ``S`` owns global batches ``i`` with
+    ``i % S == s``, i.e. offset ``s`` stride ``S``); this worker then
+    owns the rank-th residue of the server's local batch sequence
+    ``j = 0, 1, 2, ...`` where ``g = offset + j*stride``.  With the
+    defaults (offset 0, stride 1) this is exactly the single-host
+    assignment, so the two tiers share ONE partition function and the
+    any-worker-count / any-server-count bit-identity contracts are the
+    same theorem."""
     out = []
-    for i in range(rank, num_batches(len(order), batch_size), num_workers):
-        out.append((i, order[i * batch_size:(i + 1) * batch_size]))
+    nb = num_batches(len(order), batch_size)
+    j = int(rank)
+    while True:
+        g = int(stream_offset) + j * int(stream_stride)
+        if g >= nb:
+            break
+        out.append((g, order[g * batch_size:(g + 1) * batch_size]))
+        j += int(num_workers)
     return out
+
+
+def stream_batches(n_batches, stream_offset=0, stream_stride=1):
+    """How many of the epoch's ``n_batches`` global batches belong to
+    the stream ``(offset, stride)`` — the count a network server (or
+    the whole local service, offset 0 stride 1) delivers."""
+    return len(range(int(stream_offset), int(n_batches),
+                     int(stream_stride)))
 
 
 # ---------------------------------------------------------------------------
@@ -166,6 +192,23 @@ HDR_SEQ = 0
 HDR_BATCH_IDX = 1
 HDR_NVALID = 2
 HDR_EPOCH = 3
+
+
+def jsonable_aug(aug):
+    """Normalize an augmentation dict for a worker/server config:
+    numpy arrays become lists, ``mean=True``/``std=True`` resolve to
+    the shared IMAGENET_* defaults.  ONE definition used by the local
+    service's worker configs AND the network tier's handshake, so the
+    two transports cannot drift on augmentation semantics."""
+    import numpy as _np
+    out = {}
+    for k, v in dict(aug or {}).items():
+        if isinstance(v, _np.ndarray):
+            v = [float(x) for x in v.reshape(-1)]
+        elif v is True and k in ("mean", "std"):
+            v = list(IMAGENET_MEAN if k == "mean" else IMAGENET_STD)
+        out[k] = v
+    return out
 
 
 def np_dtype(name):

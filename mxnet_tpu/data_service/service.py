@@ -35,8 +35,14 @@ finish with batch N before pulling N+1 (the decode bench, a plain
 training loop).  Anything that runs ahead of its consumer must
 snapshot before the next pull: ``dataflow.DevicePrefetchIter`` does
 exactly that (copies on its background thread, then releases), and
-:class:`DataServiceIter`'s default ``copy=True`` hands out private
-arrays.
+``DataServiceIter``'s default ``copy=True`` hands out private arrays.
+
+IMPORT DISCIPLINE: this module stays jax-free (stdlib + numpy + the
+package's jax-free leaves) — ``tools/data_server.py`` runs a
+DataService on remote CPU hosts through the synthetic-package stub,
+where an accidental jax import would drag XLA into every decode host.
+The ``DataIter`` facade (which needs the jax-side ``io`` module) lives
+in :mod:`.iter`.
 """
 from __future__ import annotations
 
@@ -53,13 +59,12 @@ import weakref
 import numpy as np
 
 from ..base import ENV_DATA_WORKERS, MXNetError, get_env  # noqa: F401 — re-exported knob
-from ..io import DataBatch, DataDesc, DataIter
 from ..resilience import strip_faults_env
 from . import ENV_DATA_HEARTBEAT, ENV_DATA_RING_SLOTS, ENV_DATA_SLOT_BYTES
 from . import common as C
 from .ring import Ring
 
-__all__ = ["DataService", "DataServiceIter"]
+__all__ = ["DataService"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -131,7 +136,8 @@ class DataService(object):
                  label_width=1, shuffle=False, seed=0, part_index=0,
                  num_parts=1, num_workers=None, dtype="float32",
                  layout="NCHW", aug=None, slots=None, slot_bytes=None,
-                 heartbeat_s=None, fast_dct=True):
+                 heartbeat_s=None, fast_dct=True, stream_offset=0,
+                 stream_stride=1, start_epoch=1, start_batch=0):
         from .. import recordio
         if dtype not in _DTYPE_CODES:
             raise MXNetError("data_service: unsupported dtype %r" % (dtype,))
@@ -166,12 +172,25 @@ class DataService(object):
             raise MXNetError("data_service: empty index %s" % self._idx)
         self._part_index = int(part_index)
         self._num_parts = int(num_parts)
+        # the outer stream shard (the network tier): this service owns
+        # global batches g = offset + j*stride only — offset 0 stride 1
+        # (the local default) is the whole epoch
+        self._stream_offset = int(stream_offset)
+        self._stream_stride = max(1, int(stream_stride))
+        if not (0 <= self._stream_offset < self._stream_stride):
+            raise MXNetError(
+                "data_service: stream_offset %d out of range for "
+                "stream_stride %d" % (stream_offset, stream_stride))
         self._order = C.EpochOrder(keys, self._seed, self._shuffle,
                                    self._part_index, self._num_parts)
-        self._order.advance()                 # epoch 1
-        self.epoch = 1
+        self.epoch = max(1, int(start_epoch))
+        self._order.seek(self.epoch)
         self._nbatches = C.num_batches(len(self._order.order), self._bs)
-        self._next_idx = 0                    # next global batch to deliver
+        self._stream_batches = C.stream_batches(
+            self._nbatches, self._stream_offset, self._stream_stride)
+        self._next_j = min(max(0, int(start_batch)), self._stream_batches)
+        self.last_aug_seed = None             # chunk seed of the last batch
+        self.last_batch_idx = None            # its global batch index
         self._pending = None                  # worker with an unreleased slot
         self._closed = False
         self._uid = "%d-%x" % (os.getpid(), id(self) & 0xffffff)
@@ -182,12 +201,19 @@ class DataService(object):
                                self._slots, self._bs, self._ring_shape,
                                self._lw, self._np_dtype.itemsize,
                                slot_bytes=self._slot_bytes, create=True)
+                wk.consumed = self._worker_consumed(wk.rank, self._next_j)
                 self._spawn(wk)
-                self._command(wk, self.epoch, 0)
+                self._command(wk, self.epoch, wk.consumed)
         except BaseException:
             self.close()
             raise
         _register_service(self)
+
+    def _worker_consumed(self, rank, next_j):
+        """How many of its shard batches worker ``rank`` has already had
+        consumed when the service's local batch cursor is ``next_j``
+        (batch j belongs to worker j % N)."""
+        return len(range(int(rank), int(next_j), self.num_workers))
 
     # -- workers ------------------------------------------------------------
     def _config(self, rank):
@@ -199,12 +225,14 @@ class DataService(object):
             "ring_shape": list(self._ring_shape),
             "label_width": self._lw, "dtype": self._dtype,
             "dtype_code": _DTYPE_CODES[self._dtype],
-            "layout": self._layout, "aug": _jsonable_aug(self._aug),
+            "layout": self._layout, "aug": C.jsonable_aug(self._aug),
             "fast_dct": self._fast_dct, "seed": self._seed,
             "shuffle": self._shuffle,
             "part_index": self._part_index,
             "num_parts": self._num_parts,
             "rank": rank, "num_workers": self.num_workers,
+            "stream_offset": self._stream_offset,
+            "stream_stride": self._stream_stride,
             "slot_bytes": self._slot_bytes,
             "coordinator_pid": os.getpid(),
         }
@@ -272,29 +300,38 @@ class DataService(object):
         self._command(wk, self.epoch, wk.consumed)
 
     # -- collector ----------------------------------------------------------
-    def next_batch(self):
-        """``(data_view, labels, pad, release)`` for the next global
-        batch, in order; raises StopIteration at epoch end.  ``labels``
-        is a fresh (tiny) copy; ``data_view`` aliases the ring slot —
-        see the module docstring for the lifetime contract."""
+    def next_batch(self, timeout=None):
+        """``(data_view, labels, pad, release)`` for the next batch of
+        this service's stream, in order; raises StopIteration at epoch
+        end.  ``labels`` is a fresh (tiny) copy; ``data_view`` aliases
+        the ring slot — see the module docstring for the lifetime
+        contract.  With ``timeout`` (seconds), returns ``None`` when no
+        batch became ready in time — the network server uses this to
+        keep heartbeats flowing while a legitimately slow worker
+        decodes (None consumes nothing; call again)."""
         if self._closed:
             raise MXNetError("data_service: closed")
         self._release_pending()
-        if self._next_idx >= self._nbatches:
+        if self._next_j >= self._stream_batches:
             raise StopIteration
-        i = self._next_idx
-        wk = self._workers[i % self.num_workers]
+        j = self._next_j
+        g = self._stream_offset + j * self._stream_stride
+        wk = self._workers[j % self.num_workers]
         deadline_poll = 0.0
         t0 = time.monotonic()
+        give_up = None if timeout is None else t0 + float(timeout)
         waited = False
-        while not wk.ring.ready(i, self.epoch):
+        while not wk.ring.ready(g, self.epoch):
             waited = True
             now = time.monotonic()
+            if give_up is not None and now >= give_up:
+                wk.consumer_stall_s += now - t0
+                return None
             if now >= deadline_poll:
                 deadline_poll = now + 0.2
                 if wk.proc.poll() is not None:
                     self._respawn(wk, "died (rc=%s)" % wk.proc.returncode)
-                elif wk.ring.published_mismatch(i, self.epoch):
+                elif wk.ring.published_mismatch(g, self.epoch):
                     # a published slot with the wrong batch/epoch can
                     # only come from a straggler that missed an abort
                     # (e.g. thawed after the reset handshake timed out)
@@ -311,9 +348,16 @@ class DataService(object):
         hdr, labv, datav = wk.ring.peek(self._np_dtype)
         nvalid = int(hdr[C.HDR_NVALID])
         labels = np.array(labv[:, 0] if self._lw == 1 else labv)
-        self._next_idx += 1
+        self._next_j += 1
         wk.consumed += 1
         wk.respawn_streak = 0   # delivered: not a crash loop
+        # the in-graph augmentation seam (kernels/augment.py) folds its
+        # per-image RNG from this — the SAME per-(seed, global batch,
+        # epoch) value the host-side decoders mix, so device-augmented
+        # output is a pure function of (seed, epoch, batch) no matter
+        # which worker/server/host decoded the bytes
+        self.last_aug_seed = C.chunk_seed(self._seed, g, epoch=self.epoch)
+        self.last_batch_idx = g
         released = [False]
 
         def release(_wk=wk, _released=released):
@@ -329,15 +373,25 @@ class DataService(object):
             self._pending = None
 
     def at_epoch_end(self):
-        return self._next_idx >= self._nbatches
+        return self._next_j >= self._stream_batches
 
     def reset(self):
         """Advance to the next epoch (abandoning the current one if it
         was not fully consumed), like ``DataIter.reset``."""
+        self.seek(self.epoch + 1, 0)
+
+    def seek(self, epoch, consumed=0):
+        """Land the service at ``epoch`` (1-based) with the first
+        ``consumed`` stream batches already delivered — the network
+        tier's reconnect resume (a fresh connection re-requests the
+        tail of a partially consumed epoch; deterministic production
+        makes the re-decoded stream bit-identical).  ``reset()`` is
+        ``seek(epoch + 1, 0)``."""
         if self._closed:
             raise MXNetError("data_service: closed")
+        epoch = max(1, int(epoch))
         self._release_pending()
-        mid_epoch = self._next_idx < self._nbatches
+        mid_epoch = self._next_j < self._stream_batches
         for wk in self._workers:
             if mid_epoch:
                 wk.ring.request_abort(self.epoch)
@@ -373,12 +427,12 @@ class DataService(object):
                 # the heartbeat so a worker that wedges between epochs
                 # still ages out (reset_counters zeroed the stamp)
                 wk.ring.heartbeat()
-            wk.consumed = 0
-        self.epoch += 1
-        self._order.advance()
-        self._next_idx = 0
+        self.epoch = epoch
+        self._order.seek(epoch)
+        self._next_j = min(max(0, int(consumed)), self._stream_batches)
         for wk in self._workers:
-            self._command(wk, self.epoch, 0)
+            wk.consumed = self._worker_consumed(wk.rank, self._next_j)
+            self._command(wk, self.epoch, wk.consumed)
 
     # -- observability ------------------------------------------------------
     def stats(self):
@@ -471,97 +525,3 @@ class DataService(object):
             self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
-
-
-def _jsonable_aug(aug):
-    out = {}
-    for k, v in aug.items():
-        if isinstance(v, np.ndarray):
-            v = [float(x) for x in v.reshape(-1)]
-        elif v is True and k in ("mean", "std"):
-            v = list(C.IMAGENET_MEAN if k == "mean" else C.IMAGENET_STD)
-        out[k] = v
-    return out
-
-
-class DataServiceIter(DataIter):
-    """`DataIter` facade over :class:`DataService`: host numpy batches
-    (the ``host_batches`` analog of the in-process native pipe).
-
-    ``copy=True`` (the safe default) hands each consumer a private
-    array.  ``copy=False`` hands the ring-slot VIEW itself — fastest,
-    but only for strictly serial consumers: the array is valid until
-    ``batch.release()`` or the next pull, and anything "uploading" it
-    must truly copy (on the CPU backend ``jax.device_put`` ALIASES
-    numpy memory; use ``jnp.array(view, copy=True)``).
-    ``ImageRecordIter``'s ``host_batches`` service mode and the decode
-    bench use ``copy=False``; wrapping either flavor in
-    ``dataflow.DevicePrefetchIter(stage=trainer)`` is safe — the
-    prefetcher snapshots slot-backed batches on its background thread
-    and releases the slot before running ahead."""
-
-    def __init__(self, service=None, data_name="data",
-                 label_name="softmax_label", copy=True, **kwargs):
-        self._service = service if service is not None \
-            else DataService(**kwargs)
-        super().__init__(self._service._bs)
-        self._copy = bool(copy)
-        self.data_name = data_name
-        self.label_name = label_name
-        self.current_batch = None
-
-    @property
-    def provide_data(self):
-        svc = self._service
-        dt = np.dtype("float32" if svc._dtype == "bfloat16" else svc._dtype)
-        return [DataDesc(self.data_name, (svc._bs,) + svc._ring_shape,
-                         dtype=dt)]
-
-    @property
-    def provide_label(self):
-        svc = self._service
-        shape = (svc._bs, svc._lw) if svc._lw > 1 else (svc._bs,)
-        return [DataDesc(self.label_name, shape)]
-
-    def next(self):
-        data, labels, pad, release = self._service.next_batch()
-        batch = DataBatch([data], [labels], pad=pad,
-                          provide_data=self.provide_data,
-                          provide_label=self.provide_label)
-        if self._copy:
-            # already private: copy now, recycle the slot, and do NOT
-            # attach the instance-level release — its presence is the
-            # "transport-owned buffers" signal DevicePrefetchIter keys
-            # its snapshot on, which would re-copy every batch
-            batch.data = [np.array(data)]
-            release()
-        else:
-            batch.release = release
-        self.current_batch = batch
-        return batch
-
-    def iter_next(self):
-        try:
-            self.next()
-            return True
-        except StopIteration:
-            return False
-
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getpad(self):
-        return self.current_batch.pad
-
-    def reset(self):
-        self._service.reset()
-
-    def stats(self):
-        return self._service.stats()
-
-    def close(self):
-        self.current_batch = None   # drop the last zero-copy view
-        self._service.close()

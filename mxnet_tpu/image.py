@@ -25,7 +25,8 @@ import numpy as np
 from . import io as mxio
 from . import ndarray as nd
 from . import recordio
-from .base import ENV_DATA_WORKERS, MXNetError, get_env, register_env
+from .base import (ENV_DATA_SERVERS, ENV_DATA_WORKERS, MXNetError,
+                   get_env, register_env)
 
 ENV_UPLOAD_THREADS = register_env(
     "MXNET_UPLOAD_THREADS", default=4,
@@ -1159,7 +1160,7 @@ class ImageRecordIter(mxio.DataIter):
                  prefetch_buffer=4, preprocess_threads=4, round_batch=True,
                  data_name="data", label_name="softmax_label", dtype="float32",
                  layout="NCHW", device_transform=None, host_batches=False,
-                 data_service=None, **aug_kwargs):
+                 data_service=None, device_augment=None, **aug_kwargs):
         super(ImageRecordIter, self).__init__(batch_size)
         from . import random as _random
         self._eff_seed = _random.get_seed() if seed is None else int(seed)
@@ -1179,32 +1180,70 @@ class ImageRecordIter(mxio.DataIter):
         # Multi-process data service (docs/how_to/performance.md "Scaling
         # the input pipeline"): data_service=True uses preprocess_threads
         # worker PROCESSES; MXTPU_DATA_WORKERS=N turns it on (and sizes
-        # the fleet) without touching call sites.  data_service=False
-        # forces the in-process pipelines even when the env is set.
+        # the fleet) without touching call sites.
+        # data_service='host:port,host:port' (or MXTPU_DATA_SERVERS)
+        # streams from the network tier's server fleet instead of this
+        # host's cores.  data_service=False forces the in-process
+        # pipelines even when either env is set.
         self._service = None
         self._service_iter = None
+        self._dev_aug = None
         self._it = None
+        if device_augment is False:
+            device_augment = None   # explicit off == unset; 0 is a
+            # REAL margin (center crop + mirror/normalize on device)
         env_workers = int(get_env(ENV_DATA_WORKERS, 0) or 0)
-        if data_service or (data_service is None and env_workers > 0):
+        env_servers = str(get_env(ENV_DATA_SERVERS, "") or "").strip()
+        # data_service forms: None (env decides), False/0/"" (opt out),
+        # True or any other truthy (local service), 'host:p,host:p' or
+        # a list/tuple of addresses (network tier)
+        explicit_servers = None
+        explicit_local = False
+        if isinstance(data_service, str):
+            explicit_servers = data_service.strip() or None
+        elif isinstance(data_service, (list, tuple)):
+            explicit_servers = list(data_service) or None
+        elif data_service is not None:
+            explicit_local = bool(data_service)
+        servers = explicit_servers
+        if servers is None and data_service is None and env_servers:
+            servers = env_servers
+        env_routed = data_service is None
+        use_local = explicit_local or (
+            env_routed and not servers and env_workers > 0)
+        if servers or use_local:
             # an EXPLICIT data_service=True sizes the fleet from the
             # call's preprocess_threads; the env sizes only env-routed
             # iterators (it must not silently override a call site —
-            # the bench's scaling sweep depends on this)
-            workers = max(1, int(preprocess_threads)) if data_service \
-                else env_workers
+            # the bench's scaling sweep depends on this).  On the
+            # network tier preprocess_threads is the per-SERVER decode
+            # worker count.
+            workers = env_workers if (use_local and env_routed) \
+                else max(1, int(preprocess_threads))
             try:
                 self._init_service(
                     path_imgrec, path_imgidx, data_shape, batch_size,
                     label_width, shuffle, part_index, num_parts, workers,
                     dtype, layout, aug_kwargs, has_custom_augs,
-                    device_transform, host_batches, data_name, label_name)
+                    device_transform, host_batches, data_name, label_name,
+                    servers=servers, device_augment=device_augment)
             except MXNetError:
-                if data_service:   # explicitly requested: surface it
+                if not env_routed:   # explicitly requested: surface it
+                    raise
+                if device_augment is not None:
+                    # an explicit device-augment ask must not silently
+                    # degrade to host augmentation on a routing fallback
                     raise
                 logging.warning(
-                    "ImageRecordIter: MXTPU_DATA_WORKERS is set but this "
-                    "configuration cannot route through the data service; "
-                    "using the in-process pipeline", exc_info=True)
+                    "ImageRecordIter: MXTPU_DATA_WORKERS/MXTPU_DATA_"
+                    "SERVERS is set but this configuration cannot route "
+                    "through the data service; using the in-process "
+                    "pipeline", exc_info=True)
+        elif device_augment is not None:
+            raise MXNetError(
+                "device_augment rides the data-service transports: pass "
+                "data_service=True / a server list, or set "
+                "MXTPU_DATA_WORKERS / MXTPU_DATA_SERVERS")
         if self._service is not None:
             self.batch_size = batch_size
             self.data_shape = tuple(data_shape)
@@ -1290,10 +1329,15 @@ class ImageRecordIter(mxio.DataIter):
                       batch_size, label_width, shuffle, part_index,
                       num_parts, workers, dtype, layout, aug_kwargs,
                       has_custom_augs, device_transform, host_batches,
-                      data_name, label_name):
-        """Route through data_service.DataService; raises MXNetError for
-        configurations the service cannot express."""
-        from .data_service import DataService, DataServiceIter
+                      data_name, label_name, servers=None,
+                      device_augment=None):
+        """Route through the data service — local
+        (``data_service.DataService``, this host's cores) or the
+        network tier (``data_service.NetDataService``, a
+        ``tools/data_server.py`` fleet); raises MXNetError for
+        configurations neither can express."""
+        from .data_service import (DataService, DataServiceIter,
+                                   NetDataService)
         if path_imgidx is None:
             raise MXNetError(
                 "data_service needs path_imgidx (sharded readers plan "
@@ -1307,22 +1351,69 @@ class ImageRecordIter(mxio.DataIter):
             raise MXNetError(
                 "data_service does not implement augmentations %s"
                 % sorted(unsupported))
-        if not _rec_looks_jpeg(path_imgrec):
+        if not servers and not _rec_looks_jpeg(path_imgrec):
             # worker processes decode through their own native libjpeg
             # pipes — a PNG/BMP .rec would crash-loop every worker at
             # runtime; fail eligibility here so env routing falls back
-            # to the cv2 pipelines instead
+            # to the cv2 pipelines instead.  (Network tier: the paths
+            # belong to the SERVER hosts — this host may hold no copy;
+            # the server's handshake reply surfaces dataset problems.)
             raise MXNetError(
                 "data_service needs a JPEG-payload .rec (the worker "
                 "decode pipes are libjpeg); this file's first record "
                 "is not JPEG")
+        svc_shape = tuple(data_shape)
+        svc_aug = dict(aug_kwargs)
+        svc_dtype = dtype
+        if device_augment is not None:
+            # in-graph augmentation (kernels/augment.py, the `augment`
+            # seam of MXTPU_FUSED_KERNELS): the transport ships a
+            # RAW-DECODED uint8 canvas with a crop margin and the
+            # device does crop/mirror/normalize as traced ops, seeded
+            # per global batch.  Seam off = the EXACT host-augmented
+            # path below, by construction.
+            from .kernels import fused_enabled
+            if host_batches:
+                raise MXNetError(
+                    "device_augment produces device arrays — it cannot "
+                    "combine with host_batches")
+            if fused_enabled("augment"):
+                from .kernels.augment import DeviceAugment
+                margin = 16 if device_augment is True \
+                    else int(device_augment)
+                self._dev_aug = DeviceAugment(
+                    svc_shape, margin=margin,
+                    rand_crop=bool(aug_kwargs.get("rand_crop")),
+                    rand_mirror=bool(aug_kwargs.get("rand_mirror")),
+                    mean=aug_kwargs.get("mean"),
+                    std=aug_kwargs.get("std"), layout=layout,
+                    dtype=dtype)
+                svc_shape = self._dev_aug.canvas_shape
+                svc_aug = {k: v for k, v in aug_kwargs.items()
+                           if k == "resize"}
+                svc_dtype = "uint8"   # raw bytes on the wire: 4x less
+            else:
+                logging.info(
+                    "ImageRecordIter: MXTPU_FUSED_KERNELS disables the "
+                    "augment kernel — using the exact host-augmented "
+                    "path")
         fast_dct = get_env(ENV_JPEG_DECODE_FAST, "1") != "0"
-        self._service = DataService(
-            path_imgrec, path_imgidx, tuple(data_shape), batch_size,
-            label_width=label_width, shuffle=shuffle, seed=self._eff_seed,
-            part_index=part_index, num_parts=num_parts,
-            num_workers=workers, dtype=dtype, layout=layout,
-            aug=aug_kwargs, fast_dct=fast_dct)
+        if servers:
+            self._service = NetDataService(
+                servers, path_imgrec, path_imgidx, svc_shape,
+                batch_size, label_width=label_width, shuffle=shuffle,
+                seed=self._eff_seed, part_index=part_index,
+                num_parts=num_parts, workers_per_server=workers,
+                dtype=svc_dtype, layout=layout, aug=svc_aug,
+                fast_dct=fast_dct)
+        else:
+            self._service = DataService(
+                path_imgrec, path_imgidx, svc_shape, batch_size,
+                label_width=label_width, shuffle=shuffle,
+                seed=self._eff_seed, part_index=part_index,
+                num_parts=num_parts, num_workers=workers,
+                dtype=svc_dtype, layout=layout, aug=svc_aug,
+                fast_dct=fast_dct)
         # copy=False: the host_batches contract (views valid until the
         # next pull) matches the bench's ephemeral reads, and the device
         # path makes its own guaranteed copy in _next_service
@@ -1335,6 +1426,12 @@ class ImageRecordIter(mxio.DataIter):
         dt = np.dtype("float32" if self._dtype == "bfloat16"
                       else self._dtype)
         if self._service is not None:
+            if self._dev_aug is not None:
+                # the transport carries the uint8 canvas; consumers see
+                # the post-augmentation (device-side) product
+                shape = (self.batch_size,) + self._dev_aug.per_layout(
+                    self._dev_aug.out_shape)
+                return [mxio.DataDesc(self._data_name, shape, dtype=dt)]
             descs = self._service_iter.provide_data
             return [mxio.DataDesc(d.name, d.shape, dtype=dt) for d in descs]
         descs = []
@@ -1458,12 +1555,19 @@ class ImageRecordIter(mxio.DataIter):
         product the C++ parser handed out); the device path uploads with
         ``copy=True`` (on the CPU backend a plain device_put ALIASES the
         numpy buffer — releasing the ring slot would corrupt the "device"
-        array) and releases the slot immediately."""
+        array) and releases the slot immediately.  With device_augment
+        the uploaded canvas runs through the in-graph augmentation op,
+        seeded by the batch's chunk seed (bit-reproducible across
+        worker/server counts by construction)."""
         batch = self._service_iter.next()
         if self._host_batches:
             return batch
         import jax.numpy as jnp
-        data = nd.NDArray._from_jax(jnp.array(batch.data[0], copy=True))
+        uploaded = jnp.array(batch.data[0], copy=True)
+        if self._dev_aug is not None:
+            uploaded = self._dev_aug(uploaded, batch.aug_seed,
+                                     self.batch_size - batch.pad)
+        data = nd.NDArray._from_jax(uploaded)
         if self._device_transform is not None:
             data = nd.NDArray._from_jax(self._device_transform(data._data))
         labels = nd.array(batch.label[0])

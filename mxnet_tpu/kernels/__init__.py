@@ -39,6 +39,11 @@ Kernel catalog (``KNOWN_KERNELS``):
   op's ``lax.scan`` and by ``rnn_cell.LSTMCell`` (:mod:`.lstm_cell`).
 - ``flash_attention`` — tiled online-softmax attention that
   ``parallel/ring_attention.py`` composes with (:mod:`.flash_attention`).
+- ``augment``   — in-graph image augmentation (resize/crop/mirror/
+  normalize as traced ops, per-image RNG folded from the data
+  service's ``chunk_seed``) so the input pipeline ships raw-decoded
+  uint8 and augments on-device (:mod:`.augment`; consumed by
+  ``ImageRecordIter(device_augment=...)``).
 """
 from __future__ import annotations
 
@@ -48,12 +53,13 @@ from ..base import ENV_FUSED_KERNELS, get_env, register_env
 
 __all__ = ["KNOWN_KERNELS", "fused_enabled", "enabled_kernels",
            "use_pallas", "ENV_FLASH_BLOCK", "bn_act", "lstm_cell",
-           "flash_attention", "roofline"]
+           "flash_attention", "roofline", "augment"]
 
 _LOG = logging.getLogger(__name__)
 
 #: every kernel name the router understands (docs/how_to/kernels.md)
-KNOWN_KERNELS = ("bn_act", "bn_fold", "lstm_cell", "flash_attention")
+KNOWN_KERNELS = ("bn_act", "bn_fold", "lstm_cell", "flash_attention",
+                 "augment")
 
 # registered EAGERLY at package import (a lazy registration inside the
 # flash module failed the three-way registry==docs==reads sync for the
@@ -112,3 +118,4 @@ from . import roofline            # noqa: E402  (stdlib-light, analytic)
 from . import bn_act              # noqa: E402
 from . import lstm_cell           # noqa: E402
 from . import flash_attention     # noqa: E402
+from . import augment             # noqa: E402

@@ -1,0 +1,187 @@
+"""In-graph (on-device) image augmentation — the device side of the
+cross-host data plane (docs/how_to/performance.md, "Scaling the input
+pipeline").
+
+The host pipelines (in-process pipe, local data service, network tier)
+augment on CPU: random crop + mirror + normalize per image, seeded per
+global batch.  This module moves that work INTO the compiled graph as
+traced ops (``jax.image`` resize + ``lax.dynamic_slice`` crop + flip
+behind the ``MXTPU_FUSED_KERNELS`` seam, kernel name ``augment``), so
+the hot path can ship RAW-DECODED uint8 canvases — 4x fewer H2D bytes
+than f32, zero host augmentation cycles — and the TPU does the rest.
+
+Determinism is inherited, not re-invented: the per-image RNG folds from
+the SAME ``common.chunk_seed(seed, global batch, epoch)`` the host
+decoders mix, so device-augmented output is a pure function of
+(seed, epoch, batch index) — bit-reproducible across worker counts,
+server counts and respawns BY CONSTRUCTION (the PR-7 contract, one
+level up).  It is NOT numerically identical to the host-augmented
+path (different crop geometry: the host crops the variable-size
+resized image, the device crops a fixed-margin canvas) — that is why
+the seam exists: ``MXTPU_FUSED_KERNELS=0`` (or any list without
+``augment``) restores the EXACT host-augmented graphs.
+
+Geometry: the host decodes every image to a fixed CANVAS of
+``(H + margin, W + margin)`` (center crop/resize, no host
+augmentation); the device then takes a random ``(H, W)`` window
+(offsets uniform in ``[0, margin]``; center when ``rand_crop`` is
+off), mirrors with probability 1/2 when ``rand_mirror`` is on,
+normalizes with mean/std, zeroes pad rows, and casts to the requested
+dtype.  A canvas arriving at a different spatial size is first
+``jax.image.resize``d — the traced analog of the host's resize knob.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["DeviceAugment"]
+
+
+class DeviceAugment(object):
+    """A compiled per-batch augmentation op: ``aug(images, cseed,
+    nvalid)`` -> augmented batch.
+
+    ``data_shape`` is the canonical ``(3, H, W)`` OUTPUT shape;
+    ``margin`` the extra pixels per spatial dim the input canvas
+    carries for the random crop to roam in.  ``mean``/``std`` accept
+    the host augmenters' forms (None, scalar, 3-vector, or ``True``
+    for the shared ImageNet constants).  Instances are callables whose
+    jitted program is cached; ``cseed`` and ``nvalid`` ride as traced
+    scalars so every batch hits ONE compiled executable.
+    """
+
+    def __init__(self, data_shape, margin=16, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None, layout="NCHW",
+                 dtype="float32"):
+        shape = tuple(int(d) for d in data_shape)
+        if len(shape) != 3 or shape[0] != 3:
+            raise MXNetError(
+                "device augment needs data_shape (3, H, W), got %s"
+                % (shape,))
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError("layout must be NCHW or NHWC")
+        if int(margin) < 0:
+            raise MXNetError("margin must be >= 0")
+        self.out_shape = shape                 # canonical (c, h, w)
+        self.margin = int(margin)
+        self.rand_crop = bool(rand_crop)
+        self.rand_mirror = bool(rand_mirror)
+        self.layout = layout
+        self.dtype = str(dtype)
+        if self.dtype not in ("float32", "uint8", "bfloat16"):
+            raise MXNetError(
+                "device augment dtype must be float32/uint8/bfloat16, "
+                "got %r" % (dtype,))
+        self.mean = self._c3(mean, "mean")
+        self.std = self._c3(std, "std")
+        if self.dtype == "uint8" and (self.mean is not None
+                                      or self.std is not None):
+            raise MXNetError(
+                "uint8 device augmentation cannot normalize (mean/std "
+                "produce fractional values); normalize on-device after "
+                "the cast, or request a float dtype")
+        c, h, w = shape
+        self.canvas_shape = (c, h + self.margin, w + self.margin)
+        self._fn = None
+
+    @staticmethod
+    def _c3(v, what):
+        if v is None or v is False:
+            return None
+        from ..data_service import common as dsc
+        if v is True:
+            v = dsc.IMAGENET_MEAN if what == "mean" else dsc.IMAGENET_STD
+        a = np.asarray(v, np.float32).reshape(-1)
+        if a.size == 1:
+            a = np.repeat(a, 3)
+        if a.size != 3:
+            raise MXNetError("%s must be a scalar or 3 values" % what)
+        return a
+
+    # -- layout helpers ------------------------------------------------------
+    def _axes(self):
+        """(h_axis, w_axis) of ONE image (no batch dim)."""
+        return (1, 2) if self.layout == "NCHW" else (0, 1)
+
+    def per_layout(self, canonical):
+        c, h, w = canonical
+        return (c, h, w) if self.layout == "NCHW" else (h, w, c)
+
+    # -- the traced op -------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        c, oh, ow = self.out_shape
+        m = self.margin
+        h_ax, w_ax = self._axes()
+        img_shape = list(self.per_layout(self.canvas_shape))
+        out_sizes = list(img_shape)
+        out_sizes[h_ax], out_sizes[w_ax] = oh, ow
+        if self.mean is not None:
+            mean = jnp.asarray(self.mean)
+            mean = mean.reshape((3, 1, 1) if self.layout == "NCHW"
+                                else (3,))
+        else:
+            mean = None
+        if self.std is not None:
+            std = jnp.asarray(self.std)
+            std = std.reshape((3, 1, 1) if self.layout == "NCHW"
+                              else (3,))
+        else:
+            std = None
+
+        def one(img, key):
+            if self.rand_crop and m > 0:
+                oy = jax.random.randint(jax.random.fold_in(key, 1), (),
+                                        0, m + 1)
+                ox = jax.random.randint(jax.random.fold_in(key, 2), (),
+                                        0, m + 1)
+            else:
+                oy = ox = jnp.int32(m // 2)
+            starts = [jnp.int32(0)] * 3
+            starts[h_ax], starts[w_ax] = oy, ox
+            img = jax.lax.dynamic_slice(img, starts, out_sizes)
+            if self.rand_mirror:
+                bit = jax.random.randint(jax.random.fold_in(key, 3), (),
+                                         0, 2)
+                img = jnp.where(bit > 0, jnp.flip(img, axis=w_ax), img)
+            return img
+
+        def apply(imgs, cseed, nvalid):
+            bs = imgs.shape[0]
+            f = imgs.astype(jnp.float32)
+            if tuple(f.shape[1:]) != tuple(img_shape):
+                # traced resize to the canvas — the jax.image analog of
+                # the host resize knob (only engages when the producer
+                # ships a different decode size)
+                f = jax.image.resize(f, (bs,) + tuple(img_shape),
+                                     method="linear")
+            key = jax.random.PRNGKey(cseed)
+            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+                jnp.arange(bs))
+            out = jax.vmap(one)(f, keys)
+            if mean is not None:
+                out = out - mean
+            if std is not None:
+                out = out / std
+            # pad rows are exact zeros, matching the host decoders'
+            # padded-final-batch contract
+            rows = jnp.arange(bs).reshape((bs,) + (1,) * (out.ndim - 1))
+            out = jnp.where(rows < nvalid, out, 0.0)
+            if self.dtype == "uint8":
+                out = jnp.clip(out, 0, 255)
+            out_dt = {"float32": jnp.float32, "uint8": jnp.uint8,
+                      "bfloat16": jnp.bfloat16}[self.dtype]
+            return out.astype(out_dt)
+
+        return jax.jit(apply)
+
+    def __call__(self, imgs, cseed, nvalid):
+        if self._fn is None:
+            self._fn = self._build()
+        import jax.numpy as jnp
+        return self._fn(imgs, jnp.uint32(int(cseed) & 0xffffffff),
+                        jnp.int32(int(nvalid)))

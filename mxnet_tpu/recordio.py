@@ -21,10 +21,24 @@ from collections import namedtuple
 import numpy as np
 
 from . import native
-from .base import MXNetError
+from .base import MXNetError, get_env, register_env
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "read_index",
            "pack", "unpack", "pack_img", "unpack_img"]
+
+#: io_uring-style readahead for indexed readers that follow a known
+#: order (the data-service workers set their epoch-order plan): keep
+#: the OS page cache this many RECORDS ahead of the read cursor via
+#: posix_fadvise(WILLNEED) — sequential-speed reads out of a
+#: random-access (shuffled) plan.  Registered here (the owner module)
+#: per the eager-registration lesson.
+ENV_DATA_READAHEAD = register_env(
+    "MXTPU_DATA_READAHEAD", default=64,
+    doc="Readahead window (records) for planned indexed reads "
+        "(MXIndexedRecordIO.set_read_plan; the data-service workers "
+        "plan each epoch's shard): byte ranges of the next N planned "
+        "records are posix_fadvise(WILLNEED)d ahead of the cursor; "
+        "0 disables")
 
 
 def read_index(idx_path, key_type=int):
@@ -245,6 +259,12 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         self.fidx = None
+        self._ra_fd = None          # readahead-advice fd (any fd works)
+        self._ra_plan = None        # deque of upcoming keys
+        self._ra_window = 0
+        self._ra_ahead = 0          # plan entries already advised
+        self._ra_lens = None        # key -> approx record byte length
+        self.readahead_advised = 0  # records advised (observability)
         super(MXIndexedRecordIO, self).__init__(uri, flag)
 
     def open(self):
@@ -261,12 +281,99 @@ class MXIndexedRecordIO(MXRecordIO):
         if self.is_open:
             super(MXIndexedRecordIO, self).close()
             self.fidx.close()
+        # the readahead plan dies with the fd: a reset() (close+open)
+        # must not leave a live plan advising through a closed fd
+        self._ra_plan = None
+        self._ra_ahead = 0
+        if self._ra_fd is not None:
+            try:
+                os.close(self._ra_fd)
+            except OSError:
+                pass
+            self._ra_fd = None
 
     def seek(self, idx):
         assert self.flag == "r"
         self.record.seek(self.idx[idx])
 
+    # -- planned readahead ---------------------------------------------------
+    def set_read_plan(self, keys, window=None):
+        """Declare the order upcoming ``read_idx`` calls will follow
+        (e.g. the data service's per-epoch shard) so the reader can
+        keep the OS page cache ``window`` records ahead of the cursor
+        (``MXTPU_DATA_READAHEAD``; ``posix_fadvise(WILLNEED)`` — the
+        io_uring-style prefetch a shuffled epoch order defeats the
+        kernel's own sequential readahead on).  Reads that deviate
+        from the plan resynchronize or quietly fall off it; no plan,
+        window 0, or a platform without ``posix_fadvise`` means plain
+        reads."""
+        from collections import deque
+        if window is None:
+            window = int(get_env(ENV_DATA_READAHEAD, 64))
+        self._ra_window = max(0, int(window))
+        self._ra_plan = deque(keys)
+        self._ra_ahead = 0
+        if (self._ra_window <= 0 or not hasattr(os, "posix_fadvise")
+                or self.flag != "r"):
+            self._ra_plan = None
+            return
+        if self._ra_fd is None:
+            try:
+                self._ra_fd = os.open(self.uri, os.O_RDONLY)
+            except OSError:
+                self._ra_plan = None
+                return
+        if self._ra_lens is None:
+            # record length ≈ gap to the next start position (.idx
+            # positions are monotonic); the final record runs to EOF
+            pairs = sorted(self.idx.items(), key=lambda kv: kv[1])
+            try:
+                end = os.fstat(self._ra_fd).st_size
+            except OSError:
+                end = 0
+            lens = {}
+            for (k, pos), nxt in zip(
+                    pairs, [p for _, p in pairs[1:]] + [end]):
+                lens[k] = max(0, nxt - pos)
+            self._ra_lens = lens
+
+    def _maybe_readahead(self, idx):
+        plan = self._ra_plan
+        if plan is None or self._ra_fd is None:
+            return
+        if not plan or plan[0] != idx:
+            # off-plan read (respawn resume, random access): drop plan
+            # entries until the cursor matches again, else give up on
+            # this plan — correctness never depends on the advice
+            while plan and plan[0] != idx:
+                plan.popleft()
+                self._ra_ahead = max(0, self._ra_ahead - 1)
+            if not plan:
+                self._ra_plan = None
+                return
+        plan.popleft()
+        self._ra_ahead = max(0, self._ra_ahead - 1)
+        if self._ra_ahead <= self._ra_window // 2:
+            from itertools import islice
+            # islice, not list(plan)[...]: copying the whole remaining
+            # deque every window/2 reads would make a large shard's
+            # epoch O(N^2/window) in the decode hot path
+            for k in islice(plan, self._ra_ahead, self._ra_window):
+                pos = self.idx.get(k)
+                if pos is None:
+                    continue
+                try:
+                    os.posix_fadvise(self._ra_fd, pos,
+                                     self._ra_lens.get(k, 1 << 16),
+                                     os.POSIX_FADV_WILLNEED)
+                except OSError:
+                    self._ra_plan = None
+                    return
+                self.readahead_advised += 1
+            self._ra_ahead = min(len(plan), self._ra_window)
+
     def read_idx(self, idx):
+        self._maybe_readahead(idx)
         self.seek(idx)
         return self.read()
 

@@ -63,6 +63,36 @@ def clean_faults():
     faults.disarm()
 
 
+def spawn_data_server(tmp_path, n, port=0, extra_env=None):
+    """Spawn one real ``tools/data_server.py`` on a loopback port and
+    wait for its port file: ``(proc, 'host:port')``.  ONE helper shared
+    by the data-service tests and the chaos drills — the spawn/poll
+    protocol must not drift between them.  (bench.py keeps its own
+    standalone copy by design: bench metric subprocesses must not
+    import this pytest/jax-side module.)"""
+    import subprocess
+    import sys
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pf = str(tmp_path / ("dsport%d-%d" % (n, port)))
+    if os.path.exists(pf):
+        os.remove(pf)
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "tools", "data_server.py"),
+         "--port", str(port), "--port-file", pf],
+        stderr=subprocess.DEVNULL, env=env)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(pf):
+        assert proc.poll() is None, \
+            "data server died at startup (rc=%s)" % proc.returncode
+        assert time.monotonic() < deadline, "data server did not come up"
+        time.sleep(0.05)
+    with open(pf) as f:
+        return proc, f.read().strip()
+
+
 def pytest_collection_modifyitems(config, items):
     if _PLATFORM == "cpu":
         return

@@ -388,5 +388,57 @@ def test_gate_skips_fleet_scaling_on_small_hosts(tmp_path):
     assert rep["regressions"][0]["key"] == "fleet_qps_x"
 
 
+def test_gate_keys_cover_data_net_metrics(tmp_path):
+    """PR-12 satellite: the network tier's absolute throughput and
+    scaling shape are gate-guarded — a drop OR a vanished key blocks
+    the run like everything else."""
+    for key in ("data_net_img_s", "data_net_scaling_x"):
+        assert key in bench.GATE_KEYS
+    base = dict(BASE, data_net_img_s=6400.0, data_net_scaling_x=2.5)
+    new = dict(base, data_net_img_s=4000.0)        # -37%
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_net_img_s"
+    # a vanished key blocks too
+    gone = {k: v for k, v in base.items() if k != "data_net_scaling_x"}
+    rep = bench.gate(_write(tmp_path / "n2.json", gone),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_net_scaling_x"
+
+
+def test_gate_skips_data_net_scaling_on_small_hosts(tmp_path):
+    """data_net_scaling_x needs the consumer + S servers + S decode
+    workers running concurrently; a <4-core host emits
+    data_net_scaling_note and the gate skips the SHAPE key (the PR-7
+    SCALING_SHAPE_KEYS machinery) — absolute throughput still gates,
+    and a note-less collapse still blocks."""
+    assert bench.SCALING_SHAPE_KEYS["data_net_scaling_x"] == \
+        "data_net_scaling_note"
+    base = dict(BASE, data_net_img_s=6400.0, data_net_scaling_x=2.5)
+    flat = dict(base, data_net_scaling_x=1.0,
+                data_net_scaling_note="flat_by_construction_2core")
+    rep = bench.gate(_write(tmp_path / "new.json", flat),
+                     against=_write(tmp_path / "old.json", base))
+    assert rep["pass"], rep
+    assert "data_net_scaling_x" in rep["skipped_flat_by_construction"]
+    worse = dict(flat, data_net_img_s=3000.0)      # absolute key gates
+    rep = bench.gate(_write(tmp_path / "n2.json", worse),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_net_img_s"
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, data_net_scaling_x=1.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_net_scaling_x"
+
+
+def test_data_net_mode_is_known_and_aliases():
+    assert "data-net" in bench.KNOWN_MODES
+    assert "data_net" in bench.KNOWN_MODES
+
+
 def test_fleet_mode_is_known_and_in_the_pipeline_set():
     assert "fleet" in bench.KNOWN_MODES

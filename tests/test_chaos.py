@@ -1051,6 +1051,85 @@ def test_data_service_drill_hung_worker_heartbeat_respawn(
 
 
 # ---------------------------------------------------------------------------
+# network data-plane drill (mxnet_tpu/data_service/net.py +
+# tools/data_server.py): the PR-7 SIGKILL drill one layer up — kill a
+# REAL remote server process mid-epoch on a loopback 2-server run and
+# prove connection eviction, reconnect-resume at the last consumed
+# batch, and a stream bit-identical to the undisturbed run including
+# the next epoch.
+# ---------------------------------------------------------------------------
+
+from conftest import spawn_data_server as _spawn_data_server  # noqa: E402
+
+
+@pytest.mark.chaos
+def test_data_net_drill_sigkill_server_mid_epoch(tmp_path, monkeypatch):
+    """SIGKILL data server 0 (a real tools/data_server.py process)
+    after the second delivered batch; the host's "supervisor" (this
+    test) respawns it on the same port.  The consumer's heartbeat/
+    reconnect machinery evicts the dead connection, the handshake
+    resumes at the last consumed batch, the epoch completes, and the
+    whole 2-epoch stream is bit-identical to an undisturbed run —
+    exactly-once delivery across a server kill."""
+    monkeypatch.setenv("MXTPU_DATA_NET_TIMEOUT_S", "5")
+    monkeypatch.setenv("MXTPU_DATA_NET_RECONNECT_S", "0.25")
+    monkeypatch.setenv("MXTPU_DATA_NET_RETRIES", "60")
+    path, idx = _ds_rec_dataset(tmp_path)
+    p0, addr0 = _spawn_data_server(tmp_path, 0)
+    p1, addr1 = _spawn_data_server(tmp_path, 1)
+    port0 = int(addr0.rsplit(":", 1)[1])
+    servers = "%s,%s" % (addr0, addr1)
+    procs = [p0, p1]
+    try:
+        it = _ds_iter(path, idx, workers=1, data_service=servers)
+        ref_e1 = _ds_stream(it)
+        it.reset()
+        ref_e2 = _ds_stream(it)
+        it.close()
+
+        it = _ds_iter(path, idx, workers=1, data_service=servers)
+        got = []
+        for n, b in enumerate(it):
+            got.append((np.array(b.data[0]).copy(),
+                        np.array(b.label[0]).copy(), b.pad))
+            if n == 1:
+                os.kill(p0.pid, signal.SIGKILL)
+                p0.wait()
+                # the remote host's supervisor brings the server back
+                # on its well-known port; the consumer reconnects
+                procs[0], new_addr = _spawn_data_server(
+                    tmp_path, 0, port=port0)
+                assert new_addr == addr0
+        st = it.stats()
+        it.reset()
+        got_e2 = _ds_stream(it)
+        it.close()
+
+        reconnects = sum(s["reconnects"]
+                         for s in st["servers"].values())
+        assert reconnects >= 1, st
+        assert len(got) == len(ref_e1)
+        for i, (a, b) in enumerate(zip(ref_e1, got)):
+            assert a[2] == b[2], ("pad", i)
+            np.testing.assert_array_equal(a[1], b[1],
+                                          err_msg="labels %d" % i)
+            np.testing.assert_array_equal(a[0], b[0],
+                                          err_msg="data %d" % i)
+        for i, (a, b) in enumerate(zip(ref_e2, got_e2)):
+            np.testing.assert_array_equal(a[0], b[0],
+                                          err_msg="epoch2 data %d" % i)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
 # fleet drills (mxnet_tpu/fleet/): replicas are real serve.py daemons
 # behind the real router — SIGKILL one mid-traffic and prove eviction,
 # fail-once-never-retry, warm rejoin from the AOT store, and a clean

@@ -19,8 +19,11 @@ The load-bearing contracts proved here:
    the last consumed record, and a worker that keeps dying exhausts a
    budget instead of looping forever.
 """
+import json
 import os
+import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -31,6 +34,8 @@ from mxnet_tpu.data_service import common
 from mxnet_tpu.data_service.ring import Ring
 
 pytestmark = pytest.mark.resilience
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _gradient_img(h=64, w=64, seed=0):
@@ -143,6 +148,33 @@ def test_worker_batches_partition_is_exact():
     flat = [k for gi in sorted(seen) for k in seen[gi]]
     assert flat == order   # union in global order IS the epoch stream
     assert len(seen[4]) == 5   # padded final batch holds the remainder
+
+
+def test_worker_batches_strided_partition_is_exact():
+    """The network tier's two-level shard: server s of S owns global
+    batches i % S == s, its local workers subdivide — the union over
+    (server, worker) is exactly the epoch stream for ANY (S, W)."""
+    order = list(range(100))
+    nb = common.num_batches(100, 8)
+    for S, W in ((1, 1), (2, 2), (3, 2), (4, 3)):
+        seen = {}
+        for s in range(S):
+            count = 0
+            for w in range(W):
+                for gi, keys in common.worker_batches(
+                        order, 8, w, W, stream_offset=s,
+                        stream_stride=S):
+                    assert gi % S == s        # the outer shard
+                    assert gi not in seen
+                    seen[gi] = keys
+                    count += 1
+            assert count == common.stream_batches(nb, s, S)
+        assert sorted(seen) == list(range(nb)), (S, W)
+        flat = [k for gi in sorted(seen) for k in seen[gi]]
+        assert flat == order, (S, W)
+    # defaults are the single-host assignment, entry for entry
+    assert common.worker_batches(order, 8, 1, 3) == \
+        common.worker_batches(order, 8, 1, 3, 0, 1)
 
 
 def test_read_index_matches_indexed_recordio(rec_dataset):
@@ -536,3 +568,463 @@ def test_databatch_release_default_noop_and_dataiter_close():
     b.release()   # idempotent no-op
     it = mx.io.NDArrayIter(np.zeros((4, 2)), batch_size=2)
     it.close()    # base-class no-op exists for generic consumers
+
+
+# ---------------------------------------------------------------------------
+# chunk_seed / EpochOrder stability across process boundaries — the
+# contract the network tier rides on: the epoch permutation, the batch
+# ownership and the augmentation seeds are pure functions of
+# (keys, seed, epoch), so a server process on ANOTHER host computes
+# byte-identical plans from nothing but the config.
+# ---------------------------------------------------------------------------
+
+_XPROC_PROG = """
+import json, sys
+sys.path.insert(0, %r)
+from mxnet_tpu.data_service import common
+cfg = json.loads(sys.stdin.read())
+keys = cfg["keys"]
+out = {"orders": {}, "shards": {}, "seeds": {}}
+o = common.EpochOrder(keys, cfg["seed"], True)
+for epoch in (1, 2, 3):
+    order = o.seek(epoch)
+    out["orders"][str(epoch)] = list(order)
+    shard = {}
+    for s in range(cfg["S"]):
+        for w in range(cfg["W"]):
+            for g, ks in common.worker_batches(
+                    order, cfg["bs"], w, cfg["W"], s, cfg["S"]):
+                shard[str(g)] = {"server": s, "worker": w, "keys": ks}
+    out["shards"][str(epoch)] = shard
+    out["seeds"][str(epoch)] = [
+        common.chunk_seed(cfg["seed"], g, epoch=epoch)
+        for g in range(len(shard))]
+print(json.dumps(out, sort_keys=True))
+"""
+
+
+def test_epoch_order_and_chunk_seeds_identical_across_processes():
+    """Serialize nothing but the CONFIG to another "host" (a fresh
+    python process importing only the jax-free common module) and
+    replay: epoch orders, per-(server, worker) batch ownership and
+    per-batch augmentation seeds must be byte-identical to this
+    process's — the determinism theorem the network tier's exactly-once
+    reconnect resume depends on."""
+    cfg = {"keys": list(range(53)), "seed": 17, "bs": 8, "S": 3, "W": 2}
+    # silence the synthetic path difference: run the SAME program here
+    # and there, compare the JSON byte-for-byte
+    prog = _XPROC_PROG % (REPO,)
+    res = subprocess.run([sys.executable, "-c", prog],
+                         input=json.dumps(cfg), capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    remote = res.stdout.strip()
+
+    out = {"orders": {}, "shards": {}, "seeds": {}}
+    o = common.EpochOrder(cfg["keys"], cfg["seed"], True)
+    for epoch in (1, 2, 3):
+        order = o.seek(epoch)
+        out["orders"][str(epoch)] = list(order)
+        shard = {}
+        for s in range(cfg["S"]):
+            for w in range(cfg["W"]):
+                for g, ks in common.worker_batches(
+                        order, cfg["bs"], w, cfg["W"], s, cfg["S"]):
+                    shard[str(g)] = {"server": s, "worker": w, "keys": ks}
+        out["shards"][str(epoch)] = shard
+        out["seeds"][str(epoch)] = [
+            common.chunk_seed(cfg["seed"], g, epoch=epoch)
+            for g in range(len(shard))]
+    local = json.dumps(out, sort_keys=True)
+    assert local == remote
+
+
+# ---------------------------------------------------------------------------
+# recordio readahead (the io_uring-style posix_fadvise window)
+# ---------------------------------------------------------------------------
+
+def test_read_plan_readahead_advises_and_reads_correctly(rec_dataset):
+    path, idx = rec_dataset
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    plain = {k: r.read_idx(k) for k in r.keys}
+    r.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    order = list(reversed(r.keys))          # a shuffled-ish plan
+    r.set_read_plan(order, window=8)
+    got = {k: r.read_idx(k) for k in order}
+    if hasattr(os, "posix_fadvise"):
+        assert r.readahead_advised > 0
+    r.close()
+    assert got == plain                     # advice never changes bytes
+
+
+def test_read_plan_off_plan_reads_resync(rec_dataset):
+    """A read that deviates from the plan (respawn resume, random
+    access) must stay correct — the plan resynchronizes or quietly
+    disables, never misreads."""
+    path, idx = rec_dataset
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    r.set_read_plan(r.keys, window=4)
+    a = r.read_idx(r.keys[0])
+    b = r.read_idx(r.keys[10])   # skipped 9 plan entries
+    c = r.read_idx(r.keys[11])
+    r2 = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert a == r2.read_idx(r2.keys[0])
+    assert b == r2.read_idx(r2.keys[10])
+    assert c == r2.read_idx(r2.keys[11])
+    r.close()
+    r2.close()
+
+
+def test_read_plan_survives_reset(rec_dataset):
+    """reset() (close + open) while a plan is live must not leave the
+    plan advising through a closed fd — the next planned read stays a
+    plain correct read."""
+    path, idx = rec_dataset
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    r.set_read_plan(r.keys, window=4)
+    a = r.read_idx(r.keys[0])
+    r.reset()
+    b = r.read_idx(r.keys[0])    # plan cleared with its fd: plain read
+    assert a == b
+    r.close()
+
+
+def test_read_plan_window_zero_disables(rec_dataset, monkeypatch):
+    monkeypatch.setenv("MXTPU_DATA_READAHEAD", "0")
+    path, idx = rec_dataset
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    r.set_read_plan(r.keys)      # window from env: 0 = off
+    r.read_idx(r.keys[0])
+    assert r.readahead_advised == 0
+    r.close()
+
+
+# ---------------------------------------------------------------------------
+# the network tier (data_service/net.py + tools/data_server.py)
+# ---------------------------------------------------------------------------
+
+from conftest import spawn_data_server as _spawn_data_server  # noqa: E402
+
+
+@pytest.fixture()
+def data_servers(rec_dataset, tmp_path):
+    """Two loopback tools/data_server.py processes."""
+    procs, addrs = [], []
+    for n in range(2):
+        p, a = _spawn_data_server(tmp_path, n)
+        procs.append(p)
+        addrs.append(a)
+    yield ",".join(addrs)
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def test_net_tier_bit_identical_to_local_service(rec_dataset,
+                                                 data_servers):
+    """THE network-tier contract: a 2-server stream (1 decode worker
+    each) is bit-identical to the local in-process service — augmented,
+    across two epochs, padded final batch included.  (The local service
+    is itself pinned bit-identical to the in-process pipe above, so
+    transitively all three transports agree.)"""
+    path, idx = rec_dataset
+    kw = _kw(path, idx, rand_crop=True, rand_mirror=True)
+    loc = mx.io.ImageRecordIter(preprocess_threads=2, data_service=True,
+                                **kw)
+    ref = _stream(loc, epochs=2)
+    loc.close()
+    net = mx.io.ImageRecordIter(preprocess_threads=1,
+                                data_service=data_servers, **kw)
+    got = _stream(net, epochs=2)
+    st = net.stats()
+    net.close()
+    _assert_streams_equal(ref, got, "local-vs-net")
+    assert ref[-1][2] == 8 - 37 % 8   # padded final batch survived TCP
+    assert st["num_servers"] == 2
+    assert all(s["alive"] for s in st["servers"].values())
+    assert all(s["reconnects"] == 0 for s in st["servers"].values())
+
+
+def test_net_tier_single_server_and_device_mode(rec_dataset, tmp_path):
+    """A 1-server stream matches the 2-server stream (any-server-count
+    identity), and the transparent device-array route delivers the
+    same bytes as host_batches over the network."""
+    path, idx = rec_dataset
+    proc, addr = _spawn_data_server(tmp_path, 9)
+    try:
+        kw = _kw(path, idx)
+        host = mx.io.ImageRecordIter(preprocess_threads=2,
+                                     data_service=addr, **kw)
+        hs = _stream(host)
+        host.close()
+        kw2 = _kw(path, idx)
+        kw2.pop("host_batches")
+        dev = mx.io.ImageRecordIter(preprocess_threads=1,
+                                    data_service=addr,
+                                    host_batches=False, **kw2)
+        ds = _stream(dev)
+        dev.close()
+        _assert_streams_equal(hs, ds, "net-host-vs-device")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_net_seek_resumes_mid_epoch_bit_identical(rec_dataset,
+                                                  data_servers):
+    """`NetDataService.seek(epoch, consumed)` honors the DataService
+    collector surface: a fresh consumer seeking to (epoch, K) streams
+    exactly the reference's tail — the same machinery a reconnect uses,
+    exposed for resume-at-batch consumers."""
+    from mxnet_tpu.data_service import DataServiceIter
+    from mxnet_tpu.data_service.net import NetDataService
+
+    def svc():
+        return NetDataService(data_servers, *rec_dataset, (3, 32, 32), 8,
+                              shuffle=True, seed=11)
+
+    ref_it = DataServiceIter(svc())
+    ref = [(np.array(b.data[0]).copy(), np.array(b.label[0]).copy(),
+            b.pad) for b in ref_it]
+    ref_it.close()
+
+    resumed = svc()
+    resumed.seek(1, 2)              # first 2 global batches consumed
+    it = DataServiceIter(resumed)
+    got = [(np.array(b.data[0]).copy(), np.array(b.label[0]).copy(),
+            b.pad) for b in it]
+    st = resumed.stats()
+    it.close()
+    _assert_streams_equal(ref[2:], got, "seek-resume")
+    # the resume is WARM: pre-seek frames already in flight are
+    # discarded in-band (same-epoch, behind the cursor), never treated
+    # as a protocol violation that evicts the connection
+    assert all(s["reconnects"] == 0 for s in st["servers"].values()), st
+
+
+def test_net_env_var_routes_and_false_opts_out(rec_dataset, data_servers,
+                                               monkeypatch):
+    from mxnet_tpu.data_service.net import NetDataService
+    path, idx = rec_dataset
+    monkeypatch.setenv("MXTPU_DATA_SERVERS", data_servers)
+    it = mx.io.ImageRecordIter(preprocess_threads=1, **_kw(path, idx))
+    assert isinstance(it._service, NetDataService)
+    it.close()
+    # explicit opt-out wins over the env
+    it = mx.io.ImageRecordIter(preprocess_threads=1, data_service=False,
+                               **_kw(path, idx))
+    assert it._service is None
+    it.close()
+    # explicit data_service=True keeps the LOCAL service even when the
+    # env names servers (a call site that opted into local stays local)
+    it = mx.io.ImageRecordIter(preprocess_threads=1, data_service=True,
+                               **_kw(path, idx))
+    assert not isinstance(it._service, NetDataService)
+    assert it._service is not None
+    it.close()
+
+
+def test_data_service_truthy_and_list_forms_route(rec_dataset,
+                                                  data_servers):
+    """Routing accepts the historical truthy form (data_service=1 ==
+    the local service — it must not silently fall through to the
+    in-process pipeline) and a list of addresses for the net tier."""
+    from mxnet_tpu.data_service.net import NetDataService
+    path, idx = rec_dataset
+    it = mx.io.ImageRecordIter(preprocess_threads=2, data_service=1,
+                               **_kw(path, idx))
+    assert it._service is not None
+    assert not isinstance(it._service, NetDataService)
+    assert it._service.num_workers == 2
+    it.close()
+    it = mx.io.ImageRecordIter(preprocess_threads=1,
+                               data_service=data_servers.split(","),
+                               **_kw(path, idx))
+    assert isinstance(it._service, NetDataService)
+    it.close()
+
+
+def test_net_tier_rejects_bad_server_and_bad_config(rec_dataset,
+                                                    tmp_path):
+    """An unreachable server exhausts the reconnect budget with a clear
+    error; a server-side dataset problem surfaces as the handshake
+    rejection, not a crash loop."""
+    from mxnet_tpu.data_service.net import NetDataService
+    path, idx = rec_dataset
+    with pytest.raises(mx.MXNetError, match="unreachable"):
+        NetDataService("127.0.0.1:1", path, idx, (3, 32, 32), 8,
+                       retries=2, reconnect_s=0.05)
+    proc, addr = _spawn_data_server(tmp_path, 8)
+    try:
+        with pytest.raises(mx.MXNetError, match="rejected"):
+            NetDataService(addr, "/nonexistent/x.rec",
+                           "/nonexistent/x.idx", (3, 32, 32), 8)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_data_server_cli_never_imports_jax(rec_dataset, tmp_path):
+    """The server process (and its decode workers) must stay jax-free —
+    an XLA client on every decode host would burn seconds + hundreds of
+    MB per server and fight a co-tenant trainer for the chip.  Poisoned-
+    jax proof, the mxlint/fleet CLI idiom: the server decodes and
+    streams a REAL epoch with `import jax` booby-trapped, which would
+    crash it (and its workers) on the spot."""
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('data server must not import jax')")
+    env = {"PYTHONPATH": str(tmp_path) + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+    proc, addr = _spawn_data_server(tmp_path, 7, extra_env=env)
+    try:
+        from mxnet_tpu.data_service import DataServiceIter
+        from mxnet_tpu.data_service.net import NetDataService
+        path, idx = rec_dataset
+        svc = NetDataService(addr, path, idx, (3, 32, 32), 8,
+                             shuffle=True, seed=11, retries=2)
+        it = DataServiceIter(svc)
+        n = sum(1 for _ in it)
+        it.close()
+        assert n == 5                   # full epoch streamed jax-free
+        assert proc.poll() is None      # server survived the epoch
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# in-graph (device) augmentation — kernels/augment.py behind the
+# MXTPU_FUSED_KERNELS 'augment' seam
+# ---------------------------------------------------------------------------
+
+def test_augment_kernel_registered_in_router():
+    from mxnet_tpu import kernels
+    assert "augment" in kernels.KNOWN_KERNELS
+    assert kernels.fused_enabled("augment")   # default "1" = all on
+
+
+def test_device_augment_reproducible_across_worker_counts(rec_dataset):
+    """The acceptance contract: the device-augmented pipeline is a pure
+    function of (seed, epoch, batch) — identical streams for w=1 vs
+    w=4 across two epochs, final shapes/dtype as requested, pad rows
+    exact zeros."""
+    path, idx = rec_dataset
+    kw = dict(path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+              batch_size=8, shuffle=True, seed=11, dtype="float32",
+              rand_crop=True, rand_mirror=True, mean=True, std=True)
+
+    def dev_stream(workers):
+        it = mx.io.ImageRecordIter(preprocess_threads=workers,
+                                   data_service=True,
+                                   device_augment=True, **kw)
+        assert it.provide_data[0].shape == (8, 3, 32, 32)
+        out = _stream(it, epochs=2)
+        it.close()
+        return out
+
+    s1 = dev_stream(1)
+    s4 = dev_stream(4)
+    _assert_streams_equal(s1, s4, "device-aug w1-vs-w4")
+    pad = s1[4][2]
+    assert pad == 8 - 37 % 8
+    np.testing.assert_array_equal(s1[4][0][-pad:], 0)   # pad rows zeroed
+
+
+def test_device_augment_seam_off_restores_exact_host_path(rec_dataset,
+                                                          monkeypatch):
+    """MXTPU_FUSED_KERNELS=0 + device_augment falls back to the EXACT
+    host-augmented graph (bitwise equal to a plain service run), and
+    with the seam ON the device product provably differs (the kernel
+    actually engaged)."""
+    path, idx = rec_dataset
+    kw = dict(path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+              batch_size=8, shuffle=True, seed=11, dtype="float32",
+              rand_crop=True, rand_mirror=True)
+    host = mx.io.ImageRecordIter(preprocess_threads=2, data_service=True,
+                                 **kw)
+    ref = _stream(host)
+    host.close()
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "0")
+    off = mx.io.ImageRecordIter(preprocess_threads=2, data_service=True,
+                                device_augment=True, **kw)
+    assert off._dev_aug is None
+    got = _stream(off)
+    off.close()
+    _assert_streams_equal(ref, got, "seam-off-vs-host")
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS", "1")
+    on = mx.io.ImageRecordIter(preprocess_threads=2, data_service=True,
+                               device_augment=True, **kw)
+    dev = _stream(on)
+    on.close()
+    assert any(not np.array_equal(a[0], b[0])
+               for a, b in zip(ref, dev))   # provably engaged
+
+
+def test_device_augment_requires_service_and_rejects_host_batches(
+        rec_dataset):
+    path, idx = rec_dataset
+    with pytest.raises(mx.MXNetError, match="device_augment"):
+        mx.io.ImageRecordIter(preprocess_threads=1, device_augment=True,
+                              **_kw(path, idx))
+    with pytest.raises(mx.MXNetError, match="host_batches"):
+        mx.io.ImageRecordIter(preprocess_threads=1, data_service=True,
+                              device_augment=True, **_kw(path, idx))
+
+
+def test_device_augment_zero_margin_engages_and_false_opts_out(
+        rec_dataset):
+    """device_augment=0 is a REAL margin (center crop + on-device
+    mirror/normalize), not a falsy 'off' — only None/False disable."""
+    path, idx = rec_dataset
+    kw = dict(path_imgrec=path, path_imgidx=idx, data_shape=(3, 32, 32),
+              batch_size=8, shuffle=False, seed=11, dtype="float32",
+              rand_mirror=True)
+    it = mx.io.ImageRecordIter(preprocess_threads=1, data_service=True,
+                               device_augment=0, **kw)
+    assert it._dev_aug is not None and it._dev_aug.margin == 0
+    b = it.next()
+    assert b.data[0].shape == (8, 3, 32, 32)
+    it.close()
+    it = mx.io.ImageRecordIter(preprocess_threads=1, data_service=True,
+                               device_augment=False, **kw)
+    assert it._dev_aug is None
+    it.close()
+
+
+def test_device_augment_kernel_unit_geometry():
+    """The traced op itself: center crop with margin 0 passes pixels
+    through; a mismatched canvas goes through the jax.image resize
+    path; per-image RNG makes rows differ under rand_crop."""
+    from mxnet_tpu.kernels.augment import DeviceAugment
+    rs = np.random.RandomState(0)
+    # identity: margin 0, no aug, float pass-through
+    aug = DeviceAugment((3, 8, 8), margin=0, layout="NCHW")
+    x = rs.randint(0, 255, (4, 3, 8, 8)).astype(np.uint8)
+    y = np.asarray(aug(x, cseed=7, nvalid=4))
+    np.testing.assert_array_equal(y, x.astype(np.float32))
+    # resize path: canvas 16x16 -> (8+0)x(8+0) via jax.image
+    y2 = np.asarray(aug(rs.randint(0, 255, (4, 3, 16, 16))
+                        .astype(np.uint8), cseed=7, nvalid=4))
+    assert y2.shape == (4, 3, 8, 8)
+    # random crop: same cseed reproduces, different cseed differs
+    aug_rc = DeviceAugment((3, 8, 8), margin=4, rand_crop=True,
+                           rand_mirror=True, layout="NCHW")
+    big = rs.randint(0, 255, (4, 3, 12, 12)).astype(np.uint8)
+    a = np.asarray(aug_rc(big, cseed=5, nvalid=4))
+    b = np.asarray(aug_rc(big, cseed=5, nvalid=4))
+    c = np.asarray(aug_rc(big, cseed=6, nvalid=4))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    # NHWC layout round-trips shapes
+    aug_nhwc = DeviceAugment((3, 8, 8), margin=4, rand_crop=True,
+                             layout="NHWC")
+    z = np.asarray(aug_nhwc(rs.randint(0, 255, (2, 12, 12, 3))
+                            .astype(np.uint8), cseed=1, nvalid=2))
+    assert z.shape == (2, 8, 8, 3)

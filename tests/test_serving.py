@@ -377,6 +377,42 @@ def test_pool_bf16_weight_cast():
     np.testing.assert_allclose(out, f32, rtol=0.1, atol=0.05)
 
 
+def test_pool_bn_fold_is_the_serving_default_with_tolerance_parity(
+        monkeypatch):
+    """Inference-trace conv-BN folding (`bn_fold`) is the SERVING
+    default: the default MXTPU_FUSED_KERNELS set includes it, the
+    pooled conv/BN forward's plan structurally carries the fold (the
+    BN entry holds the conv's inputs as extra refs), and the served
+    outputs are tolerance-equal to a fold-off pool — the ONE
+    documented non-bitwise fusion (docs/how_to/serving.md, next to the
+    bf16/int8 accuracy rows)."""
+    from mxnet_tpu import kernels
+    from mxnet_tpu.executor import _fuse_bn_plan, _node_plan
+    monkeypatch.delenv("MXTPU_FUSED_KERNELS", raising=False)
+    assert "bn_fold" in kernels.enabled_kernels()   # default = on
+    sym = conv_sym()
+    # structural proof on the very graph the pool serves: under the
+    # DEFAULT env the fusion pass folds bn1 into c1 (3 conv extra refs)
+    plan = _node_plan(sym)
+    refs = [(id(n), i) for n, i in sym._outputs]
+    fused = _fuse_bn_plan(plan, refs)
+    bn_entry = next(e for e in fused if e[0].name == "bn1")
+    assert bn_entry[5] is not None and len(bn_entry[5][1]) == 3
+
+    x = np.random.RandomState(3).randn(4, 3, 8, 8).astype("f")
+    pool_on, _, args, auxs = make_pool(sym=sym, sample=(3, 8, 8))
+    folded = pool_on.get("m").forward({"data": x})[0]
+    # a fresh pool with the fold disabled (everything else fused as
+    # before): tolerance-equal, per the documented contract
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS",
+                       "bn_act,lstm_cell,flash_attention,augment")
+    assert "bn_fold" not in kernels.enabled_kernels()
+    pool_off = ModelPool()
+    pool_off.add("m", sym, args, auxs, sample_shapes={"data": (3, 8, 8)})
+    unfolded = pool_off.get("m").forward({"data": x})[0]
+    np.testing.assert_allclose(folded, unfolded, rtol=1e-5, atol=1e-6)
+
+
 def test_pool_unknown_model_and_names():
     pool, _, _, _ = make_pool()
     assert pool.names() == ["m"]
