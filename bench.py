@@ -1519,6 +1519,190 @@ def _serve_parity(port, specs):
     return True
 
 
+def _hotswap_bench(seconds=2.0):
+    """The ``bench.py hotswap`` mode (docs/how_to/serving.md,
+    "Continuous deployment"): a LIVE ``tools/serve.py --watch`` daemon
+    under closed-loop load while this process streams new verified
+    epochs into its checkpoint directory — the train-to-serve seam,
+    measured, not assumed.
+
+    - ``hotswap_swap_ms`` — mean dispatch-boundary critical section per
+      swap (wait for the in-flight batch + install + probe), as the
+      daemon itself measures it.  LOWER is better: the gate treats it
+      through ``LOWER_IS_BETTER_KEYS``.
+    - ``hotswap_drop_free`` — 1.0 iff ZERO requests were dropped or
+      errored across every swap (the zero-dropped-requests contract;
+      429 sheds are admission control, not drops, and are counted
+      separately).
+    - ``hotswap_promote_ms`` — publish-to-served latency (includes the
+      MXTPU_SWAP_POLL_S poll; recorded alongside, not gated).
+    - ``hotswap_qps_dip_frac`` — completion rate in the worst 250ms
+      window around a swap vs the steady-state median (1.0 = no dip).
+    """
+    import shutil
+    import signal as _signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.resilience import CheckpointManager
+    from mxnet_tpu.serving import ServeClient
+
+    tmp = tempfile.mkdtemp(prefix="bench_hotswap_")
+    out = {}
+    proc = None
+    try:
+        sym = models.get_symbol("mlp", num_classes=10)
+        arg_shapes, _, _ = sym.infer_shape(data=(1, 784))
+
+        def params(seed):
+            rs = np.random.RandomState(seed)
+            return {n: mx.nd.array(rs.uniform(-0.1, 0.1, s).astype("f"))
+                    for n, s in zip(sym.list_arguments(), arg_shapes)
+                    if n not in ("data", "softmax_label")}
+
+        ckpt_dir = os.path.join(tmp, "ckpts")
+        man = CheckpointManager(ckpt_dir)
+        man.save(1, symbol=sym, arg_params=params(1), aux_params={},
+                 blocking=True)
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        port_file = os.path.join(tmp, "port")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXTPU_SWAP_POLL_S="0.1")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(here, "tools", "serve.py"),
+             "--model", "mlp=%s" % ckpt_dir,
+             "--input-shape", "mlp:data=784",
+             "--port", "0", "--port-file", port_file,
+             "--buckets", "1,2,4,8", "--max-wait-ms", "2",
+             "--warmup", "--watch"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True)
+        deadline = time.monotonic() + 300
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError("hotswap daemon died: %s"
+                                   % proc.stderr.read()[-2000:])
+            if time.monotonic() > deadline:
+                raise RuntimeError("hotswap daemon never wrote its port")
+            time.sleep(0.1)
+        port = int(open(port_file).read().split(":")[1])
+        ServeClient("127.0.0.1", port).wait_ready(60)
+
+        # -- closed-loop load for the whole run ---------------------------
+        rs = np.random.RandomState(0)
+        stop = threading.Event()
+        lock = threading.Lock()
+        events = []                 # (t_done, status) per request
+        drops = [0]                 # connection-level losses
+
+        def worker(i):
+            cli = ServeClient("127.0.0.1", port, timeout=30)
+            x = rs.rand(784).astype("f") + i
+            try:
+                while not stop.is_set():
+                    try:
+                        status, _ = cli.predict("mlp", x, npy=True)
+                    except Exception:  # noqa: BLE001 — dropped response
+                        with lock:
+                            drops[0] += 1
+                        continue
+                    with lock:
+                        events.append((time.monotonic(), status))
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(max(1.0, seconds / 2.0))   # steady-state baseline
+
+        # -- stream new epochs under load ---------------------------------
+        stat_cli = ServeClient("127.0.0.1", port)
+        swap_ms, promote_ms, swap_at = [], [], []
+        for epoch in (2, 3):
+            man.save(epoch, symbol=sym, arg_params=params(epoch),
+                     aux_params={}, blocking=True)
+            t_pub = time.monotonic()
+            lim = time.monotonic() + 60
+            while time.monotonic() < lim:
+                status, stats = stat_cli.stats()
+                if status == 200 and \
+                        (stats.get("epochs") or {}).get("mlp") == epoch:
+                    break
+                time.sleep(0.02)
+            else:
+                raise RuntimeError("epoch %d never went live" % epoch)
+            t_live = time.monotonic()
+            swap_at.append(t_live)
+            promote_ms.append((t_live - t_pub) * 1e3)
+            dep = (stats.get("deploy") or {}).get("mlp") or {}
+            if dep.get("last_swap_ms") is not None:
+                swap_ms.append(float(dep["last_swap_ms"]))
+            time.sleep(max(0.5, seconds / 4.0))
+        time.sleep(max(0.5, seconds / 4.0))
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        status, stats = stat_cli.stats()
+        dep = (stats.get("deploy") or {}).get("mlp") or {}
+        stat_cli.close()
+
+        # -- the ledger ---------------------------------------------------
+        with lock:
+            done = list(events)
+        errors = sum(1 for _, s in done if s not in (200, 429))
+        sheds = sum(1 for _, s in done if s == 429)
+        ok = [t for t, s in done if s == 200]
+        out["hotswap_swaps"] = int(dep.get("promoted") or len(swap_at))
+        out["hotswap_requests"] = len(done)
+        out["hotswap_errors"] = errors
+        out["hotswap_dropped_conns"] = drops[0]
+        if sheds:
+            out["hotswap_sheds"] = sheds
+        out["hotswap_drop_free"] = \
+            1.0 if errors == 0 and drops[0] == 0 else 0.0
+        if swap_ms:
+            out["hotswap_swap_ms"] = round(sum(swap_ms) / len(swap_ms), 3)
+        out["hotswap_promote_ms"] = round(
+            sum(promote_ms) / len(promote_ms), 1)
+        # QPS dip: completions per 250ms bucket, worst swap-adjacent
+        # bucket vs the steady-state median
+        if ok:
+            t0 = min(ok)
+            buckets = {}
+            for t in ok:
+                buckets[int((t - t0) / 0.25)] = \
+                    buckets.get(int((t - t0) / 0.25), 0) + 1
+            hot = set()
+            for ts in swap_at:
+                base_i = int((ts - t0) / 0.25)
+                hot.update((base_i - 1, base_i, base_i + 1))
+            steady = sorted(v for k, v in buckets.items()
+                            if k not in hot and k != max(buckets))
+            inside = [buckets.get(i, 0) for i in sorted(hot)
+                      if 0 <= i <= max(buckets)]
+            if steady and inside:
+                med = steady[len(steady) // 2]
+                if med > 0:
+                    out["hotswap_qps_dip_frac"] = round(
+                        min(inside) / float(med), 3)
+        proc.send_signal(_signal.SIGTERM)
+        out["hotswap_drain_rc"] = proc.wait(timeout=60)
+        proc = None
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _fleet_manifest(specs, buckets, replicas=1):
     """The bench models as a real :class:`FleetManifest` (the same
     object the CLI builds — no parallel spec format to drift)."""
@@ -1959,7 +2143,8 @@ def _run_mode(mode):
         mode = "data-net"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
                 "resume", "checkpoint", "analyze", "serve", "fleet",
-                "data-service", "data-net", "roofline", "zero3"):
+                "hotswap", "data-service", "data-net", "roofline",
+                "zero3"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -1982,6 +2167,8 @@ def _run_mode(mode):
         out.update(_serve_bench())
     elif mode == "fleet":
         out.update(_fleet_bench())
+    elif mode == "hotswap":
+        out.update(_hotswap_bench())
     elif mode == "decode":
         out.update(_decode_bench())
     elif mode == "data-service":
@@ -2051,7 +2238,8 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "data-net", "data_net",
     "fed-cpu", "pipeline", "compile-probe", "resume", "checkpoint",
-    "analyze", "serve", "fleet", "roofline", "zero3", "fed", "compute",
+    "analyze", "serve", "fleet", "hotswap", "roofline", "zero3",
+    "fed", "compute",
     "compute-large", "inception-bn", "resnet-152", "lstm",
 ))
 
@@ -2113,8 +2301,9 @@ def _collect(mode, timeout=480, extra_env=None):
 # most recent BENCH_*.json and fail on >10% drops in the named keys
 # ---------------------------------------------------------------------------
 
-#: higher-is-better keys the gate guards.  Entries ending in ``*`` are
-#: prefixes (every matching key is compared).
+#: higher-is-better keys the gate guards (except the members of
+#: LOWER_IS_BETTER_KEYS below).  Entries ending in ``*`` are prefixes
+#: (every matching key is compared).
 GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "inception_bn_img_s", "resnet152_img_s", "lstm_tok_s",
              "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
@@ -2123,7 +2312,14 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "data_net_img_s", "data_net_scaling_x",
              "pipeline_decode_scaling_x", "roofline_*_speedup",
              "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
-             "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff")
+             "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff",
+             "hotswap_drop_free", "hotswap_swap_ms")
+
+#: GATE_KEYS members where LOWER is better (latencies): the gate flags
+#: a RISE past tolerance instead of a drop — gating a latency with the
+#: higher-is-better rule would fail every improvement and bless every
+#: regression
+LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms",))
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -2247,7 +2443,12 @@ def gate(new_path, against=None, tolerance=0.10):
                                 "status": "missing"})
             continue
         checked.append(key)
-        if old_v > 0 and new_v < old_v * (1.0 - tolerance):
+        if key in LOWER_IS_BETTER_KEYS:
+            if old_v > 0 and new_v > old_v * (1.0 + tolerance):
+                regressions.append(
+                    {"key": key, "baseline": old_v, "value": new_v,
+                     "rise": round(new_v / old_v - 1.0, 3)})
+        elif old_v > 0 and new_v < old_v * (1.0 - tolerance):
             regressions.append(
                 {"key": key, "baseline": old_v, "value": new_v,
                  "drop": round(1.0 - new_v / old_v, 3)})
@@ -2323,6 +2524,7 @@ def main():
         parts.update(_collect("resume"))
         parts.update(_collect("checkpoint"))
         parts.update(_collect("serve"))
+        parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
         parts.update(_collect("roofline"))
         parts.update(_collect("zero3"))
@@ -2395,7 +2597,8 @@ def main():
             result[k] = parts[k]
     for k in sorted(parts):
         if k.startswith("serve_") or k.startswith("roofline_") \
-                or k.startswith("zero3_") or k.startswith("fleet_"):
+                or k.startswith("zero3_") or k.startswith("fleet_") \
+                or k.startswith("hotswap_"):
             result[k] = parts[k]
     if compute is not None:
         if fed is None:

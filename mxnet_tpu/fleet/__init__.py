@@ -40,10 +40,11 @@ from .router import (FleetRouter, NoHealthyReplica, ReplicaDead,
                      ENV_FLEET_SPILL_QUEUE, ENV_FLEET_HEARTBEAT_S,
                      ENV_FLEET_EVICT_S)
 from .warm import build_warm_store, warm_store_manifest
+from .deploy import RollingSwap
 
 __all__ = ["FleetManifest", "parse_shape_specs", "replica_device_env",
            "default_serve_py", "Replica", "ReplicaController",
            "FleetRouter", "NoHealthyReplica", "ReplicaDead",
-           "build_warm_store", "warm_store_manifest",
+           "build_warm_store", "warm_store_manifest", "RollingSwap",
            "ENV_FLEET_REPLICAS", "ENV_FLEET_SPILL_QUEUE",
            "ENV_FLEET_HEARTBEAT_S", "ENV_FLEET_EVICT_S"]

@@ -82,7 +82,7 @@ class _ReplicaView(object):
     + forwarding outcomes)."""
 
     __slots__ = ("id", "addr", "last_ok", "stats", "inflight", "probes",
-                 "errors")
+                 "probe_retries", "errors")
 
     def __init__(self, rid):
         self.id = rid
@@ -91,6 +91,7 @@ class _ReplicaView(object):
         self.stats = None           # last /stats payload
         self.inflight = 0           # router-side forwards in flight
         self.probes = 0
+        self.probe_retries = 0      # jittered second tries (GETs only)
         self.errors = 0
 
 
@@ -130,6 +131,14 @@ class FleetRouter(object):
                     else range(n)):
             self._views[rid] = _ReplicaView(rid)
         self._order = sorted(self._views)
+        #: replicas held out of routing by a rolling swap
+        #: (fleet/deploy.py): fenced != evicted — the replica is
+        #: healthy and still finishing its in-flight work, it just
+        #: takes no NEW work while its weights swap
+        self._fenced = set()
+        #: the active RollingSwap, when one is attached (fleet serve
+        #: --watch) — surfaced on /stats as rollout progress
+        self.deploy = None
         self._lock = threading.Lock()
         self._local = threading.local()
         self._server = None
@@ -156,8 +165,9 @@ class FleetRouter(object):
                 for rid, port in self._controller.ports().items()}
 
     def _probe_one(self, view, addr):
-        """One /healthz (+ /stats) round trip; returns True when the
-        replica answered healthy."""
+        """One /healthz (+ /stats) round trip; returns ``"ok"``,
+        ``"draining"`` (the replica deliberately fenced itself) or
+        ``"down"`` (transport-level miss)."""
         import http.client
         conn = http.client.HTTPConnection(
             addr[0], addr[1], timeout=max(0.2, min(self.heartbeat_s,
@@ -167,7 +177,7 @@ class FleetRouter(object):
             resp = conn.getresponse()
             body = resp.read()
             if resp.status != 200:
-                return False
+                return "down"
             payload = json.loads(body.decode("utf-8"))
             if payload.get("status") == "draining":
                 # a draining replica takes no work — evict it NOW, not
@@ -175,14 +185,14 @@ class FleetRouter(object):
                 # would otherwise bounce 503s off it for evict_s)
                 with self._lock:
                     view.last_ok = None
-                return False
+                return "draining"
             conn.request("GET", "/stats")
             resp = conn.getresponse()
             sbody = resp.read()
             stats = json.loads(sbody.decode("utf-8")) \
                 if resp.status == 200 else None
         except Exception:  # noqa: BLE001 — any transport failure = miss
-            return False
+            return "down"
         finally:
             conn.close()
         with self._lock:
@@ -190,19 +200,57 @@ class FleetRouter(object):
             view.last_ok = time.monotonic()
             if stats is not None:
                 view.stats = stats
-        return True
+        return "ok"
+
+    #: upper bound on the jittered pause before a probe's single retry
+    PROBE_RETRY_JITTER_S = 0.08
 
     def probe(self):
         """One full probe pass (the health loop's body; also called
         synchronously at start so the first routed request never races
-        the first heartbeat)."""
+        the first heartbeat).
+
+        A transport-level miss gets ONE retry after a jittered pause
+        before the heartbeat-age clock is allowed to advance toward
+        eviction: a single dropped packet on a loaded replica must not
+        start the eviction countdown.  The retry is for these
+        idempotent probe GETs ONLY — the fail-once stance on predict
+        forwards is unchanged (a forward is NEVER resent).  A replica
+        that reported ``draining`` is a deliberate eviction, not a
+        miss: no retry.
+
+        Retries run CONCURRENTLY with one bounded join: a few
+        black-holed hosts (each costing a full connect timeout) must
+        not stretch the pass past ``evict_s`` and age out the healthy
+        replicas that were stamped at the start of it."""
+        import random
         addrs = self._addresses()
+        misses = []
         for rid, view in self._views.items():
             view.probes += 1
             addr = addrs.get(rid)
             if addr is None:
                 continue            # no port file yet (spawning)
-            self._probe_one(view, addr)
+            if self._probe_one(view, addr) == "down":
+                misses.append((view, addr))
+        if misses:
+            def _retry(view, addr):
+                time.sleep(random.uniform(
+                    0.0, min(self.PROBE_RETRY_JITTER_S,
+                             self.heartbeat_s / 4.0)))
+                view.probe_retries += 1
+                self._probe_one(view, addr)
+
+            threads = [threading.Thread(target=_retry, args=m,
+                                        name="mxfleet-probe-retry",
+                                        daemon=True)
+                       for m in misses]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + min(self.heartbeat_s, 2.0) \
+                + self.PROBE_RETRY_JITTER_S
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
         return self.healthy()
 
     def _health_loop(self):
@@ -213,13 +261,48 @@ class FleetRouter(object):
                 pass
 
     def healthy(self):
-        """Routable replica ids: probed OK within the eviction window."""
+        """Routable replica ids: probed OK within the eviction window
+        and not fenced by a rolling swap."""
         now = time.monotonic()
         with self._lock:
             return [rid for rid in self._order
-                    if self._views[rid].last_ok is not None
+                    if rid not in self._fenced
+                    and self._views[rid].last_ok is not None
                     and now - self._views[rid].last_ok <= self.evict_s
                     and self._views[rid].addr is not None]
+
+    # -- rolling-swap fencing (fleet/deploy.py) ----------------------------
+    def fence(self, rid):
+        """Hold ``rid`` out of routing (new traffic goes elsewhere;
+        its in-flight work finishes normally).  Raises when fencing it
+        would leave NO routable replica — a rollout must never take
+        the last server away (capacity floor N-1)."""
+        now = time.monotonic()
+        with self._lock:
+            others = [r for r in self._order
+                      if r != rid and r not in self._fenced
+                      and self._views[r].last_ok is not None
+                      and now - self._views[r].last_ok <= self.evict_s
+                      and self._views[r].addr is not None]
+            if not others:
+                raise MXNetError(
+                    "fencing replica %s would leave no routable "
+                    "replica — rollout must wait (a 1-replica fleet "
+                    "swaps in place: the swap itself is drop-free)"
+                    % (rid,))
+            self._fenced.add(rid)
+        return self
+
+    def unfence(self, rid):
+        """Rejoin ``rid`` to routing (the swap finished or failed —
+        either way the replica serves a consistent epoch)."""
+        with self._lock:
+            self._fenced.discard(rid)
+        return self
+
+    def fenced(self):
+        with self._lock:
+            return sorted(self._fenced)
 
     # -- routing policy ----------------------------------------------------
     def _load(self, view, model=None):
@@ -393,15 +476,21 @@ class FleetRouter(object):
             for rid in self._order:
                 view = self._views[rid]
                 entry = {"healthy": rid in healthy,
+                         "fenced": rid in self._fenced,
                          "port": view.addr[1] if view.addr else None,
                          "inflight": view.inflight,
                          "forward_errors": view.errors,
+                         "probe_retries": view.probe_retries,
                          "heartbeat_age_s":
                              round(now - view.last_ok, 3)
                              if view.last_ok is not None else None}
                 if view.stats:
                     entry["queue_depth"] = view.stats.get("queue_depth")
                     entry["est_wait_ms"] = view.stats.get("est_wait_ms")
+                    # per-replica served epochs: the rollout-progress
+                    # signal a rolling swap advances one replica at a
+                    # time (fleet/deploy.py)
+                    entry["epochs"] = view.stats.get("epochs")
                     for k, v in (view.stats.get("counters")
                                  or {}).items():
                         fleet_counters[k] = fleet_counters.get(k, 0) + v
@@ -416,6 +505,8 @@ class FleetRouter(object):
                    "draining": self.draining}
         # fleet p50/p99 = the router's own end-to-end window
         payload["fleet"]["latency_ms"] = payload["router"]["latency_ms"]
+        if self.deploy is not None:
+            payload["rollout"] = self.deploy.stats()
         return payload
 
     def healthz_payload(self):
@@ -474,6 +565,9 @@ class FleetRouter(object):
         in-flight forwards, drain every replica through the controller,
         stop.  Idempotent."""
         self.draining = True
+        if self.deploy is not None:
+            # no rollout may fence/swap replicas the drain is stopping
+            self.deploy.stop()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:

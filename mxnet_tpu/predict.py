@@ -125,6 +125,47 @@ class Predictor(object):
         self._exec_cache[signature] = self._exec
         return self
 
+    def set_params(self, param_blob):
+        """Hot-swap the device-resident parameter VALUES in place.
+
+        ``reshape`` hands each cached executor the very NDArray objects
+        held in ``_arg_params``/``_aux_params`` (no copy — see the bind
+        above), and ``Executor.forward`` re-reads those buffers on every
+        call.  Swapping ``._data`` therefore lands the new weights in
+        EVERY cached bucket executor at once, between forwards, with no
+        re-bind and no recompile (same shapes + dtypes = the same jitted
+        program).  This is the device-level half of the serving hot-swap
+        contract (``serving/deploy.py``): an in-flight forward keeps the
+        arrays it already read, the next forward sees the new epoch.
+
+        Names must be a subset of the loaded set and shapes/dtypes must
+        match exactly — anything else is a different PROGRAM, which is a
+        restart, not a swap."""
+        params = param_blob if isinstance(param_blob, dict) \
+            else load_ndarray_file(param_blob, self._ctx)
+        new_args, new_auxs = _strip_prefix(params)
+        for cur, new, what in ((self._arg_params, new_args, "arg"),
+                               (self._aux_params, new_auxs, "aux")):
+            for name, v in new.items():
+                old = cur.get(name)
+                if old is None:
+                    raise MXNetError(
+                        "set_params: unknown %s %r (not in the bound "
+                        "parameter set)" % (what, name))
+                new_nd = v if isinstance(v, nd.NDArray) \
+                    else nd.array(np.asarray(v), ctx=self._ctx,
+                                  dtype=np.asarray(v).dtype)
+                if tuple(new_nd.shape) != tuple(old.shape) or \
+                        np.dtype(new_nd.dtype) != np.dtype(old.dtype):
+                    raise MXNetError(
+                        "set_params: %s %r is %s/%s, bound set holds "
+                        "%s/%s — a shape/dtype change needs a rebind, "
+                        "not a swap" % (what, name, new_nd.shape,
+                                        new_nd.dtype, old.shape,
+                                        old.dtype))
+                old._data = new_nd._data
+        return self
+
     def set_input(self, name, data):
         """MXPredSetInput: stage a named input for the next forward."""
         if name not in self._input_shapes:

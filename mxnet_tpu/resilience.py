@@ -59,6 +59,7 @@ __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
            "PreemptionHandler", "preempted_exit",
            "checksum_file", "checksum_bytes", "checkpoint_async",
            "snapshot_params", "submit_checkpoint", "wait_checkpoints",
+           "verify_promotion", "publish_mark",
            "TransientError", "FaultInjector", "faults", "strip_faults_env",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
@@ -1410,6 +1411,15 @@ class CheckpointManager(object):
         if step_state is not None:
             entry["step_state"] = step_state
         self._update_manifest(entry)
+        # promote-path chaos points: damage the params file AFTER the
+        # manifest vouches for it — exactly the bit-rot / torn-copy
+        # shape the digest verification (verify_promotion, restore)
+        # exists to catch.  A consumer that trusts the manifest entry
+        # without re-verifying the bytes would walk straight onto them.
+        if faults.consume("rot_checkpoint"):
+            _damage_file(self.params_path(epoch), truncate=False)
+        if faults.consume("truncate_checkpoint"):
+            _damage_file(self.params_path(epoch), truncate=True)
         _LOG.info("CheckpointManager: saved epoch %d to %s", epoch,
                   self.params_path(epoch))
 
@@ -1773,3 +1783,110 @@ class CheckpointManager(object):
             with open(self.states_path(epoch), "rb") as f:
                 states = f.read()
         return symbol, arg_params, aux_params, states, epoch
+
+
+def _damage_file(path, truncate):
+    """Deterministically damage an on-disk file (the ``rot_checkpoint``
+    / ``truncate_checkpoint`` fault points): flip one mid-file byte, or
+    cut the file to half its length.  Both leave the manifest's record
+    stale — the verification layer, not the filesystem, must catch it."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            if truncate:
+                f.truncate(max(0, size // 2))
+            else:
+                f.seek(size // 2)
+                b = f.read(1) or b"\x00"
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]))
+        _LOG.warning("fault injection: %s %r after its manifest entry "
+                     "was published",
+                     "truncated" if truncate else "rotted one byte of",
+                     path)
+    except OSError as e:  # pragma: no cover — injection plumbing only
+        _LOG.warning("fault injection: could not damage %r (%s)", path, e)
+
+
+# ---------------------------------------------------------------------------
+# the promote gate (shared by serving/deploy.py and tools/ckpt_fsck.py)
+# ---------------------------------------------------------------------------
+
+def verify_promotion(directory, epoch=None, prefix="checkpoint"):
+    """THE promote-path health check: verify every file ``epoch`` needs
+    (params, optimizer states, the shared symbol file) against the
+    manifest's recorded size + digest BEFORE anything deserializes a
+    byte.  Returns ``(epoch, problems)`` — an empty ``problems`` list
+    means the epoch is safe to load; anything else means KEEP SERVING
+    THE CURRENT EPOCH (this check never walks back: a damaged newest
+    epoch is a rejection, not an invitation to guess).
+
+    This is the ONE definition of "healthy enough to promote":
+    ``serving.deploy.CheckpointWatcher`` gates every hot swap on it,
+    ``fleet.deploy.RollingSwap`` gates every rollout on it, and
+    ``tools/ckpt_fsck.py --watch/--promote-gate`` reports with it — the
+    three must never drift on what they accept.
+
+    ``epoch=None`` checks the manifest's newest checkpoint.  An entry
+    with no integrity records (pre-integrity-layer, or a manifest
+    rebuilt by the corrupt-manifest directory scan) is REJECTED:
+    unverifiable bytes must not ride a promote path, even though
+    ``restore()`` would tolerantly load them."""
+    directory = os.fspath(directory)
+    if not os.path.isdir(directory):
+        return None, ["not a checkpoint directory: %r" % directory]
+    man = CheckpointManager(directory, prefix=prefix, keep_last=None)
+    if epoch is None:
+        epoch = man.latest()
+        if epoch is None:
+            return None, ["no checkpoint in %r" % directory]
+    epoch = int(epoch)
+    entry = man.entry(epoch)
+    if entry is None:
+        return epoch, ["epoch %d is not in the manifest" % epoch]
+    problems = []
+    files = entry.get("files") or {}
+    names = [os.path.basename(man.params_path(epoch))]
+    if entry.get("states"):
+        names.append(os.path.basename(man.states_path(epoch)))
+    for name in names:
+        if name not in files:
+            problems.append("%s: no integrity record in the manifest "
+                            "(unverifiable — not promotable)" % name)
+            continue
+        try:
+            man._verify_files(entry, [name])
+        except MXNetError as e:
+            problems.append(str(e))
+    # the symbol file is shared and vouched for by the NEWEST entry
+    # that rewrote it (see CheckpointManager._update_manifest)
+    if os.path.exists(man.symbol_path()):
+        sym_entry = man._symbol_entry()
+        if sym_entry is not None:
+            try:
+                man._verify_files(
+                    sym_entry, [os.path.basename(man.symbol_path())])
+            except MXNetError as e:
+                problems.append(str(e))
+    return epoch, problems
+
+
+def publish_mark(directory, epoch, prefix="checkpoint"):
+    """Identity of ONE manifest publish of ``epoch``: (save time,
+    sorted (file, digest, size) records), or None when the entry is
+    absent/unreadable.  The promote watchers (serving/deploy.py's
+    CheckpointWatcher, fleet/deploy.py's RollingSwap) key their
+    one-rejection-per-publish dedup on it — a REWRITTEN epoch gets a
+    new mark and re-enters verification; defining it once here keeps
+    the two watchers (and any manifest schema change) in lockstep."""
+    directory = os.fspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    entry = CheckpointManager(directory, prefix=prefix,
+                              keep_last=None).entry(int(epoch))
+    if entry is None:
+        return None
+    return (entry.get("time"),
+            tuple(sorted((name, rec.get("digest"), rec.get("size"))
+                         for name, rec in
+                         (entry.get("files") or {}).items())))

@@ -21,7 +21,10 @@ from .batcher import (BucketBatcher, DeadlineExpired, Draining, QueueFull,
                       parse_buckets, pick_bucket, pad_to_bucket)
 from .pool import ModelPool, PooledModel
 from .frontend import ServeClient, ServingFrontend, Stats
+# deploy's MXTPU_SWAP_* knobs register EAGERLY here (the PR-7 lesson)
+from .deploy import CheckpointWatcher
 
 __all__ = ["BucketBatcher", "DeadlineExpired", "Draining", "QueueFull",
            "parse_buckets", "pick_bucket", "pad_to_bucket", "ModelPool",
-           "PooledModel", "ServeClient", "ServingFrontend", "Stats"]
+           "PooledModel", "ServeClient", "ServingFrontend", "Stats",
+           "CheckpointWatcher"]

@@ -210,6 +210,10 @@ class BucketBatcher(object):
         self._inflight = 0
         self._draining = False
         self._closing = False
+        #: run_exclusive() gate: while set, the dispatcher takes no new
+        #: batch (queued requests WAIT, they are never dropped) — the
+        #: hot-swap dispatch boundary (serving/deploy.py)
+        self._paused = False
         self._ema_batch_s = None            # EMA of batch service time
         self._sample_shapes = None          # fixed by the first request
         self._thread = threading.Thread(
@@ -338,6 +342,12 @@ class BucketBatcher(object):
         with self._cv:
             while True:
                 self._expire_locked()
+                if self._paused and not self._closing:
+                    # a hot swap holds the dispatch boundary: requests
+                    # keep queueing, the next batch waits for the new
+                    # weights (a close() overrides — shutdown wins)
+                    self._cv.wait(0.05)
+                    continue
                 if not self._queue:
                     if self._closing:
                         return None
@@ -406,6 +416,45 @@ class BucketBatcher(object):
                  for o in outs])
             if self.stats is not None:
                 self.stats.record_latency((now - r.enqueued_at) * 1000.0)
+
+    def run_exclusive(self, fn, timeout=30.0):
+        """Run ``fn()`` at the DISPATCH BOUNDARY: wait for the in-flight
+        batch to finish, keep the dispatcher from taking the next one
+        while ``fn`` runs, then resume.  This is the serving hot-swap
+        point (serving/deploy.py): the in-flight batch completes on the
+        old weights, the batch after ``fn`` sees the new ones, and no
+        queued request is dropped or errored — they just wait out
+        ``fn``'s (milliseconds-scale) critical section.
+
+        Raises :class:`MXNetError` when the in-flight batch does not
+        finish within ``timeout`` (a wedged forward is the watchdog's
+        job — the swap must not pile onto it)."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cv:
+            while self._paused:     # one exclusive section at a time
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        "model %r: another exclusive section held the "
+                        "dispatch boundary for %.1fs" % (self.name,
+                                                         timeout))
+                self._cv.wait(0.05)
+            self._paused = True
+            self._cv.notify_all()
+            while self._inflight:
+                if time.monotonic() >= deadline:
+                    self._paused = False
+                    self._cv.notify_all()
+                    raise MXNetError(
+                        "model %r: in-flight batch did not finish "
+                        "within %.1fs — not swapping onto a wedged "
+                        "forward" % (self.name, timeout))
+                self._cv.wait(0.05)
+        try:
+            return fn()
+        finally:
+            with self._cv:
+                self._paused = False
+                self._cv.notify_all()
 
     # -- lifecycle ---------------------------------------------------------
     def close(self, drain=True, timeout=30.0):

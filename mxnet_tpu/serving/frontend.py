@@ -145,6 +145,9 @@ class ServingFrontend(object):
         self.stats = Stats()
         self.draining = False
         self._batchers = {}
+        #: model -> CheckpointWatcher (serving/deploy.py): created by
+        #: serve.py --watch or lazily by the /swap admin endpoint
+        self.watchers = {}
         self._lock = threading.Lock()
         self._server = None
         self._stopped = threading.Event()
@@ -186,6 +189,50 @@ class ServingFrontend(object):
         with self._lock:
             batchers = dict(self._batchers)
         return {name: b.depth for name, b in batchers.items()}
+
+    # -- continuous deployment (serving/deploy.py) -------------------------
+    def watcher(self, model, start=False, **kw):
+        """The model's :class:`~.deploy.CheckpointWatcher` (created on
+        first use; raises when the model was not loaded from a
+        checkpoint directory).  ``start=True`` begins tailing."""
+        with self._lock:
+            w = self.watchers.get(model)
+        if w is None:
+            from .deploy import CheckpointWatcher
+            w = CheckpointWatcher(self.pool, model, frontend=self, **kw)
+            with self._lock:
+                w = self.watchers.setdefault(model, w)
+        if start:
+            w.start()
+        return w
+
+    def handle_swap(self, model, epoch=None):
+        """The ``POST /swap/<model>`` admin surface: one synchronous
+        verify -> stage -> swap -> probe pass (``epoch=None`` promotes
+        the newest verified epoch).  Returns ``(status, outcome)`` —
+        200 when the model is now serving the requested/newest epoch,
+        409 when the promotion was refused (verification, validation or
+        probe), 404/503 for unknown model / draining."""
+        try:
+            self.pool.get(model)
+        except MXNetError as e:
+            return 404, {"error": str(e), "model": model}
+        if self.draining:
+            return 503, {"error": "draining", "model": model}
+        try:
+            w = self.watcher(model)
+        except MXNetError as e:   # not a checkpoint-directory model
+            return 409, {"error": str(e), "model": model}
+        # an explicit swap is an operator/rollout decision: it retries
+        # a publish the poll loop is holding after an earlier failure
+        outcome = w.check_once(epoch=epoch, force=True)
+        return (200 if outcome.get("ok") else 409), outcome
+
+    def epochs(self):
+        """{model: served epoch or None} — the rollout-progress signal
+        (/healthz + /stats; the fleet router shows it per replica)."""
+        return {name: self.pool.get(name).loaded_epoch
+                for name in self.pool.names()}
 
     # -- admission ---------------------------------------------------------
     def admit(self, model):
@@ -276,6 +323,12 @@ class ServingFrontend(object):
             for name, b in batchers.items()}
         payload["draining"] = self.draining
         payload["buckets"] = list(self.buckets)
+        payload["epochs"] = self.epochs()
+        with self._lock:
+            watchers = dict(self.watchers)
+        if watchers:
+            payload["deploy"] = {name: w.stats()
+                                 for name, w in watchers.items()}
         return payload
 
     # -- lifecycle ---------------------------------------------------------
@@ -320,7 +373,12 @@ class ServingFrontend(object):
         request, then stop the server.  Idempotent."""
         self.draining = True
         with self._lock:
+            watchers = list(self.watchers.values())
             batchers = list(self._batchers.values())
+        for w in watchers:
+            # no swap may hold the dispatch boundary while the drain
+            # waits on those same batchers
+            w.stop()
         for b in batchers:
             b.close(drain=True, timeout=timeout)
         with self._lock:
@@ -377,7 +435,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._reply(200, {
                 "status": "draining" if self.fe.draining else "ok",
-                "models": self.fe.pool.names()})
+                "models": self.fe.pool.names(),
+                "epochs": self.fe.epochs()})
         elif self.path == "/stats":
             self._reply(200, self.fe.stats_payload())
         else:
@@ -417,6 +476,21 @@ class _Handler(BaseHTTPRequestHandler):
         return inputs, self._qos(payload)
 
     def do_POST(self):
+        if self.path.startswith("/swap/"):
+            # the continuous-deployment admin surface: promote the
+            # newest verified epoch (or body {"epoch": N}) for a model
+            model = self.path[len("/swap/"):].strip("/")
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                payload = json.loads(body.decode("utf-8")) if body else {}
+                epoch = payload.get("epoch")
+            except Exception as e:  # noqa: BLE001 — malformed body
+                self._reply(400, {"error": "bad request body: %s" % (e,)})
+                return
+            status, out = self.fe.handle_swap(model, epoch=epoch)
+            self._reply(status, out)
+            return
         if not self.path.startswith("/predict/"):
             self._reply(404, {"error": "unknown path %r" % self.path})
             return
@@ -517,6 +591,15 @@ class ServeClient(object):
         return self._request(
             "POST", "/predict/%s" % model, body=body,
             headers={"Content-Type": "application/json", **qos})
+
+    def swap(self, model, epoch=None):
+        """POST /swap/<model>: promote the newest verified epoch (or a
+        specific one).  NOT idempotent-retried (it is a POST)."""
+        body = json.dumps({} if epoch is None
+                          else {"epoch": int(epoch)}).encode("utf-8")
+        return self._request(
+            "POST", "/swap/%s" % model, body=body,
+            headers={"Content-Type": "application/json"})
 
     def healthz(self):
         return self._request("GET", "/healthz")

@@ -33,6 +33,7 @@ before its first dispatch, exactly like the training-side gate.
 """
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
@@ -91,12 +92,21 @@ class _Int8Forward(object):
     shape, rows independent of fill/position/co-tenants."""
 
     def __init__(self, model):
-        import jax.numpy as jnp
         from ..executor import _build_eval
-        from .aot import dev_array
 
         self._sym = model.symbol
         self._eval = _build_eval(model.symbol)
+        self._cache = {}            # shape signature -> jitted forward
+        self.refresh(model)
+
+    def refresh(self, model):
+        """(Re-)stage ``model``'s current params on device — the int8
+        half of the hot-swap path (``PooledModel.swap_params``).  The
+        per-shape jitted cache survives a refresh: the compiled
+        programs take q/scales/plain/aux as ARGUMENTS, so same-shaped
+        new values hit the same program."""
+        import jax.numpy as jnp
+        from .aot import dev_array
         self._q, self._plain = {}, {}
         for k, v in model.arg_params.items():
             if k in model._wt_scales:
@@ -107,7 +117,7 @@ class _Int8Forward(object):
                         for k, s in model._wt_scales.items()}
         self._aux = {k: dev_array(v)
                      for k, v in model.aux_params.items()}
-        self._cache = {}            # shape signature -> jitted forward
+        return self
 
     def _build(self, shapes):
         import jax
@@ -172,6 +182,12 @@ class PooledModel(object):
         self._aot_args = None
         self.arg_params = self._cast(arg_params or {})
         self.aux_params = self._cast(aux_params or {})
+        #: checkpoint provenance: the epoch currently served (None for
+        #: in-memory models) and, for directory loads, where new epochs
+        #: appear — what CheckpointWatcher tails (serving/deploy.py)
+        self.loaded_epoch = None
+        self.source_dir = None
+        self.source_prefix = "checkpoint"
         #: {input_name: per-sample shape} once declared or first served
         self.sample_shapes = dict(sample_shapes) if sample_shapes else None
         self._pred = None
@@ -273,6 +289,91 @@ class PooledModel(object):
                      for k, s in self.sample_shapes.items()}
             self.forward(dummy)
         return self
+
+    # -- hot swap (serving/deploy.py; docs/how_to/serving.md) --------------
+    @staticmethod
+    def _param_sig(params):
+        """{name: (shape, dtype)} — the program identity of a parameter
+        set.  Two sets with equal signatures run the SAME cached
+        compiled forwards; anything else is a different program."""
+        return {k: (tuple(np.shape(v)),
+                    np.dtype(getattr(v, "dtype", np.float32)).name)
+                for k, v in params.items()}
+
+    @staticmethod
+    def _shelve(params):
+        """A rollback-safe snapshot of a param dict: NDArray values get
+        a FRESH shell around their (immutable) device buffer.  The
+        Predictor swap path mutates the BOUND NDArrays' ``_data`` in
+        place — without re-shelling, the snapshot would alias the very
+        objects the swap overwrites and rollback would restore the new
+        weights onto themselves."""
+        from ..ndarray import NDArray
+        return {k: (NDArray._from_jax(v._data)
+                    if isinstance(v, NDArray) else v)
+                for k, v in params.items()}
+
+    def swap_params(self, arg_params, aux_params=None):
+        """Hot-swap this model's device-resident weights to RAW
+        checkpoint values (the pool's dtype cast / int8 quantization is
+        re-applied here, exactly as at load).  Returns an opaque
+        snapshot of the previous weights for :meth:`restore_params`.
+
+        The caller owns the dispatch boundary: run this inside
+        :meth:`BucketBatcher.run_exclusive` (``CheckpointWatcher``
+        does) so no batch forward is in flight — the in-flight batch
+        finishes on the old weights, the next batch sees the new ones.
+
+        The parameter SET must be identical after the cast (names,
+        shapes, dtypes): every cached compiled forward — Predictor
+        executor, int8 program, AOT executable — is reused as-is, so a
+        different set is a different program: a restart, not a swap."""
+        snapshot = (self._shelve(self.arg_params),
+                    self._shelve(self.aux_params),
+                    dict(self._wt_scales))
+        prev_scales = self._wt_scales
+        self._wt_scales = {}
+        try:
+            new_args = self._cast(arg_params or {})
+            new_auxs = self._cast(aux_params or {})
+            if self._param_sig(new_args) != self._param_sig(snapshot[0]) \
+                    or self._param_sig(new_auxs) != \
+                    self._param_sig(snapshot[1]):
+                raise MXNetError(
+                    "model %r: the swapped-in parameter set does not "
+                    "match the serving set (names/shapes/dtypes) — a "
+                    "program change needs a reload, swaps only change "
+                    "weights" % self.name)
+            self._install(new_args, new_auxs, self._wt_scales)
+        except Exception:
+            self._wt_scales = prev_scales
+            raise
+        return snapshot
+
+    def restore_params(self, snapshot):
+        """Roll back to a :meth:`swap_params` snapshot (the post-swap
+        probe-failed path)."""
+        self._install(*snapshot)
+        return self
+
+    def _install(self, arg_params, aux_params, wt_scales):
+        """Point every serving path at these (already-cast) params: the
+        Predictor's bound executors in place, the int8 device stage,
+        and the AOT call-time param lists."""
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self._wt_scales = wt_scales
+        if self._pred is not None:
+            self._pred.set_params(self._blob())
+        if self._int8 is not None:
+            self._int8.refresh(self)
+        if self._aot_args is not None:
+            from .aot import dev_array
+            self._aot_args = (
+                [dev_array(self.arg_params[n])
+                 for n in sorted(self.arg_params)],
+                [dev_array(self.aux_params[n])
+                 for n in sorted(self.aux_params)])
 
     # -- AOT executable store (serving/aot.py; docs/how_to/fleet.md) -------
     def _aot_forward_for(self, shapes):
@@ -481,10 +582,12 @@ class ModelPool(object):
         ``save_checkpoint`` pair)."""
         from ..model import load_checkpoint
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
-        return self._put(PooledModel(
+        entry = self._put(PooledModel(
             name, symbol, arg_params, aux_params,
             dtype=dtype if dtype is not None else self.dtype,
             ctx=self.ctx, sample_shapes=sample_shapes))
+        entry.loaded_epoch = int(epoch)
+        return entry
 
     def load_dir(self, name, directory, epoch=None, sample_shapes=None,
                  dtype=None):
@@ -502,6 +605,8 @@ class ModelPool(object):
             dtype=dtype if dtype is not None else self.dtype,
             ctx=self.ctx, sample_shapes=sample_shapes))
         entry.loaded_epoch = ep
+        entry.source_dir = os.fspath(directory)
+        entry.source_prefix = man.prefix
         return entry
 
     def get(self, name):

@@ -442,3 +442,55 @@ def test_data_net_mode_is_known_and_aliases():
 
 def test_fleet_mode_is_known_and_in_the_pipeline_set():
     assert "fleet" in bench.KNOWN_MODES
+
+
+# ---------------------------------------------------------------------------
+# hotswap mode (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+def test_gate_keys_cover_hotswap_metrics(tmp_path):
+    """The train-to-serve seam's two contracts are gate-guarded: the
+    drop-free flag (1.0 -> 0.0 = requests died during a swap) and the
+    dispatch-boundary pause (a LATENCY — guarded through
+    LOWER_IS_BETTER_KEYS, so a RISE blocks and an improvement passes).
+    A vanished key blocks like everywhere else."""
+    for key in ("hotswap_drop_free", "hotswap_swap_ms"):
+        assert key in bench.GATE_KEYS
+    assert "hotswap_swap_ms" in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, hotswap_drop_free=1.0, hotswap_swap_ms=6.5)
+    # dropped requests during a swap -> the flag collapses -> blocked
+    new = dict(base, hotswap_drop_free=0.0)
+    rep = bench.gate(_write(tmp_path / "new.json", new),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "hotswap_drop_free"
+    # a swap pause RISING past tolerance is the latency regression
+    new = dict(base, hotswap_swap_ms=20.0)
+    rep = bench.gate(_write(tmp_path / "n2.json", new),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    reg = rep["regressions"][0]
+    assert reg["key"] == "hotswap_swap_ms" and "rise" in reg
+    # ...and an IMPROVEMENT (lower pause) must pass — the raw
+    # higher-is-better rule would have flagged exactly this
+    new = dict(base, hotswap_swap_ms=2.0)
+    rep = bench.gate(_write(tmp_path / "n3.json", new),
+                     against=_write(tmp_path / "o3.json", base))
+    assert rep["pass"], rep
+    # a vanished key blocks too
+    for gone_key in ("hotswap_drop_free", "hotswap_swap_ms"):
+        gone = {k: v for k, v in base.items() if k != gone_key}
+        rep = bench.gate(_write(tmp_path / "g.json", gone),
+                         against=_write(tmp_path / "go.json", base))
+        assert not rep["pass"]
+        assert rep["regressions"][0]["key"] == gone_key
+
+
+def test_hotswap_mode_is_known_and_in_the_pipeline_set():
+    assert "hotswap" in bench.KNOWN_MODES
+    # the full-run pipeline collects it (source-level pin, like the
+    # data-net/fleet modes): a mode that silently leaves the pipeline
+    # set stops minting its gate keys and the artifact goes blind
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '_collect("hotswap")' in src

@@ -1290,3 +1290,384 @@ def test_fleet_drill_sigkill_replica_evict_reroute_rejoin_drain(
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# train-to-serve hot-swap drills (ISSUE 13): a REAL trainer process
+# streams checkpoints into a LIVE serving daemon/fleet under traffic
+# ---------------------------------------------------------------------------
+
+HOTSWAP_TRAINER_SCRIPT = """
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.resilience import faults
+
+def make_blobs(n, d, c, seed=4):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+X, y = make_blobs(240, 32, 10)
+it = mx.io.NDArrayIter(X, y, batch_size=60)
+mod = mx.mod.Module(sym)
+mx.random.seed(7)
+
+resuming = os.environ.get("MXTPU_RESUME") == "1"
+hang_at = os.environ.get("STREAM_HANG_AT")
+if hang_at and not resuming:
+    # wedge the Nth checkpoint save AFTER its files are written but
+    # BEFORE the manifest publishes — the SIGKILL-mid-write window
+    faults.arm_hang("ckpt_write", 3600.0, after=int(hang_at))
+
+gap = float(os.environ.get("STREAM_GAP_S", "0"))
+
+def epoch_cb(epoch, sym_, args, auxs):
+    if gap:
+        time.sleep(gap)     # let the watcher see each epoch land
+
+mod.fit(it, num_epoch=int(os.environ.get("STREAM_EPOCHS", "4")),
+        kvstore="tpu", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05},
+        initializer=mx.initializer.Xavier(),
+        epoch_end_callback=epoch_cb,
+        checkpoint=os.environ["CKPT_DIR"])
+"""
+
+
+def _wait_until(cond, deadline_s, what):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _daemon_stats(port):
+    from mxnet_tpu.serving import ServeClient
+    cli = ServeClient("127.0.0.1", port, timeout=10)
+    try:
+        status, payload = cli.stats()
+        return payload if status == 200 else {}
+    except Exception:  # noqa: BLE001 — daemon busy/binding
+        return {}
+    finally:
+        cli.close()
+
+
+@pytest.mark.chaos
+def test_hotswap_drill_trainer_streams_rot_and_sigkill(tmp_path):
+    """Drills (a)+(b)+(c) of the ISSUE-13 acceptance matrix, end to
+    end on real processes:
+
+    (a) a REAL trainer process streams checkpoints into a LIVE
+        ``tools/serve.py --watch`` daemon under concurrent traffic —
+        every landed swap is drop-free and the served epoch advances;
+    (b) a ROT-INJECTED checkpoint mid-stream (rot_checkpoint: byte
+        flipped after the manifest published) is rejected by digest and
+        the pool keeps serving the previous epoch (counter asserted —
+        never a walk-forward onto bad bytes);
+    (c) the trainer is SIGKILLed MID-WRITE (wedged in the
+        files-on-disk/no-manifest window): the daemon keeps serving,
+        the watcher stays alive, and a respawned trainer resumes the
+        stream to completion.
+    """
+    import threading
+
+    from mxnet_tpu.resilience import CheckpointManager
+
+    script = tmp_path / "trainer.py"
+    script.write_text(HOTSWAP_TRAINER_SCRIPT % {"repo": REPO})
+    ckpt_dir = str(tmp_path / "stream")
+    env = dict(os.environ, CKPT_DIR=ckpt_dir, STREAM_EPOCHS="4",
+               STREAM_GAP_S="1.0", STREAM_HANG_AT="2",
+               MXTPU_FAULTS="rot_checkpoint:1@1")
+    env.pop("MXTPU_RESUME", None)
+    trainer = subprocess.Popen([sys.executable, str(script)], env=env,
+                               stdout=subprocess.DEVNULL,
+                               stderr=subprocess.PIPE, text=True)
+    daemon = None
+    try:
+        man = CheckpointManager(ckpt_dir)
+        _wait_until(lambda: man.latest() is not None, 120,
+                    "the trainer's first epoch")
+
+        port_file = str(tmp_path / "port")
+        denv = dict(os.environ, JAX_PLATFORMS="cpu",
+                    MXTPU_SWAP_POLL_S="0.15")
+        denv.pop("MXTPU_FAULTS", None)
+        daemon = subprocess.Popen(
+            [sys.executable, SERVE, "--model", "mlp=%s" % ckpt_dir,
+             "--input-shape", "data=32", "--port", "0",
+             "--port-file", port_file, "--buckets", "1,2,4",
+             "--max-wait-ms", "1", "--watch"],
+            env=denv, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True)
+        port = _wait_port_file(port_file, daemon)
+        from mxnet_tpu.serving import ServeClient
+        ServeClient("127.0.0.1", port).wait_ready(60)
+
+        results, exceptions = [], []
+        stop = threading.Event()
+
+        def traffic():
+            cli = ServeClient("127.0.0.1", port, timeout=30)
+            x = np.zeros(32, "f")
+            try:
+                while not stop.is_set():
+                    try:
+                        results.append(cli.predict("mlp", x, npy=True))
+                    except Exception as e:  # noqa: BLE001 — a DROP
+                        exceptions.append(e)
+                    time.sleep(0.01)
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=traffic) for _ in range(2)]
+        for t in threads:
+            t.start()
+        _wait_until(
+            lambda: sum(1 for s, _ in results if s == 200) >= 10, 60,
+            "baseline traffic")
+
+        # (b) the rotted epoch 2 is published and REJECTED by digest;
+        # serving stays on epoch 1 — no walk-forward onto bad bytes
+        _wait_until(lambda: (man.latest() or 0) >= 2, 90,
+                    "the rotted epoch's publish")
+
+        def _rejected():
+            dep = (_daemon_stats(port).get("deploy") or {}).get("mlp")
+            return dep and dep["rejected"] >= 1 and dep["epoch"] == 1
+        _wait_until(_rejected, 60, "the digest rejection")
+
+        # (c) the trainer is wedged MID-WRITE of epoch 3 (params file
+        # on disk, manifest not published) — SIGKILL it there
+        _wait_until(
+            lambda: os.path.exists(
+                os.path.join(ckpt_dir, "checkpoint-0003.params")), 90,
+            "the wedged epoch-3 write")
+        assert man.latest() == 2        # never published
+        assert trainer.poll() is None
+        trainer.kill()
+        trainer.wait(timeout=30)
+
+        # the pool keeps serving and the watcher stays alive
+        base = sum(1 for s, _ in results if s == 200)
+        _wait_until(
+            lambda: sum(1 for s, _ in results if s == 200) >= base + 10,
+            30, "serving to continue after the trainer died")
+        dep = (_daemon_stats(port).get("deploy") or {}).get("mlp")
+        assert dep and dep["watching"], dep
+
+        # respawn the trainer (faults stripped, resume): it walks back
+        # past the rotted epoch 2, retrains 2..4, republishes cleanly
+        renv = dict(env, MXTPU_RESUME="1")
+        renv.pop("MXTPU_FAULTS", None)
+        renv.pop("STREAM_HANG_AT", None)
+        trainer = subprocess.Popen([sys.executable, str(script)],
+                                   env=renv,
+                                   stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.PIPE, text=True)
+
+        # (a) the stream completes and the served epoch ADVANCES to 4
+        _wait_until(
+            lambda: _daemon_stats(port).get("epochs", {}).get("mlp")
+            == 4, 180, "the served epoch to reach 4")
+        rc = trainer.wait(timeout=60)
+        assert rc == 0, trainer.stderr.read()[-2000:]
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # ZERO dropped/errored requests across every swap, rejection,
+        # trainer death and respawn
+        assert not exceptions, "dropped responses: %r" % exceptions[:3]
+        bad = [(s, p) for s, p in results if s != 200]
+        assert not bad, "non-200 responses during the stream: %r" \
+            % bad[:3]
+        dep = (_daemon_stats(port).get("deploy") or {}).get("mlp")
+        assert dep["promoted"] >= 1          # swaps really landed
+        assert dep["rejected"] >= 1          # the rot really rejected
+        assert dep["epoch"] == 4
+    finally:
+        for proc in (trainer, daemon):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+@pytest.mark.chaos
+def test_hotswap_drill_fleet_rolling_swap(tmp_path):
+    """Drill (d): a rolling swap across 2 REAL replicas keeps >= 1
+    replica serving at every instant (the fence takes one replica at a
+    time), the router's /stats shows per-replica epochs advancing, and
+    a BAD epoch (NaN weights — digest-clean, validation-fatal) halts
+    the rollout with every replica still on the old epoch."""
+    import threading
+
+    from mxnet_tpu.resilience import CheckpointManager
+    from mxnet_tpu.serving import ServeClient
+
+    sym = mlp_sym(num_classes=10, nh=32)
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 32))
+
+    def params(seed, poison=False):
+        rs = np.random.RandomState(seed)
+        out = {}
+        for n, s in zip(sym.list_arguments(), arg_shapes):
+            if n in ("data", "softmax_label"):
+                continue
+            v = rs.uniform(-0.3, 0.3, s).astype("f")
+            out[n] = mx.nd.array(v)
+        if poison:
+            out["fc2_weight"] = mx.nd.array(
+                np.full(out["fc2_weight"].shape, np.nan, "f"))
+        return out
+
+    ckpt_dir = str(tmp_path / "stream")
+    man = CheckpointManager(ckpt_dir)
+    man.save(1, symbol=sym, arg_params=params(1), aux_params={},
+             blocking=True)
+
+    run_dir = str(tmp_path / "run")
+    port_file = str(tmp_path / "port")
+    env = dict(os.environ,
+               MXTPU_FLEET_HEARTBEAT_S="0.3",
+               MXTPU_FLEET_EVICT_S="1.5",
+               MXTPU_SERVE_MAX_WAIT_MS="1",
+               MXTPU_SWAP_POLL_S="0.2")
+    proc = subprocess.Popen(
+        [sys.executable, FLEET, "serve",
+         "--model", "mlp=%s" % ckpt_dir,
+         "--input-shape", "mlp:data=32", "--replicas", "2",
+         "--device-sets", "cpu", "--buckets", "1,2,4",
+         "--run-dir", run_dir, "--port", "0",
+         "--port-file", port_file, "--watch"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = _wait_port_file(port_file, proc, deadline_s=300)
+        results, exceptions = [], []
+        min_healthy = [99]
+        stop = threading.Event()
+
+        def traffic():
+            cli = ServeClient("127.0.0.1", port, timeout=30)
+            x = np.zeros(32, "f")
+            try:
+                while not stop.is_set():
+                    try:
+                        results.append(cli.predict("mlp", x, npy=True))
+                    except Exception as e:  # noqa: BLE001 — a DROP
+                        exceptions.append(e)
+                    time.sleep(0.01)
+            finally:
+                cli.close()
+
+        def capacity_sampler():
+            cli = ServeClient("127.0.0.1", port, timeout=10)
+            try:
+                while not stop.is_set():
+                    try:
+                        status, h = cli.healthz()
+                        if status == 200:
+                            min_healthy[0] = min(
+                                min_healthy[0],
+                                h["replicas_healthy"])
+                    except Exception:  # noqa: BLE001 — poll only
+                        cli.close()
+                    time.sleep(0.05)
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=traffic) for _ in range(2)]
+        threads.append(threading.Thread(target=capacity_sampler))
+        for t in threads:
+            t.start()
+        _wait_until(
+            lambda: sum(1 for s, _ in results if s == 200) >= 20, 60,
+            "fleet baseline traffic")
+
+        cli = ServeClient("127.0.0.1", port, timeout=10)
+
+        def _replica_epochs():
+            try:
+                status, stats = cli.stats()
+            except Exception:  # noqa: BLE001 — poll only
+                return {}
+            if status != 200:
+                return {}
+            return {rid: (rep.get("epochs") or {}).get("mlp")
+                    for rid, rep in (stats.get("replicas")
+                                     or {}).items()}
+
+        # -- the rolling swap: both replicas advance, one at a time --
+        man.save(2, symbol=sym, arg_params=params(2), aux_params={},
+                 blocking=True)
+        _wait_until(
+            lambda: set(_replica_epochs().values()) == {2}, 120,
+            "both replicas to serve epoch 2")
+        status, stats = cli.stats()
+        assert stats["rollout"]["state"]["state"] == "complete"
+        assert stats["rollout"]["state"]["epoch"] == 2
+
+        # -- the BAD epoch: digest-clean NaN weights; every replica's
+        # own validation refuses it and the rollout HALTS
+        man.save(3, symbol=sym,
+                 arg_params=params(3, poison=True), aux_params={},
+                 blocking=True)
+
+        def _halted():
+            try:
+                status, stats = cli.stats()
+            except Exception:  # noqa: BLE001 — poll only
+                return None
+            if status != 200:
+                return None
+            roll = stats.get("rollout") or {}
+            return roll.get("state", {}).get("state") == "halted" \
+                and stats
+        stats = _wait_until(_halted, 120, "the rollout to halt")
+        # every replica is UNTOUCHED on the old epoch
+        assert set(_replica_epochs().values()) == {2}, \
+            _replica_epochs()
+        assert stats["rollout"]["halted"] >= 1
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        cli.close()
+
+        # capacity never dropped below N-1 = 1, and no request was
+        # dropped or errored across both rollouts
+        assert min_healthy[0] >= 1, min_healthy
+        assert not exceptions, "dropped responses: %r" % exceptions[:3]
+        bad = [(s, p) for s, p in results if s != 200]
+        assert not bad, "non-200s during the rolling swap: %r" % bad[:3]
+
+        # -- fleet-wide SIGTERM: clean drain ------------------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        stderr = proc.stderr.read()
+        assert rc == 0, stderr[-3000:]
+        assert "replica exit codes {0: 0, 1: 0}" in stderr
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)

@@ -109,6 +109,14 @@ class _FakeReplica(object):
         self.est_wait = {}
         self.counters = {"completed": 0, "shed_queue": 0}
         self.draining = False
+        #: drop (no response, closed socket) the next N /healthz
+        #: probes — the single-dropped-packet shape the probe retry
+        #: exists for
+        self.fail_healthz = 0
+        #: {model: epoch} reported on /healthz + /stats; /swap/<model>
+        #: advances it (or refuses when swap_refuse is set)
+        self.epochs = {}
+        self.swap_refuse = False
         self._lock = threading.Lock()
         self._server = None
         self._thread = None
@@ -134,13 +142,23 @@ class _FakeReplica(object):
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    with fake._lock:
+                        drop = fake.fail_healthz > 0
+                        if drop:
+                            fake.fail_healthz -= 1
+                    if drop:
+                        # a dropped packet: no status line, dead socket
+                        self.close_connection = True
+                        return
                     self._reply(200, {
-                        "status": "draining" if fake.draining else "ok"})
+                        "status": "draining" if fake.draining else "ok",
+                        "epochs": dict(fake.epochs)})
                 elif self.path == "/stats":
                     with fake._lock:
                         self._reply(200, {
                             "queue_depth": dict(fake.depths),
                             "est_wait_ms": dict(fake.est_wait),
+                            "epochs": dict(fake.epochs),
                             "counters": dict(fake.counters)})
                 else:
                     self._reply(404, {})
@@ -150,6 +168,20 @@ class _FakeReplica(object):
                 body = self.rfile.read(length)
                 with fake._lock:
                     fake.received.append((self.path, body))
+                if self.path.startswith("/swap/"):
+                    model = self.path[len("/swap/"):]
+                    if fake.swap_refuse:
+                        self._reply(409, {"ok": False,
+                                          "action": "rejected",
+                                          "problems": ["refused"]})
+                        return
+                    epoch = json.loads(body.decode()).get("epoch")
+                    with fake._lock:
+                        fake.epochs[model] = epoch
+                    self._reply(200, {"ok": True, "action": "promoted",
+                                      "epoch": epoch})
+                    return
+                with fake._lock:
                     fake.counters["completed"] += 1
                 self._reply(200, {"fake": fake.port,
                                   "path": self.path})
@@ -559,3 +591,193 @@ def test_fleet_cli_never_imports_jax(tmp_path):
     assert res.returncode == 1
     assert "fleet CLI must not import jax" in res.stderr
     assert "fleet: error: warm-store build failed" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# health-probe retry + rolling-swap fencing (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_probe_retry_heals_single_dropped_healthz(two_fakes):
+    """One dropped /healthz on a loaded replica must not advance the
+    heartbeat-age clock toward eviction: the probe retries ONCE (with
+    jitter) inside the same pass and the replica stays routable.  The
+    retry is for idempotent probe GETs only — no POST was ever sent."""
+    router = _mk_router(two_fakes, evict_s=10.0)
+    router.probe()
+    assert router.healthy() == [0, 1]
+    posts_before = len([p for p, _ in two_fakes[0].received
+                        if p.startswith("/predict")])
+    two_fakes[0].fail_healthz = 1
+    router.probe()
+    # the retry healed it in the SAME pass: still routable, fresh clock
+    assert router.healthy() == [0, 1]
+    assert router._views[0].probe_retries == 1
+    assert router._views[0].last_ok is not None
+    assert time.monotonic() - router._views[0].last_ok < 1.0
+    # ...and nothing non-idempotent was replayed
+    posts_after = len([p for p, _ in two_fakes[0].received
+                       if p.startswith("/predict")])
+    assert posts_after == posts_before
+    # a replica that is REALLY down fails both tries and ages out
+    two_fakes[0].fail_healthz = 99
+    last_ok = router._views[0].last_ok
+    router.probe()
+    assert router._views[0].last_ok == last_ok  # clock did not advance
+    assert router._views[0].probe_retries == 2
+
+
+def test_probe_retry_does_not_resurrect_draining_replica(two_fakes):
+    """'draining' is a deliberate self-fence, not a dropped packet: no
+    retry, immediate eviction (the rolling-restart stance)."""
+    router = _mk_router(two_fakes)
+    router.probe()
+    retries_before = router._views[0].probe_retries
+    two_fakes[0].draining = True
+    router.probe()
+    assert router.healthy() == [1]
+    assert router._views[0].probe_retries == retries_before
+
+
+def test_fence_unfence_and_capacity_floor(two_fakes):
+    """fence() holds a replica out of routing (its model's traffic
+    reroutes), unfence() rejoins it — and fencing can never take the
+    LAST routable replica (the N-1 capacity floor)."""
+    router = _mk_router(two_fakes)
+    router.probe()
+    home_a = router.manifest.home("a") % 2
+    router.fence(home_a)
+    assert router.healthy() == [1 - home_a]
+    rid, reason = router.route("a")
+    assert rid == 1 - home_a and reason == "rerouted"
+    with pytest.raises(MXNetError, match="no routable"):
+        router.fence(1 - home_a)
+    router.unfence(home_a)
+    assert router.healthy() == [0, 1]
+    assert router.route("a") == (home_a, None)
+    # the per-replica table shows the fence while it holds
+    router.fence(0)
+    assert router.stats_payload()["replicas"][0]["fenced"]
+    router.unfence(0)
+
+
+def _publish_epoch(directory, epoch, payload):
+    """A manifest entry with REAL digests, no jax: exactly the files
+    verify_promotion checks (RollingSwap never deserializes weights —
+    the replicas do, each behind its own watcher)."""
+    from mxnet_tpu.resilience import atomic_write, checksum_file
+    os.makedirs(directory, exist_ok=True)
+    name = "checkpoint-%04d.params" % epoch
+    path = os.path.join(directory, name)
+    atomic_write(path, payload)
+    size, digest = checksum_file(path)
+    mpath = os.path.join(directory, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {"prefix": "checkpoint", "checkpoints": []}
+    entries = [e for e in manifest["checkpoints"]
+               if e["epoch"] != epoch]
+    entries.append({"epoch": epoch, "params": name, "states": None,
+                    "checksum": "sha256", "time": time.time(),
+                    "files": {name: {"size": size, "digest": digest}}})
+    manifest["checkpoints"] = sorted(entries,
+                                     key=lambda e: e["epoch"])
+    atomic_write(mpath, json.dumps(manifest))
+
+
+def test_rolling_swap_rolls_one_replica_at_a_time(two_fakes, tmp_path):
+    """The fleet tier: a verified new epoch rolls fence -> swap ->
+    probe -> rejoin across the replicas; when done every replica
+    serves it, nothing stays fenced, and /stats shows the rollout."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_epoch(ckpt, 1, b"epoch-one-bytes")
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, poll_s=0.05,
+                       log=lambda m: None)
+    assert router.deploy is roll
+    assert roll.check_once() == {"a": "current"}
+
+    _publish_epoch(ckpt, 2, b"epoch-two-bytes")
+    assert roll.check_once() == {"a": "complete"}
+    assert two_fakes[0].epochs["a"] == 2
+    assert two_fakes[1].epochs["a"] == 2
+    assert router.fenced() == []
+    stats = router.stats_payload()
+    assert stats["rollout"]["state"]["state"] == "complete"
+    assert stats["rollout"]["state"]["epoch"] == 2
+    # each replica got exactly ONE /swap POST
+    for f in two_fakes:
+        swaps = [p for p, _ in f.received if p.startswith("/swap/")]
+        assert swaps == ["/swap/a"]
+
+
+def test_rolling_swap_rejects_damaged_epoch_before_any_replica(
+        two_fakes, tmp_path):
+    """A publish the verifier refuses never even starts a rollout: no
+    replica sees a /swap, the fleet stays on the old epoch, and the
+    same bad publish is counted once."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_epoch(ckpt, 1, b"epoch-one")
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, log=lambda m: None)
+    _publish_epoch(ckpt, 2, b"epoch-two")
+    # rot AFTER publish: flip a byte under the recorded digest
+    p2 = os.path.join(ckpt, "checkpoint-0002.params")
+    blob = bytearray(open(p2, "rb").read())
+    blob[3] ^= 0xFF
+    open(p2, "wb").write(bytes(blob))
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.counters["rejected"] == 1      # counted once
+    for f in two_fakes:
+        assert not [p for p, _ in f.received
+                    if p.startswith("/swap/")]
+        assert f.epochs["a"] == 1
+
+
+def test_rolling_swap_halts_when_a_replica_refuses(two_fakes,
+                                                   tmp_path):
+    """A replica that refuses the epoch (its own verify/validate/probe
+    said no) HALTS the rollout right there: later replicas are never
+    asked, keep the old epoch, and the fleet keeps serving — most of
+    the fleet is untouched by a bad epoch."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_epoch(ckpt, 1, b"epoch-one")
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, log=lambda m: None)
+    two_fakes[0].swap_refuse = True
+    _publish_epoch(ckpt, 2, b"epoch-two")
+    assert roll.check_once() == {"a": "halted"}
+    assert roll.counters["halted"] == 1
+    # replica 0 refused and stayed put; replica 1 was NEVER asked
+    assert two_fakes[0].epochs["a"] == 1
+    assert two_fakes[1].epochs["a"] == 1
+    assert not [p for p, _ in two_fakes[1].received
+                if p.startswith("/swap/")]
+    # nothing left fenced; the fleet still routes
+    assert router.fenced() == []
+    assert router.healthy() == [0, 1]
+    st = router.stats_payload()["rollout"]["state"]
+    assert st["state"] == "halted" and st["epoch"] == 2
+    # the failed publish is held, not retried forever...
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.counters["halted"] == 1
+    # ...but a REWRITTEN epoch re-enters and completes
+    two_fakes[0].swap_refuse = False
+    _publish_epoch(ckpt, 2, b"epoch-two-rewritten")
+    assert roll.check_once() == {"a": "complete"}
+    assert two_fakes[0].epochs["a"] == 2
+    assert two_fakes[1].epochs["a"] == 2

@@ -1144,3 +1144,156 @@ def test_bench_main_emits_partial_json_on_timeouts(monkeypatch, capsys):
     assert "resnet152_img_s" not in result
     assert result["inception_bn_img_s"] == 100.0
     assert result["lstm_tok_s"] == 100.0
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager recovery corners, directly on the manager (ISSUE 13
+# satellite — these paths were only exercised through the pool before)
+# ---------------------------------------------------------------------------
+
+def test_manager_manifest_lists_deleted_epoch(tmp_path):
+    """An epoch the manifest still lists but whose params file is gone
+    (operator rm, partial restore of a backup) silently drops out of
+    checkpoints()/latest() — it is not restorable and must not be
+    advertised; restore() lands on the newest epoch that exists."""
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2, 3):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    os.remove(str(tmp_path / "checkpoint-0003.params"))
+    man2 = CheckpointManager(str(tmp_path))
+    assert man2.checkpoints() == [1, 2]
+    assert man2.latest() == 2
+    _, args, _, _, epoch = man2.restore()
+    assert epoch == 2 and np.allclose(args["w"].asnumpy(), 2.0)
+    # the deleted epoch is still in the manifest (nothing rewrote it)
+    # but entry() exposes it for forensics without latest() lying
+    assert man2.entry(3) is not None
+
+
+def test_manager_digest_mismatch_entry_walked_past(tmp_path):
+    """Same-size bit rot (the flavor only digests catch): latest()
+    still names the rotted epoch — existence is its contract — but the
+    default restore() walks back past it, and verify_promotion refuses
+    it outright (the promote path never walks anywhere)."""
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    for epoch in (1, 2):
+        man.save(epoch, None,
+                 {"w": mx.nd.array(np.full((2,), epoch, "f"))}, {})
+    _flip_payload_byte(str(tmp_path / "checkpoint-0002.params"), 2)
+    assert man.latest() == 2
+    _, args, _, _, epoch = man.restore()
+    assert epoch == 1 and np.allclose(args["w"].asnumpy(), 1.0)
+    got_epoch, problems = verify_promotion(str(tmp_path))
+    assert got_epoch == 2 and problems, problems
+    assert "fails verification" in problems[0]
+
+
+def test_manager_scan_rebuild_entries_not_promotable(tmp_path):
+    """A manifest rebuilt by the corrupt-manifest directory scan has no
+    integrity records: restore() tolerates that (legacy stance), the
+    promote gate must NOT — unverifiable bytes never ride a hot swap."""
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((2,), "f"))}, {})
+    (tmp_path / "manifest.json").write_text("{ torn")
+    man2 = CheckpointManager(str(tmp_path))
+    assert man2.checkpoints() == [1]          # the scan recovered it
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 1 and problems
+    assert "no integrity record" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# the promote-path verifier + rot/truncate fault points (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_verify_promotion_clean_and_damaged(tmp_path):
+    from mxnet_tpu.resilience import verify_promotion
+    assert verify_promotion(str(tmp_path / "nope"))[0] is None
+    man = CheckpointManager(str(tmp_path))
+    assert verify_promotion(str(tmp_path))[0] is None   # empty dir
+    man.save(1, mlp_sym(), {"w": mx.nd.array(np.ones((2,), "f"))}, {},
+             optimizer_states=b"opt")
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert (epoch, problems) == (1, [])
+    epoch, problems = verify_promotion(str(tmp_path), epoch=9)
+    assert epoch == 9 and "not in the manifest" in problems[0]
+    # states rot is caught too — the verifier covers every recorded file
+    sp = tmp_path / "checkpoint-0001.states"
+    sp.write_bytes(b"opX")
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 1 and problems
+    # ...and symbol rot (shared file, newest entry vouches)
+    sp.write_bytes(b"opt")
+    assert verify_promotion(str(tmp_path)) == (1, [])
+    sym_path = tmp_path / "checkpoint-symbol.json"
+    sym_path.write_text(sym_path.read_text() + " ")
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 1 and problems
+
+
+def test_rot_and_truncate_fault_points_fire_after_manifest(
+        tmp_path, clean_faults):
+    """The promote-path fault points damage the params file AFTER its
+    manifest entry is published: the manifest looks healthy, the bytes
+    are not — exactly what the digest layer must catch."""
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((4,), "f"))}, {})
+    clean_faults.arm("rot_checkpoint")
+    man.save(2, None, {"w": mx.nd.array(np.full((4,), 2.0, "f"))}, {})
+    # the manifest LISTS epoch 2 (published before the damage) ...
+    assert man.latest() == 2
+    # ... same size on disk (a flip, not a truncation) ...
+    rec = man.entry(2)["files"]["checkpoint-0002.params"]
+    assert os.path.getsize(str(tmp_path / "checkpoint-0002.params")) \
+        == rec["size"]
+    # ... and the digest refuses it
+    _, problems = verify_promotion(str(tmp_path))
+    assert problems and "fails verification" in problems[0]
+
+    clean_faults.arm("truncate_checkpoint")
+    man.save(3, None, {"w": mx.nd.array(np.full((4,), 3.0, "f"))}, {})
+    assert os.path.getsize(str(tmp_path / "checkpoint-0003.params")) \
+        < man.entry(3)["files"]["checkpoint-0003.params"]["size"]
+    _, problems = verify_promotion(str(tmp_path))
+    assert problems
+    # restore() still works: it walks back to the intact epoch 1
+    _, args, _, _, epoch = man.restore()
+    assert epoch == 1
+
+
+def test_fsck_promote_gate_and_watch_share_the_verifier(tmp_path,
+                                                        clean_faults):
+    """tools/ckpt_fsck.py --promote-gate/--watch run resilience.
+    verify_promotion itself (imported through the synthetic-package
+    stub): clean epoch -> rc 0 / PROMOTABLE, rot-injected epoch ->
+    rc 1 / REJECTED — byte-for-byte the watcher's verdict."""
+    import json as _json
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    man.save(1, None, {"w": mx.nd.array(np.ones((4,), "f"))}, {})
+    res = _run_fsck(tmp_path, "--promote-gate")
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = _json.loads(res.stdout)
+    assert doc["promotable"] and doc["epoch"] == 1
+
+    clean_faults.arm("rot_checkpoint")
+    man.save(2, None, {"w": mx.nd.array(np.full((4,), 2.0, "f"))}, {})
+    res = _run_fsck(tmp_path, "--promote-gate")
+    assert res.returncode == 1
+    doc = _json.loads(res.stdout)
+    assert not doc["promotable"] and doc["epoch"] == 2
+    # the CLI's problems are the in-process verifier's, verbatim
+    _, problems = verify_promotion(str(tmp_path))
+    assert doc["problems"] == problems
+    # --epoch targets a specific (here: still-intact) epoch
+    res = _run_fsck(tmp_path, "--promote-gate", "--epoch", "1")
+    assert res.returncode == 0
+
+    res = _run_fsck(tmp_path, "--watch", "--watch-count", "1",
+                    "--poll", "0.05")
+    assert res.returncode == 1
+    assert "epoch 2 REJECTED" in res.stdout

@@ -1219,3 +1219,324 @@ def test_sustained_high_priority_cannot_starve_low():
         assert 3 <= state["low_seen_at"] < 150, state
     finally:
         b.close()
+
+
+# ---------------------------------------------------------------------------
+# train-to-serve hot swap (serving/deploy.py — ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _ckpt_stream(tmp_path, sym=None, sample=(32,), seed0=1):
+    """A CheckpointManager dir + an epoch-writer: save(epoch) writes
+    params seeded per epoch (so every epoch's weights differ)."""
+    from mxnet_tpu.resilience import CheckpointManager
+    sym = sym if sym is not None else mlp_sym()
+    man = CheckpointManager(str(tmp_path / "stream"))
+
+    def save(epoch, args=None, auxs=None):
+        if args is None:
+            args, auxs = init_params(sym, (1,) + tuple(sample),
+                                     seed=seed0 + epoch)
+        man.save(epoch, symbol=sym, arg_params=args,
+                 aux_params=auxs or {}, blocking=True)
+        return man
+
+    save(1)
+    return man, sym, save
+
+
+def _watched_pool(tmp_path, **kw):
+    from mxnet_tpu.serving.deploy import CheckpointWatcher
+    man, sym, save = _ckpt_stream(tmp_path)
+    pool = ModelPool()
+    entry = pool.load_dir("m", man.directory,
+                          sample_shapes={"data": (32,)}, **kw)
+    watcher = CheckpointWatcher(pool, "m")
+    return man, sym, save, pool, entry, watcher
+
+
+def test_hot_swap_bit_exactness_unchanged_and_swapped(tmp_path):
+    """THE bit-exactness contract: (1) a model whose weights did NOT
+    change serves bitwise-identical outputs across another model's
+    swap; (2) the swapped model serves outputs bitwise equal to a
+    fresh pool loaded directly from the new checkpoint — the swap
+    installs the new epoch's exact bytes."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    # a second, UNTOUCHED model in the same pool
+    args_b, auxs_b = init_params(mlp_sym(nh=24), (1, 32), seed=77)
+    pool.add("bystander", mlp_sym(nh=24), args_b, auxs_b,
+             sample_shapes={"data": (32,)})
+    x = {"data": np.random.RandomState(3).rand(4, 32).astype("f")}
+    before_b = pool.get("bystander").forward(dict(x))
+    assert watcher.check_once()["action"] == "current"
+
+    save(2)
+    out = watcher.check_once()
+    assert out["ok"] and out["action"] == "promoted", out
+    assert entry.loaded_epoch == 2
+
+    after_b = pool.get("bystander").forward(dict(x))
+    for a, b in zip(before_b, after_b):
+        assert np.array_equal(a, b), "bystander's bytes moved"
+
+    swapped = entry.forward(dict(x))
+    fresh_pool = ModelPool()
+    fresh = fresh_pool.load_dir("m", man.directory,
+                                sample_shapes={"data": (32,)})
+    assert fresh.loaded_epoch == 2
+    fresh_out = fresh.forward(dict(x))
+    for a, b in zip(swapped, fresh_out):
+        assert np.array_equal(a, b), "swap != fresh load of the epoch"
+
+
+def test_hot_swap_rejects_rot_keeps_serving_then_walks_past(
+        tmp_path, clean_faults):
+    """A rot-injected epoch (byte flipped AFTER the manifest vouched —
+    the rot_checkpoint fault point) is rejected by digest BEFORE any
+    read: the counter moves, serving stays bitwise on the old epoch,
+    the same bad publish is not re-counted every poll, and a later
+    clean epoch promotes right past it."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    x = {"data": np.random.RandomState(5).rand(2, 32).astype("f")}
+    before = entry.forward(dict(x))
+
+    clean_faults.arm("rot_checkpoint")
+    save(2)
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "rejected", out
+    assert out["target"] == 2 and out["epoch"] == 1
+    assert watcher.counters["rejected"] == 1
+    assert entry.loaded_epoch == 1
+    after = entry.forward(dict(x))
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b), "a rejected epoch changed serving"
+    # an unchanged bad publish is one rejection, not one per poll
+    out = watcher.check_once()
+    assert out["action"] == "rejected" and out.get("already_counted")
+    assert watcher.counters["rejected"] == 1
+
+    save(3)
+    out = watcher.check_once()
+    assert out["ok"] and out["action"] == "promoted" and \
+        out["epoch"] == 3, out
+
+
+def test_hot_swap_truncate_fault_rejected(tmp_path, clean_faults):
+    """The truncate_checkpoint flavor: a half-length params file under
+    an intact manifest entry is a size+digest mismatch, same verdict."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    clean_faults.arm("truncate_checkpoint")
+    save(2)
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "rejected"
+    assert entry.loaded_epoch == 1
+
+
+def test_hot_swap_validation_rejects_nan_and_wrong_graph(tmp_path):
+    """Digest-clean but BROKEN epochs die in staged validation, off the
+    serving path: NaN weights (non-finite validation forward) and a
+    different graph (param-set digest mismatch) both leave serving
+    untouched."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    # NaN weights: digests verify (the manifest recorded the NaN bytes)
+    args, auxs = init_params(sym, (1, 32), seed=9)
+    name = next(iter(args))
+    args[name] = mx.nd.array(np.full(args[name].shape, np.nan, "f"))
+    save(2, args=args, auxs=auxs)
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "validation_failed", out
+    assert watcher.counters["validation_failures"] == 1
+    assert entry.loaded_epoch == 1
+    # a failed publish is HELD, not re-staged every poll
+    out = watcher.check_once()
+    assert out["action"] == "held" and \
+        watcher.counters["validation_failures"] == 1
+
+    # different graph: the param set no longer matches the program
+    other = mlp_sym(nh=48)
+    o_args, o_auxs = init_params(other, (1, 32), seed=10)
+    from mxnet_tpu.resilience import CheckpointManager
+    man2 = CheckpointManager(man.directory)
+    man2.save(3, symbol=other, arg_params=o_args, aux_params={},
+              blocking=True)
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "validation_failed", out
+    assert entry.loaded_epoch == 1
+
+
+def test_hot_swap_probe_failure_rolls_back_bitwise(tmp_path,
+                                                   clean_faults):
+    """A post-swap probe failure (swap_probe fault point) restores the
+    PREVIOUS weights before any request can see the new ones — and the
+    restore is bitwise, not approximate."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    x = {"data": np.random.RandomState(7).rand(2, 32).astype("f")}
+    before = entry.forward(dict(x))
+    clean_faults.arm("swap_probe")
+    save(2)
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "rolled_back", out
+    assert watcher.counters["rolled_back"] == 1
+    assert entry.loaded_epoch == 1
+    after = entry.forward(dict(x))
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b), "rollback is not bitwise"
+    # the failed publish is HELD by the poll loop...
+    out = watcher.check_once()
+    assert out["action"] == "held", out
+    # ...but an explicit retry (what POST /swap sends: force=True, no
+    # epoch needed) re-attempts it — and the fault is spent, so it
+    # promotes
+    out = watcher.check_once(force=True)
+    assert out["ok"] and out["action"] == "promoted", out
+
+
+def test_hot_swap_at_dispatch_boundary_under_traffic(tmp_path):
+    """run_exclusive IS the dispatch boundary: a batch in flight when
+    the swap lands finishes on the OLD weights, the next batch runs on
+    the NEW ones, and no queued request is dropped or errored."""
+    import threading
+
+    sym = mlp_sym()
+    args1, auxs1 = init_params(sym, (1, 32), seed=1)
+    args2, auxs2 = init_params(sym, (1, 32), seed=2)
+    pool = ModelPool()
+    entry = pool.add("m", sym, args1, auxs1,
+                     sample_shapes={"data": (32,)})
+    entered = threading.Event()
+    release = threading.Event()
+
+    def runner(inputs, n):
+        entered.set()
+        assert release.wait(30)
+        entered.clear()
+        release.clear()
+        return entry.forward(inputs, n)
+
+    b = BucketBatcher(runner, buckets=(1, 2), max_wait_ms=0, name="m")
+    try:
+        x = np.random.RandomState(0).rand(32).astype("f")
+        ref1 = ref_predictor(sym, args1, auxs1, (1, 32)).forward(
+            data=x[None]).get_output(0)[0]
+        ref2 = ref_predictor(sym, args2, auxs2, (1, 32)).forward(
+            data=x[None]).get_output(0)[0]
+
+        fut1 = b.submit({"data": x})
+        assert entered.wait(10)          # batch 1 is IN FLIGHT
+        swapped = threading.Event()
+
+        def do_swap():
+            b.run_exclusive(lambda: entry.swap_params(args2, auxs2))
+            swapped.set()
+
+        t = threading.Thread(target=do_swap)
+        t.start()
+        fut2 = b.submit({"data": x})     # queued behind the swap
+        time.sleep(0.2)
+        assert not swapped.is_set(), "swap jumped the in-flight batch"
+        release.set()                    # let batch 1 finish
+        t.join(timeout=30)
+        assert swapped.is_set()
+        out1 = fut1.result(timeout=30)[0]
+        assert entered.wait(10)
+        release.set()
+        out2 = fut2.result(timeout=30)[0]
+        assert np.array_equal(out1, ref1), \
+            "in-flight batch did not finish on the old weights"
+        assert np.array_equal(out2, ref2), \
+            "post-swap batch did not run on the new weights"
+    finally:
+        release.set()
+        b.close(drain=False, timeout=5)
+
+
+def test_hot_swap_int8_and_bf16_pools(tmp_path):
+    """The swap composes with the cast/quantized serving paths: the
+    new epoch's weights go through the SAME cast the load path applies,
+    and the swapped pool equals a fresh pool loaded from the new
+    checkpoint — bitwise, per dtype path."""
+    for dtype in ("bfloat16", "int8"):
+        man, sym, save = _ckpt_stream(tmp_path / dtype)
+        pool = ModelPool(dtype=dtype)
+        entry = pool.load_dir("m", man.directory,
+                              sample_shapes={"data": (32,)})
+        x = {"data": np.random.RandomState(11).rand(2, 32).astype("f")}
+        entry.forward(dict(x))           # compile the serving path
+        save(2)
+        from mxnet_tpu.serving.deploy import CheckpointWatcher
+        out = CheckpointWatcher(pool, "m").check_once()
+        assert out["ok"], (dtype, out)
+        swapped = entry.forward(dict(x))
+        fresh = ModelPool(dtype=dtype).load_dir(
+            "m", man.directory, sample_shapes={"data": (32,)})
+        fresh_out = fresh.forward(dict(x))
+        for a, c in zip(swapped, fresh_out):
+            assert np.array_equal(a, c), dtype
+
+
+def test_hot_swap_frontend_endpoint_and_epoch_reporting(tmp_path):
+    """The /swap admin surface + epoch observability, in process: 404
+    unknown model, 409 for a non-directory model, 200 current/promoted,
+    409 rejected; /stats carries epochs + the deploy block."""
+    man, sym, save = _ckpt_stream(tmp_path)
+    pool = ModelPool()
+    pool.load_dir("m", man.directory, sample_shapes={"data": (32,)})
+    args, auxs = init_params(sym, (1, 32), seed=50)
+    pool.add("inmem", sym, args, auxs, sample_shapes={"data": (32,)})
+    fe = ServingFrontend(pool, buckets=(1, 2))
+
+    status, _ = fe.handle_swap("nope")
+    assert status == 404
+    status, out = fe.handle_swap("inmem")
+    assert status == 409, out            # no checkpoint dir to watch
+    status, out = fe.handle_swap("m")
+    assert status == 200 and out["action"] == "current"
+    save(2)
+    status, out = fe.handle_swap("m")
+    assert status == 200 and out["action"] == "promoted", out
+    payload = fe.stats_payload()
+    assert payload["epochs"]["m"] == 2
+    assert payload["deploy"]["m"]["promoted"] == 1
+    from mxnet_tpu.resilience import faults
+    try:
+        faults.arm("rot_checkpoint")
+        save(3)
+        status, out = fe.handle_swap("m")
+        assert status == 409 and out["action"] == "rejected"
+        assert fe.stats_payload()["epochs"]["m"] == 2
+    finally:
+        faults.disarm()
+
+
+def test_hot_swap_watcher_thread_promotes_and_backs_off(tmp_path):
+    """The poll thread: a new epoch published while the watcher tails
+    the directory is promoted without any explicit call; stop() ends
+    the tail."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    watcher.poll_s = 0.05
+    watcher.start()
+    try:
+        assert watcher.watching()
+        save(2)
+        deadline = time.monotonic() + 20
+        while entry.loaded_epoch != 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert entry.loaded_epoch == 2, watcher.stats()
+    finally:
+        watcher.stop()
+    assert not watcher.watching()
+
+
+def test_swap_params_refuses_program_change():
+    """swap_params is weights-only by contract: a parameter set with
+    different shapes raises and leaves serving untouched."""
+    pool, sym, args, auxs = make_pool()
+    entry = pool.get("m")
+    x = {"data": np.random.RandomState(1).rand(1, 32).astype("f")}
+    before = entry.forward(dict(x))
+    other = mlp_sym(nh=48)
+    o_args, o_auxs = init_params(other, (1, 32), seed=3)
+    with pytest.raises(MXNetError):
+        entry.swap_params(o_args, o_auxs)
+    after = entry.forward(dict(x))
+    for a, b in zip(before, after):
+        assert np.array_equal(a, b)

@@ -26,17 +26,38 @@ data loss).
 Entries written before the integrity layer (no ``files`` records) are
 checked for existence only and reported under ``unverified``.
 
+PROMOTE MODES (the train-to-serve hot-swap gate, docs/how_to/serving.md
+"Continuous deployment")::
+
+    python tools/ckpt_fsck.py CKPT_DIR --promote-gate        # one shot
+    python tools/ckpt_fsck.py CKPT_DIR --watch [--poll 1.0]  # tail
+
+Both run ``mxnet_tpu.resilience.verify_promotion`` — the SAME routine
+``serving.deploy.CheckpointWatcher`` gates every hot swap on and
+``fleet.deploy.RollingSwap`` gates every rollout on, so fsck and the
+deploy path can never drift on what "healthy enough to promote" means.
+``--promote-gate`` verifies the newest (or ``--epoch N``) checkpoint
+and exits 0 iff a watcher would promote it; ``--watch`` polls the
+manifest and prints a PROMOTABLE/REJECTED verdict line for every new
+publish (``--watch-count N`` exits after N verdicts — CI/test use).
+
 Deliberately IMPORT-LIGHT (stdlib only — no jax, no package import):
 auditing a checkpoint directory must work on a machine with no
 accelerator runtime, and importing ``mxnet_tpu`` would spin up a JAX
-client.  The checksum implementations are therefore duplicated from
-``mxnet_tpu/resilience.py``; ``tests/test_resilience.py`` asserts the
-two stay in lockstep.
+client.  The classic audit's checksum implementations are therefore
+duplicated from ``mxnet_tpu/resilience.py`` (``tests/test_resilience.
+py`` asserts the two stay in lockstep); the promote modes import ONLY
+``mxnet_tpu.resilience`` through a synthetic-package stub (the
+mxlint/fleet idiom) — ``mxnet_tpu/__init__`` never executes, so no
+accelerator client is ever created.
 """
 import argparse
+import importlib.machinery
 import json
 import os
 import sys
+import time
+import types
 
 # -- checksums (duplicated from mxnet_tpu/resilience.py; lockstep-tested) --
 
@@ -209,6 +230,68 @@ def audit(directory, prefix="checkpoint"):
     return report
 
 
+# -- promote modes (the ONE verifier, shared with serving/deploy.py) -------
+
+def _verify_promotion():
+    """Import ``resilience.verify_promotion`` through a synthetic
+    package stub — ``mxnet_tpu/__init__`` never executes, so this stays
+    runnable where no accelerator runtime exists (the data_service
+    worker / tools/fleet.py idiom)."""
+    if "mxnet_tpu" not in sys.modules:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg = types.ModuleType("mxnet_tpu")
+        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
+        pkg.__spec__ = importlib.machinery.ModuleSpec(
+            "mxnet_tpu", None, is_package=True)
+        pkg.__spec__.submodule_search_locations = pkg.__path__
+        sys.modules["mxnet_tpu"] = pkg
+    from mxnet_tpu.resilience import verify_promotion
+    return verify_promotion
+
+
+def _promote_gate(args):
+    """One-shot gate: exit 0 iff a CheckpointWatcher would promote the
+    newest (or the given) epoch right now."""
+    verify = _verify_promotion()
+    epoch, problems = verify(args.directory, epoch=args.epoch,
+                             prefix=args.prefix)
+    doc = {"directory": os.path.abspath(args.directory),
+           "epoch": epoch, "promotable": not problems,
+           "problems": problems}
+    if not args.quiet:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for p in problems:
+            sys.stderr.write("ckpt_fsck: %s\n" % p)
+    return 0 if not problems else 1
+
+
+def _watch(args):
+    """Tail the manifest and print one verdict line per new publish —
+    the operator's view of exactly what the serving watcher will do."""
+    verify = _verify_promotion()
+    seen = None                  # (epoch, promotable) last reported
+    reported = 0
+    rc = 0
+    while args.watch_count is None or reported < args.watch_count:
+        epoch, problems = verify(args.directory, prefix=args.prefix)
+        state = (epoch, not problems)
+        if epoch is not None and state != seen:
+            seen = state
+            reported += 1
+            if problems:
+                rc = 1
+                print("ckpt_fsck: epoch %d REJECTED: %s"
+                      % (epoch, "; ".join(problems)), flush=True)
+            else:
+                print("ckpt_fsck: epoch %d PROMOTABLE" % epoch,
+                      flush=True)
+        if args.watch_count is not None and reported >= args.watch_count:
+            break
+        time.sleep(args.poll)
+    return rc
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Verify a CheckpointManager directory offline: "
@@ -221,7 +304,27 @@ def main(argv=None):
                         help="also write the report to this file")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the stdout report")
+    parser.add_argument("--promote-gate", action="store_true",
+                        help="verify ONE epoch with the promote-path "
+                             "verifier (resilience.verify_promotion — "
+                             "the same routine the serving hot-swap "
+                             "gates on); exit 0 iff promotable")
+    parser.add_argument("--epoch", type=int, default=None,
+                        help="epoch for --promote-gate (default: the "
+                             "manifest's newest)")
+    parser.add_argument("--watch", action="store_true",
+                        help="tail the manifest and print a PROMOTABLE/"
+                             "REJECTED verdict per new publish")
+    parser.add_argument("--poll", type=float, default=1.0,
+                        help="--watch poll interval in seconds")
+    parser.add_argument("--watch-count", type=int, default=None,
+                        help="exit after reporting this many verdicts "
+                             "(tests/CI; default: run until killed)")
     args = parser.parse_args(argv)
+    if args.promote_gate:
+        return _promote_gate(args)
+    if args.watch:
+        return _watch(args)
     report = audit(args.directory, prefix=args.prefix)
     payload = json.dumps(report, indent=2, sort_keys=True)
     if args.json:

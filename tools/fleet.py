@@ -163,6 +163,21 @@ def _cmd_serve(fleet, args):
         _log("fleet: drained during bring-up — exiting 0")
         return 0
     router.start()          # binds + one synchronous probe pass
+    if args.watch:
+        # rolling hot swap: tail every checkpoint-DIRECTORY model and
+        # roll verified new epochs one replica at a time
+        # (docs/how_to/fleet.md "Rolling deployment"; jax-free like
+        # the rest of this process)
+        watched = {name: spec["target"]
+                   for name, spec in man.models.items()
+                   if os.path.isdir(spec["target"])}
+        if watched:
+            fleet.RollingSwap(router, watched, log=_log).start()
+            _log("fleet: watching %s for new epochs"
+                 % sorted(watched.values()))
+        else:
+            _log("fleet: --watch: no checkpoint-directory models in "
+                 "the manifest — nothing to watch")
     _log("fleet: %d replica(s) ready; router on %s:%d (models: %s)"
          % (man.replicas, router.host, router.port, man.names()))
     if args.port_file:
@@ -215,6 +230,12 @@ def main(argv=None):
     p_serve.add_argument("--ready-timeout", type=float, default=600.0,
                          help="seconds to wait for every replica's "
                               "bring-up")
+    p_serve.add_argument("--watch", action="store_true",
+                         help="tail each checkpoint-directory model "
+                              "and roll verified new epochs across "
+                              "the replicas one at a time "
+                              "(MXTPU_SWAP_* knobs; docs/how_to/"
+                              "fleet.md 'Rolling deployment')")
 
     args = parser.parse_args(argv)
     if not args.cmd:

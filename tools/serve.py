@@ -92,6 +92,12 @@ def main(argv=None):
     parser.add_argument("--warmup", action="store_true",
                         help="compile every bucket per model before "
                              "accepting traffic (needs --input-shape)")
+    parser.add_argument("--watch", action="store_true",
+                        help="tail each checkpoint-DIRECTORY model for "
+                             "new epochs and hot-swap verified ones in "
+                             "with zero dropped requests (MXTPU_SWAP_* "
+                             "knobs; docs/how_to/serving.md "
+                             "'Continuous deployment')")
     parser.add_argument("--warmup-only", action="store_true",
                         help="warm every (model, bucket) forward, print "
                              "`mxserve: warmup_s=<s>`, exit 0 WITHOUT "
@@ -186,6 +192,19 @@ def main(argv=None):
         sys.stderr.write("mxserve: warmup-only — exiting 0\n")
         sys.stderr.flush()
         return 0
+    if args.watch:
+        for name in pool.names():
+            entry = pool.get(name)
+            if entry.source_dir:
+                frontend.watcher(name, start=True)
+                sys.stderr.write(
+                    "mxserve: watching %s (epoch %s) for new epochs of "
+                    "%r\n" % (entry.source_dir, entry.loaded_epoch, name))
+            else:
+                sys.stderr.write(
+                    "mxserve: --watch: model %r was loaded from a "
+                    "prefix:epoch pair, not a checkpoint directory — "
+                    "not watchable\n" % name)
     sys.stderr.write("mxserve: listening on %s:%d (models: %s)\n"
                      % (frontend.host, frontend.port, pool.names()))
     sys.stderr.flush()
