@@ -11,6 +11,10 @@ compiler.
 
 Components:
 - mesh.py: mesh construction helpers
+- planner.py: mxplan — the automatic sharding planner (mesh shape,
+  replicate/dp-shard/zero3 strategy under an HBM budget, derived zero3
+  gather groups) and the serializable ShardingPlan artifact checkpoints
+  persist for elastic world-size resume
 - trainer.py: SPMDTrainer — fused fwd+bwd+optimizer-update step, sharded
   over the mesh (the kvstore='tpu' fast path and the bench path)
 - spmd_module.py: SPMDModule — Module-API adapter over SPMDTrainer
@@ -26,6 +30,8 @@ from .compat import HAS_SHARD_MAP
 from .mesh import build_mesh, default_mesh, local_mesh
 from .trainer import SPMDTrainer
 from . import zero3  # noqa: F401 — EAGER env registration (MXTPU_ZERO3_*)
+from . import planner  # noqa: F401 — EAGER env registration (MXTPU_PLAN_*)
+from .planner import ShardingPlan
 from .spmd_module import SPMDModule
 from . import ring_attention
 from .ring_attention import ring_attention as ring_attention_fn

@@ -24,7 +24,7 @@ class SPMDModule(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging, mesh=None,
                  param_shardings=None, data_axis="dp", compute_dtype=None,
-                 grad_sync=None):
+                 grad_sync=None, plan=None):
         super().__init__(logger=logger)
         self._symbol = symbol
         self._data_names = list(data_names)
@@ -36,6 +36,10 @@ class SPMDModule(BaseModule):
         # 'allreduce' | 'zero' | 'zero3' (None follows MXNET_GRAD_SYNC);
         # forwarded to the SPMDTrainer built at init_optimizer
         self._grad_sync = grad_sync
+        # a planner.ShardingPlan (or its doc) supplies grad_sync /
+        # sharding rules / compute dtype as one artifact instead of the
+        # ad-hoc arguments above (explicit arguments still win)
+        self._plan = plan
         self._trainer = None
         self._optimizer_spec = ("sgd", {})
 
@@ -78,7 +82,7 @@ class SPMDModule(BaseModule):
             data_axis=self._data_axis,
             param_shardings=self._param_shardings,
             compute_dtype=self._compute_dtype,
-            grad_sync=self._grad_sync)
+            grad_sync=self._grad_sync, plan=self._plan)
         self._trainer.bind(self._data_shapes, self._label_shapes)
         initializer, arg_params, aux_params = self._init_args
         self._trainer.init_params(initializer, arg_params, aux_params)
@@ -153,6 +157,14 @@ class SPMDModule(BaseModule):
 
     def set_optimizer_states(self, states):
         self._trainer.set_states(states)
+
+    @property
+    def sharding_plan(self):
+        """The descriptive :class:`~mxnet_tpu.parallel.planner.
+        ShardingPlan` of the bound trainer (None before
+        init_optimizer) — ``.explain()`` renders the layout."""
+        return None if self._trainer is None \
+            else self._trainer.sharding_plan
 
     @property
     def skipped_update_count(self):

@@ -90,11 +90,31 @@ class SPMDTrainer(object):
                  mesh=None, data_axis="dp", param_shardings=None,
                  compute_dtype=None, remat=None, input_transforms=None,
                  grad_sync=None, step_guard=None,
-                 max_consecutive_bad_steps=None):
+                 max_consecutive_bad_steps=None, plan=None):
         import jax
         from ..base import get_env
         self.symbol = symbol
         self.mesh = mesh
+        # mxplan consumption (parallel/planner.py): a ShardingPlan (or
+        # its plain doc) supplies the POLICY — grad_sync, sharding
+        # rules, compute dtype — instead of ad-hoc arguments; explicit
+        # arguments still win.  Derived artifacts (per-param specs,
+        # gather groups) are recomputed at bind() for THIS mesh, so a
+        # plan written at another world size consumes cleanly (the
+        # elastic-resume contract).
+        self._given_plan = None
+        self.sharding_plan = None   # descriptive plan, built at bind()
+        if plan is not None:
+            from .planner import ShardingPlan
+            if isinstance(plan, dict):
+                plan = ShardingPlan.from_doc(plan)
+            self._given_plan = plan
+            if grad_sync is None:
+                grad_sync = plan.grad_sync
+            if param_shardings is None and plan.param_shardings:
+                param_shardings = plan.param_shardings
+            if compute_dtype is None and plan.compute_dtype:
+                compute_dtype = plan.compute_dtype
         # Gradient synchronization over the dp axis:
         #   'allreduce' — replicated params; GSPMD psums grads (the
         #     reference's dist_sync allreduce, kvstore_dist.h).
@@ -117,8 +137,9 @@ class SPMDTrainer(object):
         #   'zero3' — fully sharded (ZeRO-3/FSDP): same sharded master
         #     params + optimizer state as 'zero', but the step gathers
         #     each parameter GROUP on demand (group boundaries keyed by
-        #     the executor plan's topological order, bucketed per
-        #     MXTPU_ZERO3_GATHER_GROUP layers), the backward RE-GATHERS
+        #     the executor plan's topological order; planner-derived
+        #     buckets under MXTPU_ZERO3_GATHER_GROUP=auto, manual
+        #     N-layers-per-group otherwise), the backward RE-GATHERS
         #     instead of keeping replicated copies alive across the
         #     fwd/bwd boundary (jax.checkpoint policy dropping the
         #     tagged gathers), and gradients leave the backward as
@@ -279,6 +300,12 @@ class SPMDTrainer(object):
         if self._zero3:
             self._plan_zero3()
         self._build_step()
+        # the descriptive plan: what THIS trainer executes (world, mesh
+        # axes, resolved per-param placement, gather groups).
+        # save_checkpoint persists it in the manifest so a resume on a
+        # different inventory knows the writing run's layout
+        from .planner import ShardingPlan
+        self.sharding_plan = ShardingPlan.from_trainer(self)
         return self
 
     def _plan_zero3(self):
@@ -312,19 +339,66 @@ class SPMDTrainer(object):
             if len(dims) == 1:
                 shardable[name] = dims[0]
         self._zero3_dims = shardable
-        try:
-            group_layers = int(
-                get_env(ENV_ZERO3_GATHER_GROUP, "1") or 1)
-        except (TypeError, ValueError):
-            group_layers = 1
-        self._zero3_groups = z3.plan_gather_groups(
-            self.symbol, sorted(shardable), group_layers)
+        self._zero3_groups = self._choose_gather_groups(shardable)
         pure_dp = tuple(self.mesh.axis_names) == (self.data_axis,)
         batch_leading = all(s and s[0] == self.batch_size
                             for s in self.out_shapes)
         self.zero3_tier = "manual" if (
             pure_dp and HAS_SHARD_MAP and batch_leading and shardable
         ) else "gspmd"
+
+    def _choose_gather_groups(self, shardable):
+        """Gather groups for the zero3 step: under the
+        ``MXTPU_ZERO3_GATHER_GROUP=auto`` default, a consumed plan's
+        recorded groups when they match this bind exactly, otherwise
+        the planner's first-consumer/bucket-merged grouping.  A NUMERIC
+        env value is the operator's manual override and wins even over
+        a consumed plan — warning when the planned grouping
+        Pareto-dominates it on the memory model (fewer collectives AND
+        a no-bigger replicated peak)."""
+        import logging
+        from ..base import get_env
+        from . import planner
+        from . import zero3 as z3
+        from .zero3 import ENV_ZERO3_GATHER_GROUP
+        names = sorted(shardable)
+        if not names:
+            return []
+        comm_itemsize = self.compute_dtype.itemsize \
+            if self.compute_dtype is not None else 4
+        shapes = {n: tuple(self.arg_shapes[n]) for n in names}
+        raw = str(get_env(ENV_ZERO3_GATHER_GROUP, "auto") or
+                  "auto").strip().lower()
+        given = self._given_plan
+        if raw in ("", "auto") and given is not None and \
+                given.gather_groups and \
+                given.world == self.mesh.shape[self.data_axis] and \
+                sorted(n for g in given.gather_groups for n in g) == names:
+            return [list(g) for g in given.gather_groups]
+        planned = planner.derive_gather_groups(
+            self.symbol, names, shapes, itemsize=comm_itemsize)
+        if raw in ("", "auto"):
+            return planned
+        try:
+            group_layers = int(raw)
+        except (TypeError, ValueError):
+            logging.getLogger(__name__).warning(
+                "MXTPU_ZERO3_GATHER_GROUP=%r is neither 'auto' nor an "
+                "integer — using the planned grouping", raw)
+            return planned
+        manual = z3.plan_gather_groups(self.symbol, names, group_layers)
+        sizes = {n: int(np.prod(shapes[n])) * comm_itemsize
+                 for n in names}
+        mc = planner.group_cost(manual, sizes)
+        pc = planner.group_cost(planned, sizes)
+        if planner.dominates(pc, mc):
+            logging.getLogger(__name__).warning(
+                "MXTPU_ZERO3_GATHER_GROUP=%d loses to the planned "
+                "grouping on the memory model: manual = %d collectives "
+                "/ %d peak gathered bytes, planned = %d / %d — unset "
+                "the knob (or set it to 'auto') to take the planner's "
+                "grouping", group_layers, mc[0], mc[1], pc[0], pc[1])
+        return manual
 
     def init_params(self, initializer, arg_params=None, aux_params=None):
         from ..ndarray import zeros as nd_zeros
@@ -1251,6 +1325,17 @@ class SPMDTrainer(object):
                 and "num_update" in payload:
             states = payload["states"]
             self._num_update = payload["num_update"]
+            # this format always records every param's slot tuple — a
+            # param missing from it means the blob belongs to a
+            # DIFFERENT model (save->resume drift); restoring would
+            # silently keep stale optimizer state for that param
+            missing = sorted(set(self.params) - set(states))
+            if missing:
+                raise MXNetError(
+                    "optimizer-state blob has no entry for parameter(s) "
+                    "%s — the checkpoint belongs to a different model "
+                    "(param added between save and resume?)"
+                    % ", ".join(missing))
         else:
             # Updater-format blob ({index_or_name: state}) saved by the
             # executor/kvstore path — convert so checkpoints resume across
@@ -1293,14 +1378,54 @@ class SPMDTrainer(object):
         and replicated runs restore each other's checkpoints freely."""
         arg_params, aux_params = self.snapshot_params()
         states = self.get_states()
+        plan_doc = self.sharding_plan.to_doc() \
+            if self.sharding_plan is not None else None
         return manager.save(step, self.symbol, arg_params, aux_params,
-                            optimizer_states=states, blocking=blocking)
+                            optimizer_states=states, blocking=blocking,
+                            plan=plan_doc)
 
     def restore(self, manager, epoch=None):
         """Resume params + optimizer state (+ step counter, inside the
         states blob) from the manager's newest — or given — checkpoint;
-        returns the restored epoch."""
+        returns the restored epoch.
+
+        ELASTIC: the checkpoint may have been written at a DIFFERENT
+        world size — gather-on-save params are full host arrays, so
+        ``set_params``'s placement re-shards them onto THIS trainer's
+        mesh (replicated<->sharded and shard<->shard alike), and the
+        persisted :class:`~mxnet_tpu.parallel.planner.ShardingPlan` in
+        the manifest records what wrote the bytes.  The param SET must
+        match exactly: a parameter added or removed between save and
+        resume raises with names (never a silent misload — use
+        ``set_params`` directly for deliberate partial restores)."""
+        from .planner import diff_param_sets
         _, arg_params, aux_params, states, epoch = manager.restore(epoch)
+        problems = diff_param_sets(
+            {n: {} for n in arg_params}, set(self.param_names))
+        problems += diff_param_sets(
+            {n: {} for n in aux_params}, set(self.aux_names),
+            kind="aux state")
+        if problems:
+            raise MXNetError(
+                "restore: checkpoint epoch %d does not match this "
+                "model's parameter set:\n  %s\n(a param added/removed "
+                "between save and resume — fix the symbol, or load "
+                "deliberately with set_params)"
+                % (epoch, "\n  ".join(problems)))
+        saved_plan = None
+        if hasattr(manager, "plan"):
+            saved_plan = manager.plan(epoch)
+        if saved_plan is not None and self.sharding_plan is not None:
+            saved_world = int(saved_plan.get("world", 1))
+            here = self.sharding_plan.world
+            if saved_world != here:
+                import logging
+                logging.getLogger(__name__).info(
+                    "elastic resume: checkpoint epoch %d was written at "
+                    "world=%d (grad_sync=%r), restoring at world=%d — "
+                    "params re-shard through set_params placement",
+                    epoch, saved_world, saved_plan.get("grad_sync"),
+                    here)
         self.set_params(arg_params, aux_params)
         if states is not None:
             self.set_states(states)
@@ -1359,6 +1484,13 @@ class SPMDTrainer(object):
         schedule = None
         if self._zero3:
             schedule = "zero3-" + (self.zero3_tier or "gspmd")
+        # the compiled platform decides which schedule shapes are owed
+        # (gspmd-tier reduce-scatter exists only where XLA's
+        # ReduceScatterCreator runs — TPU/GPU pipelines)
+        if self.mesh is not None:
+            platform = next(iter(self.mesh.devices.flat)).platform
+        else:
+            platform = jax.default_backend()
         return graph_lint.lint_lowered(
             lowered, closed_jaxpr=closed,
             compute_dtype=self.compute_dtype,
@@ -1366,6 +1498,7 @@ class SPMDTrainer(object):
             expect_allgather=self._expects_allgather(),
             schedule=schedule,
             expect_gather_bytes=self._zero3_expected_gather_bytes(),
+            platform=platform,
             min_donate_bytes=min_donate_bytes,
             # the step's carries live in args 0-3 (params/aux/opt_state/
             # extras) BY SIGNATURE — restricting the missing-donation

@@ -27,10 +27,13 @@ Two tiers, the kernels-package discipline (Pallas/lax):
 
 Group boundaries are keyed by the executor plan's TOPOLOGICAL order
 (executor._node_plan): each parameter belongs to the plan position of
-its first consuming node, consecutive consumer nodes ("layers") chunk
-into gather groups of MXTPU_ZERO3_GATHER_GROUP layers each.  Separate
-per-group gathers — not one monolithic gather — are what XLA's
-latency-hiding scheduler can pipeline against early forward compute.
+its first consuming node.  Under the MXTPU_ZERO3_GATHER_GROUP=auto
+default the PLANNER (parallel/planner.py) merges consecutive consumer
+nodes ("layers") toward a target bucket size; a numeric value is the
+manual N-layers-per-group override (plan_gather_groups below).
+Separate per-group gathers — not one monolithic gather — are what
+XLA's latency-hiding scheduler can pipeline against early forward
+compute.
 
 The backward re-gather is expressed with ``jax.checkpoint`` +
 ``checkpoint_name``: every gathered (replicated) value is tagged
@@ -55,11 +58,16 @@ __all__ = ["ENV_ZERO3_GATHER_GROUP", "GATHER_TAG", "first_consumer_order",
 GATHER_TAG = "zero3_gather"
 
 ENV_ZERO3_GATHER_GROUP = register_env(
-    "MXTPU_ZERO3_GATHER_GROUP", default="1",
-    doc="grad_sync='zero3': consecutive plan-order layers whose "
-        "parameters share one gather group (1 = per-layer gathers; "
-        "larger values fuse more parameters into fewer, bigger "
-        "collectives — less dispatch overhead, less overlap)")
+    "MXTPU_ZERO3_GATHER_GROUP", default="auto",
+    doc="grad_sync='zero3': gather grouping.  'auto' (default) derives "
+        "the groups from the executor plan's first-consumer order, "
+        "merged toward MXTPU_PLAN_GATHER_BUCKET bytes per collective "
+        "(parallel/planner.py).  A numeric value is the manual "
+        "override — N consecutive plan-order layers per group (1 = "
+        "per-layer gathers; larger values fuse more parameters into "
+        "fewer, bigger collectives — less dispatch overhead, less "
+        "overlap) — and warns when it loses to the planned grouping "
+        "on the memory model")
 
 
 def first_consumer_order(symbol, param_names):

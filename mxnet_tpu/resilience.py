@@ -1237,11 +1237,30 @@ class CheckpointManager(object):
         epoch = self.latest()
         return None if epoch is None else self.entry(epoch)
 
+    def plan(self, epoch=None):
+        """The sharding-plan doc persisted with ``epoch`` (default: the
+        newest checkpoint), or None — what mesh/strategy wrote the
+        bytes (``parallel/planner.py``; ``SPMDTrainer.restore`` reads
+        it for its elastic-resume logging, ``tools/plan_explain.py``
+        and ``ckpt_fsck --devices`` gate on it)."""
+        if epoch is None:
+            epoch = self.latest()
+            if epoch is None:
+                return None
+        entry = self.entry(epoch)
+        return None if entry is None else entry.get("plan")
+
     # -- save -------------------------------------------------------------
     def save(self, epoch, symbol=None, arg_params=None, aux_params=None,
              optimizer_states=None, step_state=None, blocking=None,
-             rank=None, world=None):
+             rank=None, world=None, plan=None):
         """Write one checkpoint atomically; returns the epoch.
+
+        ``plan`` (JSON-serializable dict) is a sharding-plan doc
+        (``parallel/planner.py``) persisted verbatim in the manifest
+        entry — the elastic-resume record of what mesh/strategy wrote
+        these bytes; read back with :meth:`plan`,
+        ``tools/plan_explain.py`` and ``tools/ckpt_fsck.py --devices``.
 
         ``optimizer_states`` is the serialized blob (bytes) from
         ``Module.get_optimizer_states()`` / ``Updater.get_states()``.
@@ -1291,11 +1310,13 @@ class CheckpointManager(object):
             arg_params = snapshot_params(arg_params)
             aux_params = snapshot_params(aux_params)
         step_state = dict(step_state) if step_state is not None else None
+        plan = dict(plan) if plan is not None else None
 
         def job():
             self._write_checkpoint(epoch, sym_json, arg_params or {},
                                    aux_params or {}, optimizer_states,
-                                   step_state, rank, world, replicas)
+                                   step_state, rank, world, replicas,
+                                   plan=plan)
 
         if blocking:
             if self._writer is not None:
@@ -1337,7 +1358,7 @@ class CheckpointManager(object):
 
     def _write_checkpoint(self, epoch, sym_json, arg_params, aux_params,
                           optimizer_states, step_state, rank, world,
-                          replicas):
+                          replicas, plan=None):
         """The write pipeline (caller thread when blocking, writer thread
         when async): files -> ``ckpt_write`` fault point -> manifest."""
         algo = _checksum_algo()
@@ -1410,6 +1431,8 @@ class CheckpointManager(object):
             entry["shards"] = shard_meta
         if step_state is not None:
             entry["step_state"] = step_state
+        if plan is not None:
+            entry["plan"] = plan
         self._update_manifest(entry)
         # promote-path chaos points: damage the params file AFTER the
         # manifest vouches for it — exactly the bit-rot / torn-copy

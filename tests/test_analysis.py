@@ -867,6 +867,56 @@ def test_collective_schedule_clean_zero3_and_unaffected_allreduce():
         t.close()
 
 
+def test_collective_schedule_gspmd_owes_rs_on_rs_platforms():
+    """ROADMAP item 2's previously-unverified claim, now asserted: on
+    TPU/GPU pipelines XLA's ReduceScatterCreator must give the GSPMD
+    tier real reduce-scatter — a gspmd zero3 schedule with gathers but
+    no RS (and a param-scale all-reduce) flags on 'tpu', while 'cpu'
+    keeps the all-reduce form as the documented tier placement."""
+    no_rs = {"all-gather": {"count": 2, "bytes": 1000},
+             "all-reduce": {"count": 1, "bytes": 800}}
+    fs = graph_lint.audit_collective_schedule(no_rs, "zero3-gspmd",
+                                              1000, platform="tpu")
+    msgs = [f.message for f in fs]
+    assert len(fs) == 2, msgs
+    assert any("ReduceScatterCreator" in m for m in msgs)
+    assert any("full all-reduce" in m for m in msgs)
+    # gpu pipelines run the pass too
+    assert len(graph_lint.audit_collective_schedule(
+        no_rs, "zero3-gspmd", 1000, platform="gpu")) == 2
+    # cpu: documented tier note, not a violation (the gathers still
+    # gate — an unsharded step keeps flagging)
+    assert graph_lint.audit_collective_schedule(
+        no_rs, "zero3-gspmd", 1000, platform="cpu") == []
+    assert graph_lint.audit_collective_schedule(
+        {}, "zero3-gspmd", 1000, platform="cpu")
+    # a clean tpu gspmd schedule passes
+    clean = {"all-gather": {"count": 2, "bytes": 1000},
+             "reduce-scatter": {"count": 1, "bytes": 125},
+             "all-reduce": {"count": 1, "bytes": 12}}
+    assert graph_lint.audit_collective_schedule(
+        clean, "zero3-gspmd", 1000, platform="tpu") == []
+    # the manual tier owes RS on EVERY platform (explicit psum_scatter)
+    assert len(graph_lint.audit_collective_schedule(
+        no_rs, "zero3-manual", 1000, platform="cpu")) == 2
+    # unknown platform (None, the legacy call shape): gspmd tolerates
+    assert graph_lint.audit_collective_schedule(
+        no_rs, "zero3-gspmd", 1000) == []
+
+
+def test_collective_schedule_records_platform():
+    """trainer.analyze threads the compiled platform into the schedule
+    stats — the artifact records WHERE the schedule claim was proven."""
+    X, y = batch()
+    t = make_trainer(grad_sync="zero3")
+    try:
+        rep = t.analyze(X, y)
+        assert rep.ok, rep.format_text()
+        assert rep.stats["schedule"]["platform"] == "cpu"
+    finally:
+        t.close()
+
+
 class _UnshardedZero3(SPMDTrainer):
     """Violation fixture: declares zero3 but sabotages the sharding —
     every param resolves replicated, so nothing gathers and gradients

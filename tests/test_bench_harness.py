@@ -494,3 +494,52 @@ def test_hotswap_mode_is_known_and_in_the_pipeline_set():
     with open(os.path.join(REPO, "bench.py")) as f:
         src = f.read()
     assert '_collect("hotswap")' in src
+
+
+def test_gate_keys_cover_plan_metrics(tmp_path):
+    """Satellite: mxplan's decision time and planned-grouping step
+    time are gate-guarded as LOWER-is-better latencies — a RISE past
+    tolerance blocks, an improvement passes, a vanished key blocks."""
+    for key in ("plan_decide_ms", "plan_step_ms"):
+        assert key in bench.GATE_KEYS
+        assert key in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, plan_decide_ms=1.2, plan_step_ms=30.0)
+    # a 50% faster planner PASSES (higher-is-better logic would fail it)
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, plan_decide_ms=0.6)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert rep["pass"], rep
+    # a 50% slower planned step BLOCKS
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, plan_step_ms=45.0)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "plan_step_ms"
+    # a vanished plan key blocks too
+    gone = {k: v for k, v in base.items() if k != "plan_decide_ms"}
+    rep = bench.gate(_write(tmp_path / "n3.json", gone),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "plan_decide_ms"
+
+
+def test_plan_mode_is_known_and_in_pipeline():
+    assert "plan" in bench.KNOWN_MODES
+
+
+def test_plan_bench_small_preset_self_proof():
+    """The plan mode's self-proof on the small preset: the budget
+    ladder walks allreduce -> zero -> zero3, an unfittable budget
+    raises at planning time, the serialized plan round-trips to an
+    identical digest, and the planned (auto) grouping is measured
+    against the retired per-layer default with fewer collectives."""
+    out = bench._plan_bench(preset="small")
+    assert out["plan_budget_ladder_ok"] is True
+    assert out["plan_budget_ladder"] == ["allreduce", "zero", "zero3"]
+    assert out["plan_overflow_raises"] is True
+    assert out["plan_roundtrip_ok"] is True
+    assert out["plan_grad_sync"] == "zero3"
+    assert out["plan_decide_ms"] > 0
+    assert out["plan_step_ms"] > 0 and out["plan_manual_step_ms"] > 0
+    # the planner's bucket merge really produced a different grouping
+    assert out["plan_auto_groups"] < out["plan_manual_groups"]

@@ -457,8 +457,11 @@ def test_zero3_schedule_proven_by_analyze():
 
 def test_zero3_gather_groups_follow_plan_order(monkeypatch):
     """Gather groups are keyed by the executor plan's topological order
-    (fc1's params before fc2's), one group per consuming layer by
-    default; MXTPU_ZERO3_GATHER_GROUP=2 fuses two layers per group."""
+    (fc1's params before fc2's): MXTPU_ZERO3_GATHER_GROUP=1 gives one
+    group per consuming layer, =2 fuses two layers per group, and the
+    'auto' default hands the grouping to the planner (which merges this
+    tiny model's layers into ONE bucket — its bytes are far below the
+    MXTPU_PLAN_GATHER_BUCKET target)."""
     def build():
         trainer = SPMDTrainer(mlp_sym(num_classes=4, nh=64), "sgd",
                               {"learning_rate": 0.1},
@@ -466,6 +469,7 @@ def test_zero3_gather_groups_follow_plan_order(monkeypatch):
         trainer.bind([("data", (64, 10))], [("softmax_label", (64,))])
         return trainer
 
+    monkeypatch.setenv("MXTPU_ZERO3_GATHER_GROUP", "1")
     t = build()
     groups = [sorted(g) for g in t._zero3_groups]
     # fc1's layer group strictly precedes fc2's in plan order
@@ -473,11 +477,23 @@ def test_zero3_gather_groups_follow_plan_order(monkeypatch):
     ix1 = next(i for i, g in enumerate(groups) if "fc1_weight" in g)
     ix2 = next(i for i, g in enumerate(groups) if "fc2_weight" in g)
     assert ix1 < ix2, groups
-    n_default = len(groups)
+    n_per_layer = len(groups)
     t.close()
     monkeypatch.setenv("MXTPU_ZERO3_GATHER_GROUP", "2")
     t = build()
-    assert len(t._zero3_groups) < n_default or n_default == 1
+    assert len(t._zero3_groups) < n_per_layer or n_per_layer == 1
+    t.close()
+    # the auto default: planner-derived groups (bucket-merged, same
+    # name set, same plan order)
+    monkeypatch.delenv("MXTPU_ZERO3_GATHER_GROUP", raising=False)
+    t = build()
+    from mxnet_tpu.parallel import planner
+    want = planner.derive_gather_groups(
+        t.symbol, sorted(t._zero3_dims),
+        {n: tuple(t.arg_shapes[n]) for n in t._zero3_dims})
+    assert t._zero3_groups == want
+    assert sorted(n for g in t._zero3_groups for n in g) == \
+        sorted(t._zero3_dims)
     t.close()
 
 

@@ -1,0 +1,578 @@
+"""mxplan tests: the sharding planner, the ShardingPlan artifact, its
+checkpoint-manifest persistence, and elastic world-size resume
+(docs/how_to/planner.md).  Meshes of different world sizes are built
+over SUBSETS of the 8 virtual CPU devices, so shard<->shard re-sharding
+runs in-process."""
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (ShardingPlan, SPMDTrainer, build_mesh,
+                                local_mesh, planner)
+from mxnet_tpu.resilience import CheckpointManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mlp_sym(nh=64, nc=4, name_prefix=""):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=nh,
+                                name=name_prefix + "fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=nc,
+                                name=name_prefix + "fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def deep_sym(depth=4, nh=32, nc=4):
+    net = mx.sym.Variable("data")
+    for i in range(depth):
+        net = mx.sym.FullyConnected(net, num_hidden=nh, name="fc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=nc, name="fc_out")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_trainer(sym, mesh, batch=64, din=10, grad_sync="zero3", seed=33,
+                 **kw):
+    t = SPMDTrainer(sym, "sgd",
+                    {"learning_rate": 0.3, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+                    mesh=mesh, grad_sync=grad_sync, **kw)
+    t.bind([("data", (batch, din))], [("softmax_label", (batch,))])
+    mx.random.seed(seed)
+    t.init_params(mx.initializer.Xavier())
+    return t
+
+
+def sub_mesh(n):
+    import jax
+    return build_mesh({"dp": n}, jax.devices()[:n])
+
+
+def batch(batch=64, din=10, nc=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(batch, din).astype("f"),
+            rs.randint(0, nc, batch).astype("f"))
+
+
+# ---------------------------------------------------------------------------
+# the artifact: serialization, digest, explain
+# ---------------------------------------------------------------------------
+
+def test_plan_roundtrip_and_digest():
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    sp = t.sharding_plan
+    assert sp is not None and sp.world == 8
+    rt = ShardingPlan.from_doc(json.loads(sp.to_json()))
+    assert rt.digest() == sp.digest()
+    assert rt.to_doc() == sp.to_doc()
+    # explain() names the strategy, the mesh and every gather group
+    text = sp.explain()
+    assert "grad_sync='zero3'" in text and "world=8" in text
+    for g in sp.gather_groups:
+        for name in g:
+            assert name in text
+    t.close()
+
+
+def test_plan_save_load_file(tmp_path):
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    path = str(tmp_path / "plan.json")
+    t.sharding_plan.save(path)
+    loaded = ShardingPlan.load(path)
+    assert loaded.digest() == t.sharding_plan.digest()
+    t.close()
+
+
+def test_plan_unknown_version_rejected():
+    with pytest.raises(mx.MXNetError, match="version"):
+        ShardingPlan.from_doc({"version": 999})
+
+
+# ---------------------------------------------------------------------------
+# prescriptive planning: the budget ladder + derived groups
+# ---------------------------------------------------------------------------
+
+def test_plan_budget_ladder_chooses_cheapest_fitting_strategy():
+    sym = mlp_sym(nh=64)
+    shapes = ([("data", (64, 10))], [("softmax_label", (64,))])
+    probe = planner.plan(sym, *shapes, world=8, optimizer="sgd",
+                        optimizer_params={"momentum": 0.9})
+    per = probe.doc["bytes"]["per_device"]
+    # the model orders the strategies by residency
+    assert per["allreduce"] > per["zero"] > per["zero3"]
+    picks = [planner.plan(sym, *shapes, world=8, hbm_budget=b,
+                          optimizer="sgd",
+                          optimizer_params={"momentum": 0.9}).grad_sync
+             for b in (per["allreduce"] + 1, per["zero"] + 1,
+                       per["zero3"] + 1)]
+    assert picks == ["allreduce", "zero", "zero3"], picks
+    # nothing fits -> loud failure at PLANNING time, with the numbers
+    with pytest.raises(mx.MXNetError, match="no strategy fits"):
+        planner.plan(sym, *shapes, world=8, hbm_budget=1)
+    # no budget -> replicated-by-assumption, and the plan SAYS so
+    free = planner.plan(sym, *shapes, world=8)
+    assert free.grad_sync == "allreduce"
+    assert any("no HBM budget" in d for d in free.decisions)
+
+
+def test_plan_pinned_grad_sync_and_explicit_rules():
+    sym = mlp_sym(nh=64)
+    p = planner.plan(sym, [("data", (64, 10))], [("softmax_label", (64,))],
+                     world=8, grad_sync="zero3",
+                     param_shardings={r"fc1_weight": ("tp", None)})
+    assert p.grad_sync == "zero3"
+    rec = p.params["fc1_weight"]
+    assert rec["rule"] == "explicit" and rec["spec"] == ["tp", None]
+    # explicit-ruled params stay out of the dp gather groups
+    grouped = {n for g in p.gather_groups for n in g}
+    assert "fc1_weight" not in grouped
+    assert "fc2_weight" in grouped
+    # batch indivisible by the dp axis is a planning-time error for zero3
+    with pytest.raises(mx.MXNetError, match="does not divide"):
+        planner.plan(sym, [("data", (60, 10))], [("softmax_label", (60,))],
+                     world=8, grad_sync="zero3")
+
+
+def test_derive_gather_groups_bucket_merge_and_order():
+    sym = deep_sym(depth=4, nh=32)
+    arg_shapes, _, _ = sym.infer_shape(data=(64, 32),
+                                       softmax_label=(64,))
+    shapes = dict(zip(sym.list_arguments(), arg_shapes))
+    names = sorted(n for n in shapes if n not in ("data", "softmax_label"))
+    # a huge bucket merges everything into one collective
+    one = planner.derive_gather_groups(sym, names, shapes,
+                                       bucket_bytes=1 << 30)
+    assert len(one) == 1 and sorted(one[0]) == names
+    # a tiny bucket degenerates to per-layer groups, in plan order
+    per_layer = planner.derive_gather_groups(sym, names, shapes,
+                                             bucket_bytes=1)
+    from mxnet_tpu.parallel import zero3 as z3
+    assert per_layer == z3.plan_gather_groups(sym, names, 1)
+    # a mid bucket lies between and every name appears exactly once
+    mid_bucket = 32 * 32 * 4 * 2 + 1
+    mid = planner.derive_gather_groups(sym, names, shapes,
+                                       bucket_bytes=mid_bucket)
+    assert len(per_layer) >= len(mid) >= len(one)
+    flat = [n for g in mid for n in g]
+    assert sorted(flat) == names and len(flat) == len(set(flat))
+
+
+def _big_middle_sym():
+    """Several small fcs around one dominant fc: the step's gathered
+    peak is the big layer under ANY grouping, so per-layer gathers
+    only add dispatches — the Pareto-dominated shape."""
+    net = mx.sym.Variable("data")
+    for i in range(3):
+        net = mx.sym.FullyConnected(net, num_hidden=32, name="s%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=512, name="big")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="out")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_manual_knob_warns_when_planned_grouping_dominates(monkeypatch,
+                                                           caplog):
+    """MXTPU_ZERO3_GATHER_GROUP=1 on the big-middle model is
+    Pareto-dominated by the planner's merge: the big layer sets the
+    gathered peak either way, so per-layer gathers buy nothing and
+    cost 3x the collectives.  The trainer warns but OBEYS the
+    override."""
+    # bucket below the big layer's bytes: the planner merges the small
+    # layers and leaves 'big' alone — same peak, fewer collectives
+    monkeypatch.setenv("MXTPU_PLAN_GATHER_BUCKET", "40000")
+    monkeypatch.setenv("MXTPU_ZERO3_GATHER_GROUP", "1")
+    sym = _big_middle_sym()
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.parallel.trainer"):
+        t = make_trainer(sym, local_mesh("dp"), din=32)
+    # the override is obeyed (per-layer groups)...
+    from mxnet_tpu.parallel import zero3 as z3
+    names = sorted(t._zero3_dims)
+    assert t._zero3_groups == z3.plan_gather_groups(sym, names, 1)
+    planned = planner.derive_gather_groups(
+        sym, names, {n: tuple(t.arg_shapes[n]) for n in names},
+        bucket_bytes=40000)
+    assert len(planned) < len(t._zero3_groups)
+    t.close()
+    # ...and the warning names both costs
+    assert any("loses to the planned grouping" in r.message
+               for r in caplog.records), caplog.text
+    # no warning when the manual value matches/beats the planned shape
+    caplog.clear()
+    monkeypatch.setenv("MXTPU_ZERO3_GATHER_GROUP", "%d" % (len(names),))
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.parallel.trainer"):
+        t = make_trainer(sym, local_mesh("dp"), din=32)
+    t.close()
+    assert not any("loses to the planned grouping" in r.message
+                   for r in caplog.records), caplog.text
+
+
+def test_garbage_knob_falls_back_to_planned(monkeypatch, caplog):
+    monkeypatch.setenv("MXTPU_ZERO3_GATHER_GROUP", "banana")
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.parallel.trainer"):
+        t = make_trainer(mlp_sym(), local_mesh("dp"))
+    want = planner.derive_gather_groups(
+        t.symbol, sorted(t._zero3_dims),
+        {n: tuple(t.arg_shapes[n]) for n in t._zero3_dims})
+    assert t._zero3_groups == want
+    assert any("neither 'auto' nor an integer" in r.message
+               for r in caplog.records)
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# plan consumption: SPMDTrainer(plan=...)
+# ---------------------------------------------------------------------------
+
+def test_trainer_consumes_prescriptive_plan():
+    sym = mlp_sym(nh=64)
+    p = planner.plan(sym, [("data", (64, 10))], [("softmax_label", (64,))],
+                     world=8, grad_sync="zero3")
+    t = SPMDTrainer(sym, "sgd", {"learning_rate": 0.1},
+                    mesh=local_mesh("dp"), plan=p)
+    t.bind([("data", (64, 10))], [("softmax_label", (64,))])
+    assert t.grad_sync == "zero3"
+    # a matching plan's recorded groups are consumed verbatim
+    assert t._zero3_groups == p.gather_groups
+    mx.random.seed(1)
+    t.init_params(mx.initializer.Xavier())
+    X, y = batch()
+    t.step(X, y)
+    t.close()
+    # the plain doc form (what a manifest carries) consumes too, and an
+    # explicit argument still wins over the plan
+    t2 = SPMDTrainer(sym, "sgd", {"learning_rate": 0.1},
+                     mesh=local_mesh("dp"), plan=p.to_doc(),
+                     grad_sync="allreduce")
+    t2.bind([("data", (64, 10))], [("softmax_label", (64,))])
+    assert t2.grad_sync == "allreduce"
+    t2.close()
+
+
+def test_plan_written_at_other_world_consumes_cleanly():
+    """A plan recorded at world=4 consumed on the dp=8 mesh: the POLICY
+    applies, the derived groups recompute for THIS mesh (the
+    elastic-resume contract)."""
+    sym = mlp_sym(nh=64)
+    p4 = planner.plan(sym, [("data", (64, 10))],
+                      [("softmax_label", (64,))], world=4,
+                      grad_sync="zero3")
+    assert p4.world == 4
+    t = SPMDTrainer(sym, "sgd", {"learning_rate": 0.1},
+                    mesh=local_mesh("dp"), plan=p4)
+    t.bind([("data", (64, 10))], [("softmax_label", (64,))])
+    assert t.grad_sync == "zero3"
+    assert t.sharding_plan.world == 8
+    # groups were re-derived for world 8, covering THIS bind's shardable
+    # set exactly
+    assert sorted(n for g in t._zero3_groups for n in g) == \
+        sorted(t._zero3_dims)
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# inventory + resume gates
+# ---------------------------------------------------------------------------
+
+def test_check_inventory_notes_and_problems():
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    sp = t.sharding_plan
+    t.close()
+    # same world: clean
+    assert sp.check_inventory(8) == ([], [])
+    # world change: a NOTE, not a problem (elastic resume)
+    problems, notes = sp.check_inventory(2)
+    assert not problems and any("elastic re-shard" in n for n in notes)
+    # indivisible batch under zero3: a hard problem
+    problems, _ = sp.check_inventory(7)
+    assert any("does not divide" in p for p in problems)
+    # blown budget: a hard problem
+    problems, _ = sp.check_inventory(8, hbm_bytes=16)
+    assert any("HBM budget" in p for p in problems)
+    # empty inventory
+    problems, _ = sp.check_inventory(0)
+    assert problems
+    # module-level jax-free entry (what ckpt_fsck imports)
+    problems, notes = planner.check_inventory(sp.to_doc(), 2)
+    assert not problems and notes
+    assert planner.check_inventory({"version": 999}, 8)[0]
+
+
+def test_check_inventory_unsatisfiable_mesh_axes():
+    """A plan with a tp axis needs a device count divisible by it."""
+    import jax
+    mesh = build_mesh({"dp": 4, "tp": 2}, jax.devices())
+    t = SPMDTrainer(mlp_sym(nh=64), "sgd", {"learning_rate": 0.1},
+                    mesh=mesh, grad_sync="zero3",
+                    param_shardings={r"fc1_weight": ("tp", None)})
+    t.bind([("data", (64, 10))], [("softmax_label", (64,))])
+    sp = t.sharding_plan
+    t.close()
+    problems, _ = sp.check_inventory(7)
+    assert any("mesh axes" in p for p in problems)
+    problems, _ = sp.check_inventory(4)
+    assert not any("mesh axes" in p for p in problems)
+
+
+def test_diff_param_sets_names_every_drift():
+    saved = {"a": {"shape": [4, 4]}, "b": {"shape": [8]}}
+    assert planner.diff_param_sets(saved, {"a": (4, 4), "b": (8,)}) == []
+    probs = planner.diff_param_sets(saved, {"a": (4, 4), "c": (2,)})
+    assert any("c" in p and "added" in p for p in probs)
+    assert any("b" in p and "removed" in p for p in probs)
+    probs = planner.diff_param_sets(saved, {"a": (5, 4), "b": (8,)})
+    assert any("changed shape" in p for p in probs)
+
+
+# ---------------------------------------------------------------------------
+# manifest persistence
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_manifest_carries_plan(tmp_path):
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    X, y = batch()
+    t.step(X, y)
+    mgr = CheckpointManager(str(tmp_path))
+    t.save_checkpoint(mgr, 1, blocking=True)
+    doc = mgr.plan(1)
+    assert doc is not None and doc["world"] == 8
+    assert doc["grad_sync"] == "zero3"
+    assert doc == mgr.plan()  # epoch default = latest
+    assert ShardingPlan.from_doc(doc).digest() == \
+        t.sharding_plan.digest()
+    # the async path snapshots the plan too
+    t.step(X, y)
+    t.save_checkpoint(mgr, 2, blocking=False)
+    mgr.wait()
+    assert mgr.plan(2) is not None
+    t.close()
+
+
+def test_ckpt_fsck_devices_gate(tmp_path):
+    """tools/ckpt_fsck.py --devices runs the same inventory check as
+    plan_explain --check, jax-free, and fails the audit on a hard
+    mismatch while passing elastic world changes."""
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    t.step(*batch())
+    mgr = CheckpointManager(str(tmp_path))
+    t.save_checkpoint(mgr, 1, blocking=True)
+    t.close()
+    fsck = os.path.join(REPO, "tools", "ckpt_fsck.py")
+    env = {k: v for k, v in os.environ.items()}
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, fsck, str(tmp_path)] + list(extra),
+            capture_output=True, text=True, timeout=120, env=env)
+
+    # elastic world change: audit passes, the note is in the report
+    res = run("--devices", "2")
+    assert res.returncode == 0, res.stderr
+    rep = json.loads(res.stdout)
+    assert any("elastic re-shard" in n
+               for e in rep["checkpoints"]
+               for n in e.get("plan_notes", [])), rep
+    # hard mismatch (batch 64 on 7 devices under zero3): audit fails
+    res = run("--devices", "7", "-q")
+    assert res.returncode == 1
+    assert "does not divide" in res.stderr
+    # blown budget fails too
+    res = run("--devices", "8", "--hbm", "16", "-q")
+    assert res.returncode == 1 and "HBM budget" in res.stderr
+
+
+def test_plan_explain_cli(tmp_path):
+    """tools/plan_explain.py: explain + --check on a plan file and a
+    checkpoint directory, with --devices so no jax is needed."""
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    t.step(*batch())
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    t.save_checkpoint(mgr, 1, blocking=True)
+    plan_file = str(tmp_path / "plan.json")
+    t.sharding_plan.save(plan_file)
+    t.close()
+    cli = os.path.join(REPO, "tools", "plan_explain.py")
+
+    def run(target, *extra):
+        return subprocess.run([sys.executable, cli, target] + list(extra),
+                              capture_output=True, text=True, timeout=120)
+
+    res = run(plan_file)
+    assert res.returncode == 0 and "grad_sync='zero3'" in res.stdout
+    res = run(str(tmp_path / "ckpt"), "--check", "--devices", "8",
+              "--json", str(tmp_path / "rep.json"))
+    assert res.returncode == 0 and "FITS" in res.stdout
+    with open(tmp_path / "rep.json") as f:
+        rep = json.load(f)
+    assert rep["fits"] is True and rep["devices"] == 8
+    res = run(str(tmp_path / "ckpt"), "--check", "--devices", "2")
+    assert res.returncode == 0 and "NOTE" in res.stdout
+    res = run(str(tmp_path / "ckpt"), "--check", "--devices", "7")
+    assert res.returncode == 1 and "PROBLEM" in res.stderr
+    # a directory with no plan is a usage error, not a crash
+    res = run(str(tmp_path))
+    assert res.returncode == 2
+
+
+def test_plan_explain_cli_is_jax_free(tmp_path):
+    """The CLI with --devices must never import jax (the login-host
+    contract): poison the import and run every mode."""
+    t = make_trainer(mlp_sym(), local_mesh("dp"))
+    plan_file = str(tmp_path / "plan.json")
+    t.sharding_plan.save(plan_file)
+    t.close()
+    poison = tmp_path / "jax.py"
+    poison.write_text("raise ImportError('jax poisoned for this test')")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path)
+    cli = os.path.join(REPO, "tools", "plan_explain.py")
+    res = subprocess.run(
+        [sys.executable, cli, plan_file, "--check", "--devices", "8"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "FITS" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# elastic resume: set_params re-sharding corners (satellite)
+# ---------------------------------------------------------------------------
+
+def _save(tmp_path, sym, mesh, nsteps=2, din=10, **kw):
+    t = make_trainer(sym, mesh, din=din, **kw)
+    X, y = batch(din=din)
+    for _ in range(nsteps):
+        t.step(X, y)
+    mgr = CheckpointManager(str(tmp_path))
+    t.save_checkpoint(mgr, nsteps, blocking=True)
+    want = {k: v.asnumpy() for k, v in t.get_params()[0].items()}
+    t.close()
+    return mgr, want
+
+
+def test_elastic_restore_shard_to_shard_bitwise(tmp_path):
+    """zero3 world=4 -> world=8: every shard-divisible param re-shards
+    (18 -> 9 rows of a 72-dim fc) and restores bit-identically."""
+    sym = mlp_sym(nh=72)
+    mgr, want = _save(tmp_path, sym, sub_mesh(4))
+    assert mgr.plan(2)["world"] == 4
+    b = make_trainer(sym, local_mesh("dp"), seed=99)
+    assert b.restore(mgr) == 2
+    w = b.params["fc1_weight"]
+    assert w.sharding.spec == ("dp", None)
+    assert w.addressable_shards[0].data.shape == (9, 10)
+    got = {k: v.asnumpy() for k, v in b.get_params()[0].items()}
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    # optimizer state re-sharded alongside
+    m = b.opt_state["fc1_weight"][0]
+    assert m.addressable_shards[0].data.shape == (9, 10)
+    b.step(*batch())  # training continues on the new world
+    b.close()
+
+
+def test_elastic_restore_uneven_remainder_falls_back_replicated(
+        tmp_path):
+    """A param dim that divided the OLD world but not the new one
+    (60 % 4 == 0, 60 % 8 != 0): sharded at save, REPLICATED at resume
+    — values still bit-identical, training still correct."""
+    sym = mlp_sym(nh=60)
+    mgr, want = _save(tmp_path, sym, sub_mesh(4))
+    a = make_trainer(sym, sub_mesh(4), seed=1)
+    assert a.params["fc1_weight"].sharding.spec == ("dp", None)
+    a.close()
+    b = make_trainer(sym, local_mesh("dp"), seed=99)
+    assert b.restore(mgr) == 2
+    from jax.sharding import PartitionSpec as P
+    assert b.params["fc1_weight"].sharding.spec == P()
+    assert "fc1_weight" not in b._zero3_dims
+    got = {k: v.asnumpy() for k, v in b.get_params()[0].items()}
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    b.step(*batch())
+    b.close()
+
+
+def test_elastic_restore_world_one_degenerate(tmp_path):
+    """world=4 zero3 checkpoint restores on a single-device trainer
+    (mesh=None): the fully-degenerate elastic case."""
+    sym = mlp_sym(nh=64)
+    mgr, want = _save(tmp_path, sym, sub_mesh(4))
+    b = SPMDTrainer(sym, "sgd", {"learning_rate": 0.3, "momentum": 0.9,
+                                 "rescale_grad": 1.0 / 64},
+                    mesh=None)
+    b.bind([("data", (64, 10))], [("softmax_label", (64,))])
+    mx.random.seed(99)
+    b.init_params(mx.initializer.Xavier())
+    assert b.restore(mgr) == 2
+    got = {k: v.asnumpy() for k, v in b.get_params()[0].items()}
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    b.step(*batch())
+    b.close()
+    # ...and the reverse: a single-device checkpoint restores sharded
+    mgr2, want2 = _save(tmp_path / "up", sym, None, grad_sync="allreduce")
+    c = make_trainer(sym, local_mesh("dp"), seed=98)
+    assert c.restore(mgr2) == 2
+    got2 = {k: v.asnumpy() for k, v in c.get_params()[0].items()}
+    for k in want2:
+        np.testing.assert_array_equal(want2[k], got2[k], err_msg=k)
+    c.close()
+
+
+def test_restore_param_added_or_removed_raises_clearly(tmp_path):
+    """A param added/removed between save and resume must raise with
+    NAMES — never silently keep init values or drop checkpoint values."""
+    mgr, _ = _save(tmp_path, mlp_sym(nh=64), sub_mesh(4))
+    # resume model grew a layer (fc3 exists in model, not in checkpoint)
+    grown = deep_sym(depth=2, nh=64)
+    b = make_trainer(grown, local_mesh("dp"), din=10, seed=9)
+    with pytest.raises(mx.MXNetError, match="added"):
+        b.restore(mgr)
+    b.close()
+    # resume model LOST a param (checkpoint has fc1/fc2, model only fc1)
+    data = mx.sym.Variable("data")
+    small = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc2"),
+        name="softmax")
+    c = make_trainer(small, local_mesh("dp"), seed=9)
+    with pytest.raises(mx.MXNetError, match="removed"):
+        c.restore(mgr)
+    c.close()
+
+
+def test_restore_states_missing_param_raises(tmp_path):
+    """An optimizer-state blob from a different model fails loudly in
+    set_states (stale state must not survive a resume silently)."""
+    t = make_trainer(mlp_sym(nh=64), sub_mesh(4))
+    t.step(*batch())
+    blob = t.get_states()
+    t.close()
+    import pickle
+    payload = pickle.loads(blob)
+    payload["states"].pop("fc1_weight")
+    b = make_trainer(mlp_sym(nh=64), local_mesh("dp"), seed=2)
+    with pytest.raises(mx.MXNetError, match="fc1_weight"):
+        b.set_states(pickle.dumps(payload))
+    b.close()
+
+
+def test_elastic_resume_logs_world_change(tmp_path, caplog):
+    mgr, _ = _save(tmp_path, mlp_sym(nh=64), sub_mesh(4))
+    b = make_trainer(mlp_sym(nh=64), local_mesh("dp"), seed=99)
+    with caplog.at_level(logging.INFO,
+                         logger="mxnet_tpu.parallel.trainer"):
+        b.restore(mgr)
+    assert any("elastic resume" in r.message and "world=4" in r.message
+               for r in caplog.records), caplog.text
+    b.close()

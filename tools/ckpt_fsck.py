@@ -26,6 +26,16 @@ data loss).
 Entries written before the integrity layer (no ``files`` records) are
 checked for existence only and reported under ``unverified``.
 
+PRE-RESUME PLAN GATE: ``--devices N`` [``--hbm BYTES``] additionally
+checks each entry's recorded sharding plan (``parallel/planner.py``,
+persisted by ``SPMDTrainer.save_checkpoint``) against that inventory —
+a world-size change is reported under ``plan_notes`` (elastic resume
+re-shards through ``set_params``), while an unsatisfiable mesh, an
+indivisible batch or a blown HBM budget FAILS the audit, so a
+resume on the wrong inventory is caught by fsck, not by an OOM or a
+partitioner crash mid-restore.  Same math as ``tools/plan_explain.py
+--check``.
+
 PROMOTE MODES (the train-to-serve hot-swap gate, docs/how_to/serving.md
 "Continuous deployment")::
 
@@ -193,10 +203,19 @@ def _check_entry(directory, entry):
             "primary_ok": primary_ok}
 
 
-def audit(directory, prefix="checkpoint"):
-    """Audit one checkpoint directory -> the JSON-serializable report."""
+def audit(directory, prefix="checkpoint", devices=None, hbm=None):
+    """Audit one checkpoint directory -> the JSON-serializable report.
+
+    With ``devices`` given, every manifest entry carrying a sharding
+    plan is additionally gated against that inventory
+    (``parallel.planner.check_inventory`` — the pre-resume
+    world-size/plan-mismatch check): hard misfits (unsatisfiable mesh
+    axes, an indivisible batch, a blown HBM budget) FAIL the
+    audit; a plain world change is reported per entry under
+    ``plan_notes`` without failing (elastic resume handles it)."""
     report = {"directory": os.path.abspath(directory), "prefix": prefix,
               "ok": True, "problems": [], "checkpoints": []}
+    planner = _load_planner() if devices is not None else None
     manifest_path = os.path.join(directory, "manifest.json")
     if not os.path.isdir(directory):
         report["ok"] = False
@@ -224,6 +243,20 @@ def audit(directory, prefix="checkpoint"):
         return report
     for entry in manifest.get("checkpoints", []):
         res = _check_entry(directory, entry)
+        if planner is not None:
+            plan_doc = entry.get("plan")
+            if plan_doc is None:
+                res["plan_notes"] = ["no sharding plan recorded — "
+                                     "inventory fit cannot be checked"]
+            else:
+                probs, notes = planner.check_inventory(
+                    plan_doc, devices, hbm_bytes=hbm)
+                if notes:
+                    res["plan_notes"] = notes
+                if probs:
+                    res["problems"].extend(
+                        "plan: %s" % p for p in probs)
+                    res["ok"] = False
         report["checkpoints"].append(res)
         if not res["ok"]:
             report["ok"] = False
@@ -232,21 +265,42 @@ def audit(directory, prefix="checkpoint"):
 
 # -- promote modes (the ONE verifier, shared with serving/deploy.py) -------
 
+def _stub_package(name, path):
+    """Install a synthetic package so submodules import WITHOUT the real
+    ``__init__`` executing (which would spin up an accelerator client)."""
+    if name in sys.modules:
+        return
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [path]
+    pkg.__spec__ = importlib.machinery.ModuleSpec(name, None,
+                                                  is_package=True)
+    pkg.__spec__.submodule_search_locations = pkg.__path__
+    sys.modules[name] = pkg
+
+
+def _pkg_root():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "mxnet_tpu")
+
+
 def _verify_promotion():
     """Import ``resilience.verify_promotion`` through a synthetic
     package stub — ``mxnet_tpu/__init__`` never executes, so this stays
     runnable where no accelerator runtime exists (the data_service
     worker / tools/fleet.py idiom)."""
-    if "mxnet_tpu" not in sys.modules:
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        pkg = types.ModuleType("mxnet_tpu")
-        pkg.__path__ = [os.path.join(root, "mxnet_tpu")]
-        pkg.__spec__ = importlib.machinery.ModuleSpec(
-            "mxnet_tpu", None, is_package=True)
-        pkg.__spec__.submodule_search_locations = pkg.__path__
-        sys.modules["mxnet_tpu"] = pkg
+    _stub_package("mxnet_tpu", _pkg_root())
     from mxnet_tpu.resilience import verify_promotion
     return verify_promotion
+
+
+def _load_planner():
+    """Import ``parallel.planner`` the same jax-free way (both package
+    ``__init__``s stubbed) for the ``--devices`` plan gate."""
+    _stub_package("mxnet_tpu", _pkg_root())
+    _stub_package("mxnet_tpu.parallel", os.path.join(_pkg_root(),
+                                                     "parallel"))
+    from mxnet_tpu.parallel import planner
+    return planner
 
 
 def _promote_gate(args):
@@ -320,12 +374,22 @@ def main(argv=None):
     parser.add_argument("--watch-count", type=int, default=None,
                         help="exit after reporting this many verdicts "
                              "(tests/CI; default: run until killed)")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="also gate each entry's recorded sharding "
+                             "plan against this device inventory (the "
+                             "pre-resume world-size/plan check; see "
+                             "tools/plan_explain.py --check)")
+    parser.add_argument("--hbm", type=int, default=None,
+                        help="per-device HBM budget in bytes for the "
+                             "--devices plan gate (default: each "
+                             "plan's recorded budget)")
     args = parser.parse_args(argv)
     if args.promote_gate:
         return _promote_gate(args)
     if args.watch:
         return _watch(args)
-    report = audit(args.directory, prefix=args.prefix)
+    report = audit(args.directory, prefix=args.prefix,
+                   devices=args.devices, hbm=args.hbm)
     payload = json.dumps(report, indent=2, sort_keys=True)
     if args.json:
         with open(args.json, "w") as f:

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Explain / gate a sharding plan (mxplan's operator CLI).
+
+::
+
+    python tools/plan_explain.py PLAN.json               # a saved plan file
+    python tools/plan_explain.py CKPT_DIR [--epoch N]    # a checkpoint's plan
+    python tools/plan_explain.py TARGET --check --devices 8 [--hbm BYTES]
+    python tools/plan_explain.py TARGET --json report.json
+
+``TARGET`` is either a plan JSON file (``ShardingPlan.save``) or a
+CheckpointManager directory whose manifest entries carry a ``plan``
+(written by ``SPMDTrainer.save_checkpoint``).  The default action
+prints ``ShardingPlan.explain()`` — mesh, strategy, per-param actions,
+gather groups and every decision with the byte model behind it.
+
+``--check`` is the PRE-RESUME GATE: exit 0 when the plan still fits the
+given device inventory, nonzero when it does not — unsatisfiable mesh
+axes, a batch the new dp axis cannot shard, or a
+blown HBM budget are hard problems; a plain world-size change prints as
+a NOTE and passes (gather-on-save checkpoints re-shard elastically
+through ``set_params``; docs/how_to/planner.md).  ``tools/ckpt_fsck.py
+--devices N`` runs the same check inside the full directory audit.
+
+``--devices N`` names the inventory explicitly (required for
+``--check`` on a jax-free host); without it the CLI asks jax — the
+ONLY path that touches an accelerator runtime.
+
+Deliberately jax-free by default: ``mxnet_tpu.parallel.planner`` is
+imported through synthetic package stubs (the mxlint/ckpt_fsck idiom)
+so ``mxnet_tpu/__init__`` and ``parallel/__init__`` never execute and
+no XLA client is created — auditing a plan must work on the login host,
+not just the pod.
+"""
+import argparse
+import importlib.machinery
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_planner():
+    """Import ``mxnet_tpu.parallel.planner`` without executing either
+    package ``__init__`` (both would spin up jax)."""
+    for name, path in (("mxnet_tpu", os.path.join(_REPO, "mxnet_tpu")),
+                       ("mxnet_tpu.parallel",
+                        os.path.join(_REPO, "mxnet_tpu", "parallel"))):
+        if name in sys.modules:
+            continue
+        pkg = types.ModuleType(name)
+        pkg.__path__ = [path]
+        pkg.__spec__ = importlib.machinery.ModuleSpec(
+            name, None, is_package=True)
+        pkg.__spec__.submodule_search_locations = pkg.__path__
+        sys.modules[name] = pkg
+    from mxnet_tpu.parallel import planner
+    return planner
+
+
+def _load_plan_doc(target, epoch=None, prefix="checkpoint"):
+    """(doc, origin) from a plan file or a checkpoint directory's
+    manifest.  Raises ValueError with a message on anything unreadable."""
+    if os.path.isdir(target):
+        manifest = os.path.join(target, "manifest.json")
+        try:
+            with open(manifest) as f:
+                man = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError("cannot read %s: %s" % (manifest, e))
+        if man.get("prefix") and man["prefix"] != prefix:
+            raise ValueError(
+                "manifest in %r belongs to prefix %r (asked for %r) — "
+                "pass --prefix %s" % (target, man["prefix"], prefix,
+                                      man["prefix"]))
+        entries = [e for e in man.get("checkpoints", [])
+                   if e.get("plan") is not None]
+        if not entries:
+            raise ValueError(
+                "no manifest entry in %r carries a sharding plan (the "
+                "run predates mxplan, or saved without save_checkpoint)"
+                % target)
+        if epoch is not None:
+            entries = [e for e in entries
+                       if int(e["epoch"]) == int(epoch)]
+            if not entries:
+                raise ValueError("epoch %d has no plan in %r"
+                                 % (epoch, target))
+        entry = max(entries, key=lambda e: int(e["epoch"]))
+        return entry["plan"], "%s (epoch %d)" % (target,
+                                                 int(entry["epoch"]))
+    try:
+        with open(target) as f:
+            return json.load(f), target
+    except (OSError, ValueError) as e:
+        raise ValueError("cannot read plan file %r: %s" % (target, e))
+
+
+def _inventory(args):
+    """Device count for --check: --devices wins; otherwise ask jax (the
+    only accelerator-touching path)."""
+    if args.devices is not None:
+        return int(args.devices)
+    try:
+        import jax
+        return len(jax.devices())
+    except Exception as e:  # noqa: BLE001 — no runtime on this host
+        raise ValueError(
+            "no --devices given and jax is unavailable here (%s) — pass "
+            "--devices N" % e)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Explain a sharding plan, or gate it against the "
+                    "current device inventory (--check).")
+    parser.add_argument("target",
+                        help="plan JSON file or checkpoint directory")
+    parser.add_argument("--epoch", type=int, default=None,
+                        help="checkpoint epoch (directory targets; "
+                             "default: newest with a plan)")
+    parser.add_argument("--prefix", default="checkpoint",
+                        help="checkpoint prefix for directory targets")
+    parser.add_argument("--check", action="store_true",
+                        help="gate: exit 0 iff the plan fits the device "
+                             "inventory (world changes are notes, not "
+                             "failures)")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="device count to check against (default: "
+                             "ask jax — requires a runtime)")
+    parser.add_argument("--hbm", type=int, default=None,
+                        help="per-device HBM budget in bytes for "
+                             "--check (default: the plan's recorded "
+                             "budget, if any)")
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="also write a machine-readable report")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the stdout explanation")
+    args = parser.parse_args(argv)
+
+    planner = _load_planner()
+    try:
+        doc, origin = _load_plan_doc(args.target, epoch=args.epoch,
+                                     prefix=args.prefix)
+    except ValueError as e:
+        sys.stderr.write("plan_explain: %s\n" % e)
+        return 2
+
+    report = {"origin": origin, "checked": bool(args.check)}
+    try:
+        sp = planner.ShardingPlan.from_doc(doc)
+    except Exception as e:  # noqa: BLE001 — version/shape problems
+        report["problems"] = [str(e)]
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+        sys.stderr.write("plan_explain: %s\n" % e)
+        return 1
+    report["digest"] = sp.digest()
+    report["world"] = sp.world
+    report["grad_sync"] = sp.grad_sync
+
+    rc = 0
+    if not args.quiet:
+        print("plan: %s" % origin)
+        print(sp.explain())
+    if args.check:
+        try:
+            ndev = _inventory(args)
+        except ValueError as e:
+            sys.stderr.write("plan_explain: %s\n" % e)
+            return 2
+        problems, notes = sp.check_inventory(ndev, hbm_bytes=args.hbm)
+        report.update({"devices": ndev, "problems": problems,
+                       "notes": notes, "fits": not problems})
+        for n in notes:
+            print("plan_explain: NOTE: %s" % n)
+        for p in problems:
+            sys.stderr.write("plan_explain: PROBLEM: %s\n" % p)
+        print("plan_explain: %s on %d device(s)"
+              % ("FITS" if not problems else "DOES NOT FIT", ndev))
+        rc = 0 if not problems else 1
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
