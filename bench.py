@@ -189,11 +189,15 @@ def _compute_bench(trainer, batch, steps, warmup, trials,
     return batch * _steps_per_sec(trainer, staged, s1, s1 + steps, trials)
 
 
-def _make_dataset(n_img, side=256):
+def _make_dataset(n_img, side=256, classes=1000):
     """Synthetic RecordIO dataset with natural-image-like JPEG statistics
     (smooth gradients + low-frequency texture; ~13 KB/img at q90, in line
     with 256x256 photographic JPEGs — NOT white noise, which carries ~4x
-    the entropy and decodes several times slower than any real photo)."""
+    the entropy and decodes several times slower than any real photo).
+    ``classes`` bounds the labels: a consumer training a small head must
+    ask for a matching range — out-of-range labels under SoftmaxOutput
+    one-hot to a ZERO row, so every such example pushes all logits down
+    and the fed loop diverges (the fed-cpu guard abort on this host)."""
     import tempfile
 
     import cv2
@@ -212,7 +216,7 @@ def _make_dataset(n_img, side=256):
         base = (np.outer(xs, np.roll(xs, (i * 37) % side))[..., None]
                 * np.array([255, 180, 120])).astype(np.float32)
         img = np.clip(base + tex_bank[i % 16], 0, 255).astype(np.uint8)
-        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        header = recordio.IRHeader(0, float(i % classes), i, 0)
         rec.write_idx(i, recordio.pack_img(header, img, quality=90))
     rec.close()
     return prefix
@@ -410,7 +414,10 @@ def _data_service_bench(batch=128, n_img=1024, trials=2):
     # posix_fadvise window off — the before/after of
     # MXTPU_DATA_READAHEAD (page-cache-warm hosts show ~0; cold/remote
     # storage is where the window pays)
-    ra_prev = os.environ.get("MXTPU_DATA_READAHEAD")
+    # deliberate RAW env save/restore (not get_env): the restore must
+    # distinguish "operator never set it" (pop) from an explicit value,
+    # and get_env cannot — it substitutes the registered default
+    ra_prev = os.environ.get("MXTPU_DATA_READAHEAD")  # mxlint: disable=env-direct-read
     os.environ["MXTPU_DATA_READAHEAD"] = "0"   # workers inherit env
     try:
         ra_off, _ = measure(mx.io.ImageRecordIter(
@@ -604,7 +611,8 @@ def _fed_cpu_bench(batch=64, steps=40, warmup=8, trials=3):
     import mxnet_tpu as mx
     from mxnet_tpu.parallel import SPMDTrainer
 
-    prefix = _make_dataset(512, side=96)
+    # labels bounded to THIS net's 10-class head (see _make_dataset)
+    prefix = _make_dataset(512, side=96, classes=10)
     shape = (3, 64, 64)
 
     data = mx.sym.Variable("data")
@@ -621,10 +629,16 @@ def _fed_cpu_bench(batch=64, steps=40, warmup=8, trials=3):
     net = mx.sym.SoftmaxOutput(net, name="softmax")
 
     def make_it(host):
+        # mean/std normalization: raw 0-255 pixels into an SGD step at
+        # lr 0.01 diverge to non-finite weights within the warmup on
+        # this host (the step guard then aborts the bench) — normalized
+        # inputs keep the measured work identical and the loop stable
         return mx.io.ImageRecordIter(
             path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
             data_shape=shape, batch_size=batch, shuffle=True,
             rand_crop=True, rand_mirror=True, preprocess_threads=2,
+            mean_r=127.0, mean_g=127.0, mean_b=127.0,
+            std_r=60.0, std_g=60.0, std_b=60.0,
             prefetch_buffer=4, dtype="float32", seed=0, host_batches=host)
 
     trainer = SPMDTrainer(
@@ -1187,9 +1201,216 @@ def _roofline_bench(preset=None, trials=None):
            timeit(unfused_fa, q, k, v),
            RL.workload("flash_attention", b=Bq, t=T, heads=Hh, d=D))
 
+    # -- eltwise_chain: relu -> scale -> add -> tanh run --------------
+    Ne, Ce, HWe = (4, 16, 28 * 28) if small else (16, 32, 56 * 56)
+    xe = jnp.asarray(rs.rand(Ne, Ce, HWe).astype("f"))
+    ye = jnp.asarray(rs.rand(Ne, Ce, HWe).astype("f"))
+    relu_j = jax.jit(jax.nn.relu)
+    scale_j = jax.jit(lambda v: v * 0.125)
+    add_j = jax.jit(jnp.add)
+    tanh_j = jax.jit(jnp.tanh)
+
+    def unfused_chain(x, y):
+        return tanh_j(add_j(scale_j(relu_j(x)), y))
+
+    fused_chain = jax.jit(
+        lambda x, y: jnp.tanh(jax.nn.relu(x) * 0.125 + y))
+    record("eltwise_chain",
+           timeit(fused_chain, xe, ye),
+           timeit(unfused_chain, xe, ye),
+           RL.workload("eltwise_chain", n=Ne, c=Ce, hw=HWe, depth=4))
+
+    # -- concat_fuse: sibling 1x1 tower heads as ONE GEMM -------------
+    Nc, Cc, Hc = (2, 64, 14) if small else (8, 192, 28)
+    widths = (16, 16, 24) if small else (64, 64, 96)
+    xc = jnp.asarray(rs.randn(Nc, Cc, Hc, Hc).astype("f"))
+    wsc = [jnp.asarray(rs.randn(w, Cc, 1, 1).astype("f") * 0.1)
+           for w in widths]
+    conv1 = jax.jit(lambda x, w: jax.nn.relu(
+        jax.lax.conv_general_dilated(x, w, (1, 1), "VALID")))
+
+    def unfused_cc(x, w1, w2, w3):
+        return conv1(x, w1), conv1(x, w2), conv1(x, w3)
+
+    o1, o2 = widths[0], widths[0] + widths[1]
+
+    @jax.jit
+    def fused_cc(x, w1, w2, w3):
+        m = jax.nn.relu(jax.lax.conv_general_dilated(
+            x, jnp.concatenate([w1, w2, w3], axis=0), (1, 1), "VALID"))
+        return m[:, :o1], m[:, o1:o2], m[:, o2:]
+
+    record("concat_fuse",
+           timeit(fused_cc, xc, *wsc),
+           timeit(unfused_cc, xc, *wsc),
+           RL.workload("concat_fuse", n=Nc, c=Cc, hw=Hc * Hc,
+                       widths=list(widths)))
+
+    # -- pool_act: act->max-pool reordered to pool-first --------------
+    Np, Cp, Hp = (4, 16, 56) if small else (16, 64, 112)
+    xp = jnp.asarray(rs.randn(Np, Cp, Hp, Hp).astype("f"))
+    pool_j = jax.jit(lambda v: NN.pooling(
+        v, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max"))
+
+    def unfused_pa(x):
+        return pool_j(relu_j(x))
+
+    fused_pa = jax.jit(lambda v: NN.activation(NN.pooling(
+        v, kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max"),
+        act_type="relu"))
+    record("pool_act",
+           timeit(fused_pa, xp),
+           timeit(unfused_pa, xp),
+           RL.workload("pool_act", n=Np, c=Cp, hw=Hp * Hp, stride=2))
+
     out["roofline_all_win"] = all(
         out["roofline_%s_win" % op]
-        for op in ("bn_act", "lstm_cell", "flash_attention"))
+        for op in ("bn_act", "lstm_cell", "flash_attention",
+                   "eltwise_chain", "concat_fuse", "pool_act"))
+
+    # -- whole-model proof: inception-bn forward, new passes on vs off
+    out.update(_roofline_inception(small, trials))
+    return out
+
+
+#: the pre-mxfuse kernel set — the "new passes off" baseline the
+#: inception stanza (and the headline inception-gap claim) compares
+#: against; bn_act/bn_fold stay ON both sides
+_PRE_MXFUSE_KERNELS = "bn_act,bn_fold,lstm_cell,flash_attention,augment"
+
+
+def _small_inception():
+    """A trimmed inception-bn (stem + one A tower + one B tower) for
+    the small roofline preset — the same patterns every pass matches
+    (merge trio, grouped 3x3 siblings, act→pool stem, avg-pool
+    branch) at test-tier compile cost."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.inception_bn import (ConvFactory,
+                                               InceptionFactoryA,
+                                               InceptionFactoryB)
+    data = mx.sym.Variable("data")
+    c1 = ConvFactory(data, 16, (3, 3), pad=(1, 1), name="conv1")
+    p1 = mx.sym.Pooling(c1, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                        pool_type="max", name="pool1")
+    a = InceptionFactoryA(p1, 16, 16, 24, 16, 24, "avg", 16, "3a")
+    b = InceptionFactoryB(a, 16, 24, 16, 24, "3c")
+    flat = mx.sym.Flatten(mx.sym.Pooling(
+        b, global_pool=True, kernel=(1, 1), pool_type="avg"))
+    fc = mx.sym.FullyConnected(flat, num_hidden=10, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _roofline_inception(small, trials):
+    """The mxfuse headline measurement (ISSUE 15 / ROADMAP item 5):
+    inception-bn FORWARD throughput through the real executor with the
+    plan-optimizer passes ON (default env) vs OFF (the pre-mxfuse
+    kernel set — bn_act/bn_fold still on, so the delta is the NEW
+    passes only), plus the infer_trace satellite: eval-trace build
+    time with dead-node elimination on vs off (the pruned plan skips
+    tracing every conv a fold replaced).
+
+    Both executors are bound first and the timing windows INTERLEAVE
+    on/off (best-of): sequential measurement on this host drifts by
+    more than the effect under test (page cache, frequency ramp), and
+    interleaving cancels it.  The small preset measures a trimmed
+    inception (same patterns, test-tier compile cost)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import _build_eval
+    from mxnet_tpu.kernels import KNOWN_KERNELS
+    from mxnet_tpu.models import inception_bn
+
+    shape = (2, 3, 32, 32) if small else (8, 3, 96, 96)
+    steps = 2 if small else 5
+    windows = 2 if small else 7
+    sym = _small_inception() if small \
+        else inception_bn.get_symbol(num_classes=100)
+
+    def bind(env):
+        os.environ["MXTPU_FUSED_KERNELS"] = env
+        ex = sym.simple_bind(mx.cpu(), grad_req="null", data=shape)
+        rs_i = np.random.RandomState(0)
+        for name in sorted(ex.arg_dict):
+            if name in ("data", "softmax_label"):
+                continue
+            arr = ex.arg_dict[name]
+            arr[:] = (rs_i.rand(*arr.shape).astype("f") - 0.5) * 0.2
+        for name in ex.aux_dict:
+            ex.aux_dict[name][:] = 1.0 if name.endswith("var") else 0.0
+        ex.arg_dict["data"][:] = rs_i.rand(*shape).astype("f")
+        return ex
+
+    def window(ex):
+        tic = time.perf_counter()
+        for _ in range(steps):
+            outs = ex.forward()
+        outs[0].asnumpy()                          # completion barrier
+        return (time.perf_counter() - tic) / steps
+
+    prev = os.environ.get("MXTPU_FUSED_KERNELS")  # mxlint: disable=env-direct-read
+    out = {}
+    try:
+        ex_on = bind("1")
+        ex_off = bind(_PRE_MXFUSE_KERNELS)
+        ex_on.forward()[0].asnumpy()               # compile + warm
+        ex_off.forward()[0].asnumpy()
+        best_on = best_off = float("inf")
+        for _ in range(max(1, windows)):
+            best_on = min(best_on, window(ex_on))
+            best_off = min(best_off, window(ex_off))
+        ex_on.close()
+        ex_off.close()
+        on_rate, off_rate = shape[0] / best_on, shape[0] / best_off
+        out["roofline_inception_fwd_on_img_s"] = round(on_rate, 2)
+        out["roofline_inception_fwd_off_img_s"] = round(off_rate, 2)
+        out["roofline_inception_fwd_x"] = round(on_rate / off_rate, 3)
+        out["roofline_inception_fwd_win"] = bool(on_rate >= off_rate)
+
+        # infer_trace: eval-trace build time (plan interpretation +
+        # jaxpr trace) with the pruned plan vs the full fused plan
+        args = {n: np.zeros(s, np.float32) for n, s in zip(
+            sym.list_arguments(),
+            sym.infer_shape(data=shape)[0])}
+        auxs = {n: np.zeros(s, np.float32) for n, s in zip(
+            sym.list_auxiliary_states(),
+            sym.infer_shape(data=shape)[2])}
+        rng = jax.random.PRNGKey(0)
+
+        def trace_once(env):
+            os.environ["MXTPU_FUSED_KERNELS"] = env
+            tic = time.perf_counter()
+            eval_fn = _build_eval(sym)
+            jax.make_jaxpr(
+                lambda a, x, r: eval_fn(a, x, r, False))(args, auxs,
+                                                         rng)
+            return time.perf_counter() - tic
+
+        no_prune = ",".join(k for k in KNOWN_KERNELS
+                            if k != "infer_trace")
+        # same discipline as the forward stanza: warm BOTH paths once
+        # untimed (the first trace pays jax tracing-machinery warmup
+        # for this program size), then INTERLEAVE best-of windows —
+        # sequential on-then-off measurement drifts by more than the
+        # ~10-20% effect on a ~0.2s quantity (the r06 dry run measured
+        # the on path first-and-cold and "lost" for exactly that
+        # reason)
+        trace_once("1")
+        trace_once(no_prune)
+        on_s = off_s = float("inf")
+        for _ in range(3 if small else 5):
+            off_s = min(off_s, trace_once(no_prune))
+            on_s = min(on_s, trace_once("1"))
+        out["roofline_infer_trace_on_s"] = round(on_s, 3)
+        out["roofline_infer_trace_off_s"] = round(off_s, 3)
+        out["roofline_infer_trace_x"] = round(off_s / on_s, 3) \
+            if on_s else None
+        out["roofline_infer_trace_win"] = bool(off_s >= on_s)
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_FUSED_KERNELS", None)
+        else:
+            os.environ["MXTPU_FUSED_KERNELS"] = prev
     return out
 
 
@@ -2421,7 +2642,9 @@ def _collect(mode, timeout=480, extra_env=None):
     overhead grows from ~2.5 ms to ~30 ms).  Fresh sessions give every
     metric the steady-state it would have in a real training job.
     ``extra_env`` overlays the child environment (the compile-cache
-    probes point both runs at one cache directory this way).
+    probes point both runs at one cache directory this way); a value
+    of ``None`` REMOVES the variable from the child (the resume mode
+    strips an operator's global ``MXTPU_COMPILE_CACHE``, see main()).
 
     Isolation (the BENCH_r05 regression, ROADMAP item 5): a metric that
     hits its budget must cost THAT metric, never the run.  The child is
@@ -2437,7 +2660,11 @@ def _collect(mode, timeout=480, extra_env=None):
     import subprocess
     env = dict(os.environ)
     env["BENCH_MODE"] = mode
-    env.update(extra_env or {})
+    for k, v in (extra_env or {}).items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             text=True, env=env, start_new_session=True)
@@ -2478,6 +2705,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "data_service_img_s", "data_service_scaling_x",
              "data_net_img_s", "data_net_scaling_x",
              "pipeline_decode_scaling_x", "roofline_*_speedup",
+             "roofline_inception_fwd_x", "roofline_infer_trace_x",
+             "inception_gap_frac",
              "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
              "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff",
              "hotswap_drop_free", "hotswap_swap_ms",
@@ -2506,6 +2735,19 @@ SCALING_SHAPE_KEYS = {
     # hosts note it and only the SHAPE key is exempted
     "fleet_qps_x": "fleet_scaling_note",
 }
+
+#: keys whose absolute value is a property of the ACCELERATOR tier the
+#: round ran on (the fed/compute/model-sweep throughputs).  The gate
+#: compares them only when baseline and new artifact ran the SAME
+#: device tier (``device_kind``): a CPU round "regressing" a TPU
+#: round's img/s is a hardware swap, not a code regression — and
+#: blessing it would be just as wrong as blocking it.  Skipped keys
+#: are listed LOUDLY in the report (``skipped_device_tier_change``);
+#: same-tier rounds always gate, so the rule can neither mask nor fake
+#: a regression within a tier.  Ratio/host-side keys always gate.
+DEVICE_TIER_KEYS = frozenset((
+    "value", "compute_img_s", "compute_large_img_s",
+    "inception_bn_img_s", "resnet152_img_s", "lstm_tok_s"))
 
 
 def _gate_payload(path):
@@ -2594,8 +2836,17 @@ def gate(new_path, against=None, tolerance=0.10):
         return {"pass": False, "error": "baseline %s holds no parsed "
                 "result" % base_path}
     regressions, checked, skipped = [], [], []
+    tier_skipped = []
+    base_tier = base.get("device_kind")
+    new_tier = new.get("device_kind")
+    tier_changed = base_tier != new_tier
     structural = ("flat_by_construction", "unavailable")
     for key in sorted(_match_gate_keys(base)):
+        if key in DEVICE_TIER_KEYS and tier_changed:
+            # accelerator-tier throughputs are only comparable within
+            # one device tier — a changed tier is recorded, not gated
+            tier_skipped.append(key)
+            continue
         note = SCALING_SHAPE_KEYS.get(key)
         if note is not None and (
                 str(base.get(note, "")).startswith(structural)
@@ -2626,6 +2877,10 @@ def gate(new_path, against=None, tolerance=0.10):
               "regressions": regressions}
     if skipped:
         report["skipped_flat_by_construction"] = skipped
+    if tier_skipped:
+        report["skipped_device_tier_change"] = {
+            "keys": tier_skipped,
+            "baseline_device": base_tier, "new_device": new_tier}
     if new.get("incomplete"):
         report["incomplete_modes"] = sorted(new["incomplete"])
     return report
@@ -2690,21 +2945,42 @@ def main():
             parts["compile_cold_s"] = cold["compile_bringup_s"]
         if "compile_bringup_s" in warm:
             parts["compile_warm_s"] = warm["compile_bringup_s"]
-        parts.update(_collect("resume"))
+        # the resume drill runs WITHOUT any operator-set global compile
+        # cache: jax's persistent compilation cache segfaults this
+        # backend's save/restore/second-trainer sequence (glibc heap
+        # corruption; reproduced on the pre-mxfuse tree, so pre-existing
+        # upstream, not a harness property).  Stripping the var costs
+        # resume its warm-relaunch amortization — an honesty note, not a
+        # masked failure: the mode still measures the full recompile.
+        parts.update(_collect("resume",
+                              extra_env={"MXTPU_COMPILE_CACHE": None}))
         parts.update(_collect("checkpoint"))
         parts.update(_collect("serve"))
         parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
-        parts.update(_collect("roofline"))
+        # the mxfuse whole-model stanza compiles inception twice
+        parts.update(_collect("roofline", timeout=600))
         parts.update(_collect("zero3"))
         parts.update(_collect("plan"))
-        parts.update(_collect("fed"))
+        # CPU-tier hosts pay the cold resnet-50 fwd+bwd XLA compile
+        # (up to ~20 min) inside this mode before the first step runs
+        # (cold on purpose — see the compute stanza below)
+        parts.update(_collect("fed", timeout=1800))
     parts.update(_collect("analyze", timeout=240))
-    parts.update(_collect("compute"))
+    # the model compiles dominate these modes on CPU-tier hosts: a cold
+    # resnet-50 fwd+bwd build runs ~20 min before the first step, so
+    # the budgets assume COLD compiles.  Deliberately not amortized via
+    # MXTPU_COMPILE_CACHE: on this backend executables LOADED from the
+    # persistent cache compute garbage (non-finite training steps,
+    # occasional heap corruption) even though compile-and-run in one
+    # process is fine — reproduced on the pre-mxfuse tree, see
+    # docs/how_to/performance.md "Persistent compile cache"
+    parts.update(_collect("compute", timeout=1800))
     if os.environ.get("BENCH_SWEEP", "1") != "0":
-        parts.update(_collect("compute-large"))
-        parts.update(_collect("inception-bn"))
-        parts.update(_collect("resnet-152"))
+        parts.update(_collect("compute-large", timeout=2400))
+        parts.update(_collect("inception-bn", timeout=1800))
+        # the deepest compile of the sweep: >40 min cold on this tier
+        parts.update(_collect("resnet-152", timeout=3600))
         parts.update(_collect("lstm"))
 
     # pull timed-out/failed models aside so the numeric consumers below
@@ -2786,6 +3062,13 @@ def main():
         result["inception_bn_img_s"] = parts["inception-bn"]
         result["inception_bn_vs_baseline"] = round(
             parts["inception-bn"] / 152.0, 3)
+        if compute:
+            # the mxfuse headline metric (ROADMAP item 5): inception's
+            # speedup-over-its-K80-baseline as a fraction of resnet50's
+            # (r04: 61.4x / 137.1x = 0.448) — the plan-optimizer passes
+            # exist to narrow this gap, and the gate holds the ratio
+            result["inception_gap_frac"] = round(
+                (parts["inception-bn"] / 152.0) / (compute / 109.0), 3)
     if "resnet-152" in parts:
         result["resnet152_img_s"] = parts["resnet-152"]
         result["resnet152_vs_baseline"] = round(

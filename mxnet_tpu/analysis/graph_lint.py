@@ -38,6 +38,15 @@ of the invariants the runtime relies on:
   registered ``custom_vjp``/``custom_jvp`` — Pallas has no reverse-mode
   transpose, so a differentiated step reaching it dies at trace time
   (or the op is silently forward-only); rtc.py documents the contract.
+- ``plan-fusion-parity``: the mxfuse plan-optimizer rewrite for a
+  symbol must keep the plain-plan monitored path intact — every pass
+  may only FILL override slots: entry count, node identity/order and
+  slots 0-4 (attrs, output counts, aux names, RNG fold positions) must
+  be byte-identical to the unoptimized plan, no extra ref may read a
+  value-rewritten passthrough, and the original plan object must be
+  left untouched (monitored runs interpret it verbatim).
+  ``audit_plan_fusion(symbol)`` is the check; ``trainer.analyze()``
+  and ``PooledModel.analyze()`` run it on their bound symbols.
 
 All jax imports are function-local so importing this module costs
 nothing in host-only contexts (the AST level and the CLI).
@@ -51,8 +60,9 @@ from .report import Finding, Report
 __all__ = ["iter_eqns", "find_callbacks", "audit_dtype", "audit_donation",
            "collective_stats", "audit_collectives",
            "audit_collective_schedule", "find_unprotected_pallas",
-           "lint_lowered", "lint_jit", "CALLBACK_PRIMITIVES",
-           "COLLECTIVE_OPS", "PALLAS_PRIMITIVES", "RS_PLATFORMS"]
+           "audit_plan_fusion", "lint_lowered", "lint_jit",
+           "CALLBACK_PRIMITIVES", "COLLECTIVE_OPS", "PALLAS_PRIMITIVES",
+           "RS_PLATFORMS"]
 
 #: jaxpr primitives that re-enter the host mid-step
 CALLBACK_PRIMITIVES = frozenset((
@@ -492,6 +502,121 @@ def audit_collective_schedule(stats, schedule, expect_gather_bytes,
             "reduce-scatter — %s" % (schedule, why),
             data={"reduce_scatter": rs, "platform": platform}))
     return findings
+
+
+def audit_plan_fusion(symbol):
+    """The ``plan-fusion-parity`` rule: run the mxfuse pipeline over
+    ``symbol``'s node plan (under the CURRENT ``MXTPU_FUSED_KERNELS``)
+    and verify every override kept the plain-plan monitored contract.
+
+    Checks (docs/how_to/performance.md "The plan optimizer"):
+
+    1. the pipeline neither raises nor mutates the plain plan — the
+       monitored path interprets that exact object;
+    2. the rewritten plan is a PERMUTATION of the plain entries (none
+       added or dropped) with byte-identical slots 0-4 — per-node RNG
+       fold constants and monitor coordinates ride IN the entries, so
+       identity must hold while interpretation order may be re-sorted
+       — and the order is topologically valid for the post-override
+       dependency graph (op-node values exist before an entry reads
+       them; variables bind lazily);
+    3. every override is ``(callable, [(plan-node, int)], dead-ins)``
+       and no extra ref reads a value-rewriting passthrough (its env
+       value is not that node's output);
+    4. inference-trace pruning (``live_entries``) keeps every graph
+       output and every extra-ref producer interpretable.
+
+    Returns a :class:`Report`; violations are rule
+    ``plan-fusion-parity``.
+    """
+    import copy
+
+    from .. import mxfuse
+    from ..executor import _node_plan
+
+    rep = Report(tool="mxlint.graph")
+
+    def flag(msg):
+        rep.add("plan-fusion-parity", msg)
+
+    plan = _node_plan(symbol)
+    out_refs = [(id(n), i) for n, i in symbol._outputs]
+    before = [(id(e[0]),) + tuple(copy.deepcopy(e[1:5])) for e in plan]
+    try:
+        fused = mxfuse.optimize_plan(plan, out_refs)
+    except Exception as e:  # noqa: BLE001 — a broken pass IS the finding
+        flag("pass pipeline raised %s: %s" % (type(e).__name__, e))
+        return rep
+    after = [(id(e[0]),) + tuple(e[1:5]) for e in plan]
+    if before != after:
+        flag("pass pipeline MUTATED the plain plan — monitored runs "
+             "interpret that object verbatim")
+    if fused is plan:
+        rep.stats["plan_fusion"] = {"overrides": 0,
+                                    "entries": len(plan)}
+        return rep
+    if len(fused) != len(plan):
+        flag("rewritten plan has %d entries, plain plan %d — passes "
+             "must never add or drop entries (per-node RNG fold "
+             "constants travel with them)" % (len(fused), len(plan)))
+        return rep
+    plain_of = {id(e[0]): e for e in plan}
+    if {id(e[0]) for e in fused} != set(plain_of):
+        flag("rewritten plan is not a permutation of the plain "
+             "entries — nodes were substituted")
+        return rep
+    n_overrides = 0
+    seen = set()
+    for fe in fused:
+        pe = plain_of[id(fe[0])]
+        if tuple(fe[1:5]) != tuple(pe[1:5]):
+            flag("entry %r changed outside the override slot"
+                 % fe[0].name)
+        ov = fe[5]
+        if ov is None:
+            continue
+        n_overrides += 1
+        if not callable(ov[0]) or not isinstance(ov[1], (list, tuple)):
+            flag("override at %r is not (callable, refs, ...)"
+                 % fe[0].name)
+            continue
+        for ref in ov[1]:
+            if id(ref[0]) not in plain_of:
+                flag("override at %r references a node outside the "
+                     "plan" % fe[0].name)
+    # interpretation-order validity: an entry's op-node dependencies
+    # (inputs + override extra refs) must already be interpreted when
+    # it runs; variables bind lazily
+    for fe in fused:
+        node, ov = fe[0], fe[5]
+        refs = list(node.inputs or ())
+        if ov is not None:
+            refs += list(ov[1])
+        for src, _idx in refs:
+            if id(src) in plain_of and src.op is not None \
+                    and id(src) not in seen:
+                flag("entry %r runs before its dependency %r — the "
+                     "rewritten order is not topologically valid"
+                     % (node.name, src.name))
+                return rep
+        seen.add(id(node))
+    live = mxfuse.live_entries(fused, out_refs)
+    live_ids = {id(e[0]) for e in live}
+    for nid, _i in out_refs:
+        if nid not in live_ids:
+            flag("inference-trace pruning dropped a graph output")
+    for e in live:
+        ov = e[5]
+        if ov is None:
+            continue
+        for src, _idx in ov[1]:
+            if id(src) not in live_ids and src.op is not None:
+                flag("pruned eval plan drops op node %r that an "
+                     "override's extra refs read" % src.name)
+    rep.stats["plan_fusion"] = {"overrides": n_overrides,
+                                "entries": len(plan),
+                                "eval_live": len(live)}
+    return rep
 
 
 def lint_lowered(lowered, closed_jaxpr=None, compute_dtype=None,

@@ -247,7 +247,9 @@ ENV_DATA_SERVERS = register_env(
 # at trace/bind time (docs/how_to/kernels.md).
 ENV_FUSED_KERNELS = register_env(
     "MXTPU_FUSED_KERNELS", default="1",
-    doc="Fused-kernel routing (mxnet_tpu/kernels/): 1 = all fused "
-        "kernels on (default), 0 = exact pre-fusion graphs, or a "
-        "comma list from {bn_act, bn_fold, lstm_cell, flash_attention, "
-        "augment} to enable individually (docs/how_to/kernels.md)")
+    doc="Fused-kernel + plan-optimizer routing (mxnet_tpu/kernels/, "
+        "mxnet_tpu/mxfuse.py): 1 = everything on (default), 0 = exact "
+        "pre-fusion graphs, or a comma list from {bn_act, bn_fold, "
+        "lstm_cell, flash_attention, augment, concat_fuse, pool_act, "
+        "eltwise_chain, infer_trace} to enable individually "
+        "(docs/how_to/kernels.md)")

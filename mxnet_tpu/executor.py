@@ -65,11 +65,13 @@ def _node_plan(symbol):
     test suite's earlier tests silently changed later seeded runs.
 
     Slot 6 is an optional fusion override, ``None`` or ``(fn,
-    extra_refs)``: the interpreter then calls ``fn`` instead of the
-    node's op, appending the values of ``extra_refs`` ((src_node, idx)
-    pairs) to the node's own inputs — how the BN+activation fusion pass
-    (:func:`_fuse_bn_plan`) reroutes node pairs without renumbering the
-    plan (RNG fold constants stay put)."""
+    extra_refs, eval_dead_ins)``: the interpreter then calls ``fn``
+    instead of the node's op, appending the values of ``extra_refs``
+    ((src_node, idx) pairs) to the node's own inputs — how the mxfuse
+    plan-optimizer passes (:mod:`mxnet_tpu.mxfuse`) rewrite node groups
+    without renumbering the plan (RNG fold constants stay put);
+    ``eval_dead_ins`` feeds the inference-trace dead-node
+    elimination."""
     plan = []
     for ix, node in enumerate(symbol._nodes()):
         if node.is_variable:
@@ -89,116 +91,15 @@ def _node_plan(symbol):
     return plan
 
 
-#: Activation types the BN+activation fusion accepts (the fused kernel's
-#: lax tier covers every registered act_type; the Pallas tier narrows
-#: further internally and falls back to lax for the rest)
-_FUSABLE_ACTS = ("relu", "sigmoid", "tanh", "softrelu", "softsign")
-
-
-def _make_fused_bn_fn(act_type, conv_attrs):
-    """The override body for one fused BatchNorm site.
-
-    Training: fused normalize+scale/shift+activate in one kernel pass
-    (kernels/bn_act.py; Pallas on TPU, fused-lax elsewhere — bit-equal
-    to the unfused graph on the lax tier).  Inference with a private
-    Conv producer: BN folds into the conv weights and the original conv
-    result goes dead (XLA DCEs it out of the eval program); parity is
-    tolerance-bound there (float reassociation), the documented
-    exception in tests/test_kernels.py.
-    """
-    def fused(data, gamma, beta, moving_mean, moving_var, *conv_ins,
-              is_train=False, **bn_attrs):
-        from .kernels import bn_act as _ba
-        bn_attrs.pop("output_mean_var", None)   # fusion requires False
-        if conv_ins and not is_train:
-            cdata, w = conv_ins[0], conv_ins[1]
-            cbias = conv_ins[2] if len(conv_ins) > 2 else None
-            from .ops.nn import activation, convolution
-            w2, b2 = _ba.fold_bn_into_conv(
-                w, cbias, gamma, beta, moving_mean, moving_var,
-                eps=bn_attrs.get("eps", 0.001),
-                fix_gamma=bn_attrs.get("fix_gamma", True))
-            out = convolution(cdata, w2, b2,
-                              **{k: v for k, v in conv_attrs.items()
-                                 if k != "no_bias"})
-            if act_type:
-                out = activation(out, act_type=act_type)
-            return out, moving_mean, moving_var
-        return _ba.fused_bn_act(data, gamma, beta, moving_mean,
-                                moving_var, act_type=act_type,
-                                is_train=is_train, **bn_attrs)
-    return fused
-
-
 def _fuse_bn_plan(plan, out_refs):
-    """Rewrite the plan for the BatchNorm fusions (MXTPU_FUSED_KERNELS
-    ``bn_act``/``bn_fold``; docs/how_to/kernels.md):
-
-    - a BatchNorm whose single consumer is an Activation gets the fused
-      one-pass kernel; the Activation entry becomes a passthrough.
-    - a BatchNorm whose data producer is a private Convolution
-      additionally folds into the conv weights on the inference trace.
-
-    Aux updates are untouched: the overridden entry still returns
-    ``(out, new_mm, new_mv)`` at the BatchNorm node, where the executor
-    already writes them back.  Entries keep their positions, so RNG fold
-    constants are unchanged and ``MXTPU_FUSED_KERNELS=0`` (which skips
-    this pass entirely) restores the exact pre-fusion program.
-    """
-    from .kernels import fused_enabled
-    do_act = fused_enabled("bn_act")
-    do_fold = fused_enabled("bn_fold")
-    if not (do_act or do_fold):
-        return plan
-    consumers = {}
-    entry_of = {}
-    for e in plan:
-        node = e[0]
-        entry_of[id(node)] = e
-        if node.op is None:
-            continue
-        for pos, (src, idx) in enumerate(node.inputs):
-            consumers.setdefault((id(src), idx), []).append((node, pos))
-    out_ids = {(nid, i) for nid, i in out_refs}
-
-    overrides = {}   # id(node) -> (fn, extra_refs)
-    for e in plan:
-        node, call_attrs, n_out = e[0], e[1], e[2]
-        if node.op is None or node.op.name != "BatchNorm" or n_out != 1:
-            continue
-        users = consumers.get((id(node), 0), [])
-        act_node, act_type = None, None
-        if do_act and len(users) == 1 and (id(node), 0) not in out_ids:
-            u, pos = users[0]
-            if u.op is not None and u.op.name == "Activation" \
-                    and pos == 0 and len(u.inputs) == 1:
-                a_attrs = entry_of[id(u)][1] or {}
-                at = str(a_attrs.get("act_type", "relu"))
-                if at in _FUSABLE_ACTS:
-                    act_node, act_type = u, at
-        conv_node = None
-        if do_fold and node.inputs:
-            src, idx = node.inputs[0]
-            if src.op is not None and src.op.name == "Convolution" \
-                    and idx == 0 \
-                    and len(consumers.get((id(src), 0), [])) == 1 \
-                    and (id(src), 0) not in out_ids:
-                conv_node = src
-        if act_node is None and conv_node is None:
-            continue
-        conv_attrs = dict(entry_of[id(conv_node)][1]) if conv_node \
-            else {}
-        extra = list(conv_node.inputs) if conv_node is not None else []
-        overrides[id(node)] = (_make_fused_bn_fn(act_type, conv_attrs),
-                               extra)
-        if act_node is not None:
-            overrides[id(act_node)] = (lambda x, **_kw: x, [])
-
-    if not overrides:
-        return plan
-    return [e if id(e[0]) not in overrides
-            else e[:5] + (overrides[id(e[0])],)
-            for e in plan]
+    """Run the mxfuse plan-optimizer pipeline (docs/how_to/
+    performance.md "The plan optimizer") — kept under the historical
+    name as the executor's rewrite entry point.  Entries keep their
+    positions (only the override slot changes), so RNG fold constants
+    are unchanged and ``MXTPU_FUSED_KERNELS=0`` (which skips the
+    pipeline entirely) restores the exact pre-fusion program."""
+    from . import mxfuse
+    return mxfuse.optimize_plan(plan, out_refs)
 
 
 def _build_eval(symbol, placement=None, mirror_segments=0):
@@ -220,11 +121,24 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
     plan = _node_plan(symbol)
     out_refs = [(id(n), i) for n, i in symbol._outputs]
     placement = placement or {}
-    # BN+activation fusion / conv-BN folding (MXTPU_FUSED_KERNELS):
-    # fused dispatch only — the placement (eager per-op) path and
-    # monitored runs keep the plain plan, so per-node taps still see
-    # the unfused node outputs
+    # mxfuse plan-optimizer passes (MXTPU_FUSED_KERNELS): fused
+    # dispatch only — the placement (eager per-op) path and monitored
+    # runs keep the plain plan, so per-node taps still see the unfused
+    # node outputs
     fused_plan = plan if placement else _fuse_bn_plan(plan, out_refs)
+    # the inference-trace pass set (infer_trace): dead-node elimination
+    # + bind-time constant folding over the EVAL interpretation only —
+    # entries are skipped, never changed, so positions (RNG folds,
+    # monitor coordinates) are untouched and values are bit-identical
+    # (dead entries were unread; folded values are computed once here
+    # instead of per trace)
+    infer_plan, const_env = None, {}
+    if not placement:
+        from .kernels import fused_enabled
+        if fused_enabled("infer_trace"):
+            from . import mxfuse
+            const_env, infer_plan = mxfuse.fold_constants(
+                mxfuse.live_entries(fused_plan, out_refs))
     if mirror_segments and mirror_segments > 1:
         if placement:
             import logging
@@ -237,9 +151,15 @@ def _build_eval(symbol, placement=None, mirror_segments=0):
 
     if not placement:
         def eval_fn(args, aux, rng, is_train, monitor=None):
-            env, aux_updates = {}, {}
-            _run_plan_nodes(plan if monitor is not None else fused_plan,
-                            env, args, aux, rng, is_train,
+            if monitor is not None:
+                chunk = plan              # plain: every node tapped
+            elif not is_train and infer_plan is not None:
+                chunk = infer_plan        # pruned + const-folded eval
+            else:
+                chunk = fused_plan
+            env = dict(const_env) if chunk is infer_plan else {}
+            aux_updates = {}
+            _run_plan_nodes(chunk, env, args, aux, rng, is_train,
                             aux_updates, monitor)
             return [env[nid][i] for nid, i in out_refs], aux_updates
         return eval_fn
@@ -311,19 +231,41 @@ def _run_plan_nodes(chunk, env, args, aux, rng, is_train, aux_updates,
                 raise MXNetError("unbound variable %r" % node.name)
             env[id(node)] = (val,)
             continue
-        ins = [env[id(src)][idx] for src, idx in node.inputs]
         kw = {}
-        if node.op.needs_is_train:
+        if node.op.needs_is_train or override is not None:
+            # override bodies ALWAYS receive is_train (train/eval
+            # lowering choices are theirs to make), whatever the
+            # underlying op declares
             kw["is_train"] = is_train
         if node.op.needs_rng:
             kw["rng"] = jax.random.fold_in(rng, rng_ix)
         if override is not None:
-            # fusion override (_fuse_bn_plan): fn replaces the op, with
-            # the referenced extra inputs appended (conv data/weights)
-            fn, extra_refs = override
-            ins = ins + [env[id(src)][idx] for src, idx in extra_refs]
+            # fusion override (mxfuse passes): fn replaces the op, with
+            # the referenced extra inputs appended (conv data/weights).
+            # Inputs the override declared dead on the inference path
+            # ride as None — their producers may have been pruned from
+            # the eval trace by infer_trace (the fn ignores them there)
+            fn, extra_refs = override[0], override[1]
+            dead = override[2] if len(override) > 2 and not is_train \
+                else ()
+            ins = [None if pos in dead else env[id(src)][idx]
+                   for pos, (src, idx) in enumerate(node.inputs)]
+            for src, idx in extra_refs:
+                if id(src) not in env and src.op is None:
+                    # variable extras may sit LATER in plan order than
+                    # this entry (a merged group references every
+                    # sibling's weights) — bind them on first touch
+                    if src.name in args:
+                        env[id(src)] = (args[src.name],)
+                    elif src.name in aux:
+                        env[id(src)] = (aux[src.name],)
+                    else:
+                        raise MXNetError("unbound variable %r"
+                                         % src.name)
+                ins.append(env[id(src)][idx])
         else:
             fn = node.op.fn
+            ins = [env[id(src)][idx] for src, idx in node.inputs]
         # named_scope stamps the symbol node name into HLO op_name
         # metadata, so device profiles attribute fused-program time back
         # to graph nodes (reference per-op profiler semantics,

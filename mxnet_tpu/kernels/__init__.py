@@ -44,6 +44,23 @@ Kernel catalog (``KNOWN_KERNELS``):
   service's ``chunk_seed``) so the input pipeline ships raw-decoded
   uint8 and augments on-device (:mod:`.augment`; consumed by
   ``ImageRecordIter(device_augment=...)``).
+- ``concat_fuse`` — mxfuse plan pass: sibling conv→BN(→act) tower
+  heads sharing one input merge into ONE conv over concatenated
+  filters (inception's 1x1 branches; :mod:`.concat_fuse`).
+- ``pool_act``  — mxfuse plan pass: act→max-pool reorders to
+  pool-first (bitwise; the activation touches stride²-fewer elements)
+  and pool→act pairs collapse to one entry (:mod:`.pool_act`).
+- ``eltwise_chain`` — mxfuse plan pass: private elementwise runs
+  collapse into one fused region (:mod:`.eltwise_chain`).
+- ``infer_trace`` — inference-trace pass set: dead-node elimination +
+  bind-time constant folding over the executor's EVAL interpretation
+  (``mxnet_tpu.mxfuse.live_entries``/``fold_constants``) — composes
+  with the ``bn_fold`` serving default; values are bit-identical, the
+  win is trace/bind time per serving bucket.
+
+The plan-level passes live in :mod:`mxnet_tpu.mxfuse` (the
+match-and-rewrite framework over the executor's node plan); this
+registry routes them exactly like the kernel bodies.
 """
 from __future__ import annotations
 
@@ -53,13 +70,16 @@ from ..base import ENV_FUSED_KERNELS, get_env, register_env
 
 __all__ = ["KNOWN_KERNELS", "fused_enabled", "enabled_kernels",
            "use_pallas", "ENV_FLASH_BLOCK", "bn_act", "lstm_cell",
-           "flash_attention", "roofline", "augment"]
+           "flash_attention", "roofline", "augment", "concat_fuse",
+           "pool_act", "eltwise_chain"]
 
 _LOG = logging.getLogger(__name__)
 
-#: every kernel name the router understands (docs/how_to/kernels.md)
+#: every kernel name the router understands (docs/how_to/kernels.md);
+#: the last four are mxfuse plan-optimizer passes, routed identically
 KNOWN_KERNELS = ("bn_act", "bn_fold", "lstm_cell", "flash_attention",
-                 "augment")
+                 "augment", "concat_fuse", "pool_act", "eltwise_chain",
+                 "infer_trace")
 
 # registered EAGERLY at package import (a lazy registration inside the
 # flash module failed the three-way registry==docs==reads sync for the
@@ -119,3 +139,6 @@ from . import bn_act              # noqa: E402
 from . import lstm_cell           # noqa: E402
 from . import flash_attention     # noqa: E402
 from . import augment             # noqa: E402
+from . import concat_fuse         # noqa: E402
+from . import pool_act            # noqa: E402
+from . import eltwise_chain       # noqa: E402

