@@ -51,6 +51,7 @@ cross-checked against XLA cost_analysis: 69.1 vs 67.2 GFLOP/img for the
 ResNet-152 train step) against the chip's nominal peak, and the run
 fails loudly if any MFU exceeds 1.0.
 """
+import contextlib
 import json
 import os
 import sys
@@ -63,6 +64,37 @@ import numpy as np
 
 def _env_int(name, default):
     return int(os.environ.get(name, str(default)))
+
+
+#: sentinel for _scoped_env: "don't touch the value on entry" (the body
+#: sets its own values; only the exit-time restore is wanted)
+_KEEP = object()
+
+
+@contextlib.contextmanager
+def _scoped_env(name, value=_KEEP):
+    """Scoped RAW save/restore of one environment variable.
+
+    Deliberately raw (not get_env): the restore must distinguish "the
+    operator never set it" (pop) from an explicit value, and get_env
+    cannot — it substitutes the registered default, so a round-trip
+    through it would leave later modes measuring under the default
+    instead of the operator's (absent) setting.  ``value`` is applied
+    on entry (``None`` unsets for the scope; the ``_KEEP`` default
+    leaves the current value alone — for bodies that steer the
+    variable themselves and only need the exit-time restore)."""
+    prev = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    elif value is not _KEEP:
+        os.environ[name] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
 
 
 def _make_trainer(sym_name, batch, input_transforms=None, shapes=None):
@@ -413,22 +445,10 @@ def _data_service_bench(batch=128, n_img=1024, trials=2):
     # the recordio readahead satellite: the same w=1 service with the
     # posix_fadvise window off — the before/after of
     # MXTPU_DATA_READAHEAD (page-cache-warm hosts show ~0; cold/remote
-    # storage is where the window pays)
-    # deliberate RAW env save/restore (not get_env): the restore must
-    # distinguish "operator never set it" (pop) from an explicit value,
-    # and get_env cannot — it substitutes the registered default
-    ra_prev = os.environ.get("MXTPU_DATA_READAHEAD")  # mxlint: disable=env-direct-read
-    os.environ["MXTPU_DATA_READAHEAD"] = "0"   # workers inherit env
-    try:
+    # storage is where the window pays); workers inherit the env
+    with _scoped_env("MXTPU_DATA_READAHEAD", "0"):
         ra_off, _ = measure(mx.io.ImageRecordIter(
             preprocess_threads=1, data_service=True, **kw))
-    finally:
-        # restore the operator's value — popping unconditionally would
-        # remeasure every later mode under the default instead
-        if ra_prev is None:
-            os.environ.pop("MXTPU_DATA_READAHEAD", None)
-        else:
-            os.environ["MXTPU_DATA_READAHEAD"] = ra_prev
 
     # largest MEASURED worker count within min(4, ncores) — ncores==3
     # must pick row 2, not KeyError on a row that was never measured
@@ -1348,9 +1368,10 @@ def _roofline_inception(small, trials):
         outs[0].asnumpy()                          # completion barrier
         return (time.perf_counter() - tic) / steps
 
-    prev = os.environ.get("MXTPU_FUSED_KERNELS")  # mxlint: disable=env-direct-read
     out = {}
-    try:
+    # bind()/trace_once() steer MXTPU_FUSED_KERNELS themselves; the
+    # scope restores the operator's value (or its absence) on exit
+    with _scoped_env("MXTPU_FUSED_KERNELS"):
         ex_on = bind("1")
         ex_off = bind(_PRE_MXFUSE_KERNELS)
         ex_on.forward()[0].asnumpy()               # compile + warm
@@ -1406,11 +1427,6 @@ def _roofline_inception(small, trials):
         out["roofline_infer_trace_x"] = round(off_s / on_s, 3) \
             if on_s else None
         out["roofline_infer_trace_win"] = bool(off_s >= on_s)
-    finally:
-        if prev is None:
-            os.environ.pop("MXTPU_FUSED_KERNELS", None)
-        else:
-            os.environ["MXTPU_FUSED_KERNELS"] = prev
     return out
 
 
@@ -1920,6 +1936,65 @@ def _hotswap_bench(seconds=2.0):
         if proc is not None and proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _region_bench(timeout=420):
+    """The composed region drill as a metric (docs/how_to/region.md):
+    one ``tools/region.py smoke`` run — data plane -> supervised elastic
+    trainer -> rolling fleet -> closed-loop clients, with a rot-injected
+    publish — measured at the region's own seams:
+
+    - ``region_drop_free`` — 1.0 iff ZERO client requests were dropped
+      or errored across the drill (the storm-grade contract).
+    - ``region_goodput_chaos_frac`` — fraction of client requests that
+      succeeded on the FIRST attempt (a fail-once 502 the client had to
+      retry counts against goodput even though nothing was dropped).
+    - ``region_freshness_ms`` — end-to-end publish->served freshness:
+      wall-clock from the trainer's manifest publish to the watcher's
+      committed swap, fleet-wide worst case (lower is better).
+    """
+    import shutil
+    import subprocess
+    import tempfile
+
+    region = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "region.py")
+    tmp = tempfile.mkdtemp(prefix="bench_region_")
+    out = {}
+    try:
+        report_path = os.path.join(tmp, "report.json")
+        res = subprocess.run(
+            [sys.executable, region, "smoke", "--run-dir",
+             os.path.join(tmp, "run"), "--report", report_path],
+            capture_output=True, text=True, timeout=timeout)
+        if res.returncode != 0:
+            raise RuntimeError("region smoke drill failed (rc %d):\n%s"
+                               % (res.returncode, res.stderr[-2000:]))
+        with open(report_path) as f:
+            doc = json.load(f)
+        stats = doc["stats"]
+        clients = stats["clients"]
+        requests = clients["requests"]
+        dropped = clients["dropped"]
+        out["region_requests"] = requests
+        out["region_dropped"] = dropped
+        out["region_retried"] = clients["retried"]
+        out["region_drop_free"] = \
+            1.0 if dropped == 0 and doc["ok"] else 0.0
+        if requests:
+            out["region_goodput_chaos_frac"] = round(
+                (requests - clients["retried"] - dropped)
+                / float(requests), 4)
+        if stats.get("freshness_ms") is not None:
+            out["region_freshness_ms"] = round(
+                float(stats["freshness_ms"]), 3)
+        out["region_served_epoch"] = doc["spec"]["epochs"]
+        out["region_publish_rejected"] = \
+            stats["events"].get("publish_rejected", 0)
+        out["region_elapsed_s"] = doc["elapsed_s"]
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out
 
@@ -2457,12 +2532,9 @@ def _plan_bench(preset=None):
 
     def _measure(group_env):
         from mxnet_tpu.parallel.zero3 import ENV_ZERO3_GATHER_GROUP
-        # saving/restoring the OPERATOR'S value around the steered
-        # measurement, not reading config — get_env can't round-trip
-        # "unset" # mxlint: disable=env-direct-read
-        prev = os.environ.get(ENV_ZERO3_GATHER_GROUP)
-        os.environ[ENV_ZERO3_GATHER_GROUP] = group_env
-        try:
+        # steering the OPERATOR'S variable around one measurement, not
+        # reading config — _scoped_env round-trips "unset" faithfully
+        with _scoped_env(ENV_ZERO3_GATHER_GROUP, group_env):
             t = SPMDTrainer(_deep_sym(), "sgd",
                             {"learning_rate": 0.001, "momentum": 0.9,
                              "rescale_grad": 1.0 / 32},
@@ -2486,11 +2558,6 @@ def _plan_bench(preset=None):
             elapsed = time.perf_counter() - tic
             t.close()
             return (elapsed / steps) * 1000, ngroups
-        finally:
-            if prev is None:
-                os.environ.pop(ENV_ZERO3_GATHER_GROUP, None)
-            else:
-                os.environ[ENV_ZERO3_GATHER_GROUP] = prev
 
     # best-of-2, interleaved: host scheduler drift on a shared box is
     # larger than the grouping delta, so each variant keeps its best run
@@ -2555,6 +2622,8 @@ def _run_mode(mode):
         out.update(_serve_bench())
     elif mode == "fleet":
         out.update(_fleet_bench())
+    elif mode == "region":
+        out.update(_region_bench())
     elif mode == "hotswap":
         out.update(_hotswap_bench())
     elif mode == "decode":
@@ -2626,7 +2695,7 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "data-net", "data_net",
     "fed-cpu", "pipeline", "compile-probe", "resume", "checkpoint",
-    "analyze", "serve", "fleet", "hotswap", "roofline", "zero3",
+    "analyze", "serve", "fleet", "hotswap", "region", "roofline", "zero3",
     "plan", "fed", "compute",
     "compute-large", "inception-bn", "resnet-152", "lstm",
 ))
@@ -2710,6 +2779,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
              "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff",
              "hotswap_drop_free", "hotswap_swap_ms",
+             "region_drop_free", "region_goodput_chaos_frac",
+             "region_freshness_ms",
              "plan_decide_ms", "plan_step_ms")
 
 #: GATE_KEYS members where LOWER is better (latencies): the gate flags
@@ -2717,7 +2788,7 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
 #: higher-is-better rule would fail every improvement and bless every
 #: regression
 LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms", "plan_decide_ms",
-                                  "plan_step_ms"))
+                                  "plan_step_ms", "region_freshness_ms"))
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -2958,6 +3029,9 @@ def main():
         parts.update(_collect("serve"))
         parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
+        # the composed region drill (tools/region.py smoke): trainer
+        # bring-up + fleet bring-up + the settled storm window
+        parts.update(_collect("region", timeout=600))
         # the mxfuse whole-model stanza compiles inception twice
         parts.update(_collect("roofline", timeout=600))
         parts.update(_collect("zero3"))

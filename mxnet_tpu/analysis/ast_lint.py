@@ -37,7 +37,8 @@ import re
 from .report import Finding, Report
 
 __all__ = ["lint_paths", "collect_env_reads", "collect_registered",
-           "iter_py_files", "RULES", "ENV_PREFIXES"]
+           "collect_fault_points", "iter_py_files", "RULES",
+           "ENV_PREFIXES"]
 
 ENV_PREFIXES = ("MXTPU_", "MXNET_")
 
@@ -547,6 +548,79 @@ def collect_env_reads(paths):
         for name, line, via in _env_reads(mod, consts):
             if name.startswith(ENV_PREFIXES):
                 out.setdefault(name, []).append((mod.path, line, via))
+    return out
+
+
+#: ``resilience.FaultInjector`` consume methods — a call
+#: ``faults.<method>("<point>")`` IS a production fault site
+_FAULT_READS = ("maybe_fail", "maybe_trip", "maybe_hang", "consume")
+#: arming entry points (tests/tools side of the contract)
+_FAULT_ARMS = ("arm", "arm_hang")
+
+
+def _param_string_defaults(node, name):
+    """String defaults of parameters called ``name`` on a function def
+    (``atomic_path(path, fault_point="checkpoint_write")``)."""
+    out = []
+    a = node.args
+    positional = list(a.posonlyargs) + list(a.args)
+    for param, default in zip(positional[len(positional)
+                                         - len(a.defaults):], a.defaults):
+        if param.arg == name and isinstance(default, ast.Constant) \
+                and isinstance(default.value, str):
+            out.append((default.value, default.lineno))
+    for param, default in zip(a.kwonlyargs, a.kw_defaults):
+        if default is not None and param.arg == name and \
+                isinstance(default, ast.Constant) and \
+                isinstance(default.value, str):
+            out.append((default.value, default.lineno))
+    return out
+
+
+def collect_fault_points(paths, arms=False):
+    """``point -> [(file, line, via)]`` for every statically resolvable
+    fault-injection site under ``paths`` — the mechanical registry that
+    ``tools/mxlint.py --list-faults`` prints and the docs-sync test
+    asserts against ``docs/how_to/fault_tolerance.md``.
+
+    A site is a ``faults.maybe_fail/maybe_trip/maybe_hang/consume`` call
+    whose point resolves statically (string literal, or a module-level
+    string constant like ``SERVE_FORWARD_FAULT``), plus the
+    ``fault_point=`` routing idiom of ``resilience.atomic_path`` /
+    ``atomic_write`` (both the call-site keyword strings and the
+    parameter defaults).  With ``arms=True`` it instead collects
+    ``faults.arm``/``arm_hang`` call points — the test/tool side, used
+    to catch typo'd armings that would silently never fire.
+    """
+    modules, _ = _load_modules(paths)
+    consts, _ = _collect_constants(modules)
+    methods = _FAULT_ARMS if arms else _FAULT_READS
+    out = {}
+
+    def add(name, mod, line, via):
+        out.setdefault(name, []).append((mod.path, line, via))
+
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if isinstance(func, ast.Attribute) and \
+                        func.attr in methods and node.args:
+                    name = _resolve_env_name(node.args[0], consts)
+                    if name:
+                        add(name, mod, node.lineno, func.attr)
+                if not arms:
+                    for kw in node.keywords:
+                        if kw.arg == "fault_point" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                isinstance(kw.value.value, str):
+                            add(kw.value.value, mod, node.lineno,
+                                "fault_point=")
+            elif not arms and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for name, line in _param_string_defaults(
+                        node, "fault_point"):
+                    add(name, mod, line, "fault_point=")
     return out
 
 

@@ -468,6 +468,7 @@ class FleetRouter(object):
         counters + the per-replica table."""
         healthy = set(self.healthy())
         fleet_counters = {}
+        freshness = []
         replicas = {}
         ctrl = {r["id"]: r for r in self._controller.snapshot()} \
             if self._controller is not None else {}
@@ -491,6 +492,18 @@ class FleetRouter(object):
                     # signal a rolling swap advances one replica at a
                     # time (fleet/deploy.py)
                     entry["epochs"] = view.stats.get("epochs")
+                    # per-model publish->served freshness from each
+                    # replica's watcher (serving/deploy.py) — the
+                    # region drill aggregates the fleet-wide worst case
+                    fresh = {}
+                    for name, blk in (view.stats.get("deploy")
+                                      or {}).items():
+                        ms = (blk or {}).get("last_freshness_ms")
+                        if ms is not None:
+                            fresh[name] = ms
+                            freshness.append(ms)
+                    if fresh:
+                        entry["freshness_ms"] = fresh
                     for k, v in (view.stats.get("counters")
                                  or {}).items():
                         fleet_counters[k] = fleet_counters.get(k, 0) + v
@@ -501,7 +514,9 @@ class FleetRouter(object):
                    "fleet": {"counters": fleet_counters,
                              "models": self.manifest.names(),
                              "replicas_total": len(self._order),
-                             "replicas_healthy": len(healthy)},
+                             "replicas_healthy": len(healthy),
+                             "freshness_ms":
+                                 max(freshness) if freshness else None},
                    "draining": self.draining}
         # fleet p50/p99 = the router's own end-to-end window
         payload["fleet"]["latency_ms"] = payload["router"]["latency_ms"]

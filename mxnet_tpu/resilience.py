@@ -45,6 +45,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import signal
 import sys
 import threading
@@ -61,6 +62,8 @@ __all__ = ["atomic_write", "atomic_path", "retry", "retrying_next",
            "snapshot_params", "submit_checkpoint", "wait_checkpoints",
            "verify_promotion", "publish_mark",
            "TransientError", "FaultInjector", "faults", "strip_faults_env",
+           "region_faults_env", "FaultEvent", "parse_fault_schedule",
+           "SCHEDULE_ACTIONS",
            "WATCHDOG_EXIT_CODE", "PREEMPT_EXIT_CODE",
            "ENV_INIT_RETRIES", "ENV_INIT_TIMEOUT", "ENV_INIT_BACKOFF",
            "ENV_DATA_RETRIES", "ENV_DATA_BACKOFF", "ENV_MAX_BAD_STEPS",
@@ -302,6 +305,124 @@ def strip_faults_env(value, points):
             filter(None, (p.strip() for p in (value or "").split(",")))
             if part.partition(":")[0] not in points]
     return ",".join(keep)
+
+
+def region_faults_env(env, arm=()):
+    """A copy of ``env`` with :data:`ENV_FAULTS` scoped to ONE region
+    role's spawn: the orchestrator's own ``MXTPU_FAULTS`` (whatever the
+    operator armed around the whole process tree) is removed, and only
+    ``arm`` — this role's scheduled ``point:times[@after]`` entries —
+    is set.  This is the leak barrier the composed drill needs: without
+    it, a fault armed for one role rides ``os.environ`` into every
+    sibling the supervisor respawns later, and a fire-once chaos event
+    becomes a crash loop somewhere else (docs/how_to/region.md)."""
+    env = dict(env)
+    env.pop(ENV_FAULTS, None)
+    spec = ",".join(arm) if not isinstance(arm, str) else arm
+    if spec:
+        env[ENV_FAULTS] = spec
+    return env
+
+
+# ---------------------------------------------------------------------------
+# STORM fault schedules (the composed region drill's chaos script)
+# ---------------------------------------------------------------------------
+
+#: actions a region supervisor knows how to drive (tools/region.py):
+#: ``kill`` = SIGKILL the role's process (its supervisor respawns it),
+#: ``resize`` = SIGKILL + respawn the trainer at a different world size,
+#: ``arm`` = arm a :data:`faults` point inside the running role
+SCHEDULE_ACTIONS = ("kill", "resize", "arm")
+
+
+class FaultEvent(object):
+    """One scheduled chaos event: ``<at_s> <action> <target> [<arg>]``."""
+
+    __slots__ = ("at_s", "action", "target", "arg")
+
+    def __init__(self, at_s, action, target, arg=None):
+        self.at_s = float(at_s)
+        self.action = action
+        self.target = target
+        self.arg = arg
+
+    @property
+    def label(self):
+        """Stable event name ``/region/stats`` counts this under —
+        ``kill:data#0``, ``resize:trainer``, ``arm:trainer:rot_checkpoint``."""
+        base = "%s:%s" % (self.action, self.target)
+        if self.action == "arm" and self.arg:
+            return base + ":" + self.arg.partition(":")[0]
+        return base
+
+    def __repr__(self):
+        return "FaultEvent(%.3g %s %s%s)" % (
+            self.at_s, self.action, self.target,
+            " " + self.arg if self.arg else "")
+
+
+def parse_fault_schedule(text):
+    """Parse a STORM chaos schedule into time-ordered
+    :class:`FaultEvent` s (docs/how_to/region.md "STORM schedule
+    grammar").
+
+    One event per line or comma-separated entry::
+
+        <at_s> kill <role>            # SIGKILL; the supervisor respawns
+        <at_s> resize <role> <n>      # SIGKILL + respawn at world size n
+        <at_s> arm <role> <point:times[@after]>   # arm a fault point
+
+    ``at_s`` is seconds after the storm window opens.  A ``#`` at the
+    start of a line or after whitespace starts a comment (role names
+    like ``replica#1`` keep their ``#``); blank entries are ignored.
+    Raises :class:`MXNetError` on
+    an unknown action or a malformed entry — a storm that silently
+    skipped a misspelled event would pass its drill without testing
+    anything."""
+    events = []
+    for raw_line in (text or "").splitlines():
+        # comments: '#' at line start or after whitespace ONLY — a '#'
+        # glued to a token is part of a role name (replica#1)
+        line = re.split(r"(?:^|(?<=\s))#", raw_line, maxsplit=1)[0]
+        for entry in filter(None, (p.strip() for p in line.split(","))):
+            parts = entry.split()
+            if len(parts) < 3:
+                raise MXNetError(
+                    "fault schedule entry %r: want '<at_s> <action> "
+                    "<target> [<arg>]'" % entry)
+            at_s, action, target = parts[0], parts[1], parts[2]
+            arg = parts[3] if len(parts) > 3 else None
+            if len(parts) > 4:
+                raise MXNetError("fault schedule entry %r: trailing "
+                                 "tokens %s" % (entry, parts[4:]))
+            try:
+                at_s = float(at_s)
+            except ValueError:
+                raise MXNetError("fault schedule entry %r: %r is not a "
+                                 "time in seconds" % (entry, parts[0]))
+            if action not in SCHEDULE_ACTIONS:
+                raise MXNetError(
+                    "fault schedule entry %r: unknown action %r (know: "
+                    "%s)" % (entry, action, ", ".join(SCHEDULE_ACTIONS)))
+            if action == "resize":
+                if arg is None or not arg.isdigit() or int(arg) < 1:
+                    raise MXNetError(
+                        "fault schedule entry %r: resize needs a world "
+                        "size >= 1" % entry)
+            elif action == "arm":
+                point, _, times = (arg or "").partition(":")
+                times, _, after = (times or "1").partition("@")
+                if not point or not (times or "1").isdigit() or \
+                        (after and not after.isdigit()):
+                    raise MXNetError(
+                        "fault schedule entry %r: arm needs "
+                        "'point:times[@after]'" % entry)
+            elif arg is not None:
+                raise MXNetError("fault schedule entry %r: kill takes "
+                                 "no argument" % entry)
+            events.append(FaultEvent(at_s, action, target, arg))
+    events.sort(key=lambda e: e.at_s)
+    return events
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,12 @@ class CheckpointWatcher(object):
                          "validation_failures": 0, "rolled_back": 0,
                          "swap_errors": 0}
         self.last_swap_ms = None
+        #: publish->served latency of the LAST promote: wall-clock span
+        #: from the manifest entry's publish timestamp (written by the
+        #: trainer's CheckpointManager.save) to the moment the epoch
+        #: went live here — the region drill's end-to-end freshness
+        #: metric (docs/how_to/region.md)
+        self.last_freshness_ms = None
         self.last_outcome = None
         #: bad publishes already counted: epoch -> manifest-entry mark,
         #: so one rotted epoch is one ``rejected``, not one per poll —
@@ -154,6 +160,7 @@ class CheckpointWatcher(object):
                "epoch": self.pool.get(self.model).loaded_epoch,
                "watching": self.watching(), "poll_s": self.poll_s,
                "last_swap_ms": self.last_swap_ms,
+               "last_freshness_ms": self.last_freshness_ms,
                "last_outcome": self.last_outcome}
         out.update(self.counters)
         return out
@@ -337,11 +344,22 @@ class CheckpointWatcher(object):
         self._rejected_marks.pop(epoch, None)
         self.counters["promoted"] += 1
         self.last_swap_ms = round(swap_ms, 3)
+        try:
+            published = (self._man.entry(epoch) or {}).get("time")
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            published = None
+        if published is not None:
+            # wall clock on both sides (publisher + server): the two
+            # processes may be different hosts, and time.time() is the
+            # only shared clock the manifest can carry
+            self.last_freshness_ms = round(
+                max(0.0, time.time() - float(published)) * 1e3, 3)
         _log().info("CheckpointWatcher[%s]: hot-swapped epoch %s -> %d "
                     "in %.1fms", self.model, current, epoch, swap_ms)
         return self._outcome(True, "promoted", epoch=epoch,
                              from_epoch=current,
-                             swap_ms=self.last_swap_ms)
+                             swap_ms=self.last_swap_ms,
+                             freshness_ms=self.last_freshness_ms)
 
     # -- the poll thread ---------------------------------------------------
     def start(self):

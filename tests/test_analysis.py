@@ -714,6 +714,72 @@ def test_every_code_read_is_registered():
 
 
 # ---------------------------------------------------------------------------
+# fault-point registry <-> docs <-> armings three-way sync (satellite)
+# ---------------------------------------------------------------------------
+
+def _documented_fault_points():
+    path = os.path.join(REPO, "docs", "how_to", "fault_tolerance.md")
+    with open(path) as f:
+        text = f.read()
+    # first cell of each table row, lowercase names only (the same
+    # file's env-var table rows start with MXTPU_ and don't match)
+    return set(re.findall(r"^\|\s*`([a-z][a-z0-9_]*)`", text,
+                          flags=re.M))
+
+
+def test_fault_point_collector_resolves_every_mechanism():
+    """Each static-resolution mechanism proves out on a known site:
+    string literal, module-constant first arg, ``fault_point=``
+    parameter default, and ``fault_point=`` call-site keyword."""
+    sites = ast_lint.collect_fault_points([PKG])
+    assert "iter_next" in sites          # plain string literal
+    assert "serve_forward" in sites      # SERVE_FORWARD_FAULT constant
+    assert "checkpoint_write" in sites   # atomic_path param default
+    assert "manifest_write" in sites     # call-site fault_point="..."
+    # sites carry usable provenance
+    path, line, via = sites["swap_probe"][0]
+    assert path.endswith(os.path.join("serving", "deploy.py"))
+    assert via == "maybe_fail"
+
+
+def test_fault_points_match_docs():
+    """docs/how_to/fault_tolerance.md's fault table IS the tree: the
+    list grew by hand across PRs and nothing checked it until now."""
+    sites = ast_lint.collect_fault_points([PKG])
+    documented = _documented_fault_points()
+    assert set(sites) == documented, (
+        "fault-point/docs drift: undocumented=%s, doc-rows-with-no-"
+        "site=%s" % (sorted(set(sites) - documented),
+                     sorted(documented - set(sites))))
+
+
+def test_every_static_arming_names_a_real_point():
+    """Every ``faults.arm``/``arm_hang`` call with a static point —
+    package, tools, tests — arms a point production code actually
+    reads; a typo'd arming would never fire and silently pass its
+    drill."""
+    sites = ast_lint.collect_fault_points([PKG])
+    arms = ast_lint.collect_fault_points(
+        [PKG, os.path.join(REPO, "tools"), os.path.join(REPO, "tests")],
+        arms=True)
+    unknown = set(arms) - set(sites)
+    assert not unknown, (
+        "armed points with no production site: %s (sites: %s)"
+        % (sorted(unknown), {n: arms[n][:3] for n in sorted(unknown)}))
+
+
+def test_mxlint_list_faults_cli():
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+         "--list-faults"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    listed = {line.split()[0] for line in res.stdout.splitlines()
+              if line and not line.startswith("mxlint:")}
+    assert listed == set(ast_lint.collect_fault_points([PKG]))
+
+
+# ---------------------------------------------------------------------------
 # CLI + stable report (satellite)
 # ---------------------------------------------------------------------------
 

@@ -662,3 +662,63 @@ def test_plan_bench_small_preset_self_proof():
     assert out["plan_step_ms"] > 0 and out["plan_manual_step_ms"] > 0
     # the planner's bucket merge really produced a different grouping
     assert out["plan_auto_groups"] < out["plan_manual_groups"]
+
+
+# ---------------------------------------------------------------------------
+# region mode (the composed region drill, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_gate_keys_cover_region_metrics(tmp_path):
+    """The composed drill's three contracts are gate-guarded: the
+    storm-grade drop-free flag, the first-try goodput fraction under
+    chaos, and the publish->served freshness (a LATENCY — guarded
+    through LOWER_IS_BETTER_KEYS).  A vanished key blocks like
+    everywhere else: a drill that stops minting a metric must block,
+    not go quietly blind."""
+    for key in ("region_drop_free", "region_goodput_chaos_frac",
+                "region_freshness_ms"):
+        assert key in bench.GATE_KEYS
+    assert "region_freshness_ms" in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, region_drop_free=1.0,
+                region_goodput_chaos_frac=0.99,
+                region_freshness_ms=250.0)
+    # a dropped request during the storm collapses the flag -> blocked
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, region_drop_free=0.0)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "region_drop_free"
+    # goodput sagging under chaos (more fail-once retries) blocks
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, region_goodput_chaos_frac=0.5)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "region_goodput_chaos_frac"
+    # freshness RISING past tolerance blocks; an improvement passes
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, region_freshness_ms=800.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    reg = rep["regressions"][0]
+    assert reg["key"] == "region_freshness_ms" and "rise" in reg
+    rep = bench.gate(_write(tmp_path / "n4.json",
+                            dict(base, region_freshness_ms=90.0)),
+                     against=_write(tmp_path / "o4.json", base))
+    assert rep["pass"], rep
+    # a vanished region key blocks too
+    for gone_key in ("region_drop_free", "region_goodput_chaos_frac",
+                     "region_freshness_ms"):
+        gone = {k: v for k, v in base.items() if k != gone_key}
+        rep = bench.gate(_write(tmp_path / "g.json", gone),
+                         against=_write(tmp_path / "go.json", base))
+        assert not rep["pass"]
+        assert rep["regressions"][0]["key"] == gone_key
+
+
+def test_region_mode_is_known_and_in_the_pipeline_set():
+    assert "region" in bench.KNOWN_MODES
+    # source-level pin, like hotswap/fleet: a mode that silently
+    # leaves the pipeline set stops minting its gate keys
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '_collect("region"' in src

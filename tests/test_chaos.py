@@ -1872,3 +1872,117 @@ def test_chaos_elastic_resume_across_world_sizes(tmp_path):
     # and the two resumes agree with each other the same way
     np.testing.assert_allclose(reports[2]["losses"], reports[8]["losses"],
                                rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# the composed region drill (tools/region.py): data plane -> elastic
+# trainer -> rolling fleet -> clients under ONE supervision tree, with
+# scheduled chaos and a live /region/stats endpoint
+# (docs/how_to/region.md)
+# ---------------------------------------------------------------------------
+
+REGION = os.path.join(REPO, "tools", "region.py")
+
+
+def _run_region(mode, tmp_path, timeout):
+    """Run ``tools/region.py <mode>``, poll /region/stats while it is
+    live (the endpoint is part of the contract), return (report, one
+    mid-run stats payload)."""
+    import http.client
+
+    run_dir = str(tmp_path / "region")
+    report = str(tmp_path / "report.json")
+    proc = subprocess.Popen(
+        [sys.executable, REGION, mode, "--run-dir", run_dir,
+         "--report", report],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + timeout
+    try:
+        port_file = os.path.join(run_dir, "region.port")
+        addr = None
+        while time.monotonic() < deadline and proc.poll() is None:
+            if os.path.exists(port_file):
+                addr = open(port_file).read().strip()
+                if addr:
+                    break
+            time.sleep(0.2)
+        live = None
+        if addr and proc.poll() is None:
+            host, port = addr.rsplit(":", 1)
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    conn = http.client.HTTPConnection(host, int(port),
+                                                      timeout=5)
+                    conn.request("GET", "/region/stats")
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    conn.close()
+                    if resp.status == 200:
+                        live = json.loads(body.decode())
+                        break
+                except OSError:
+                    time.sleep(0.2)
+        out, err = proc.communicate(
+            timeout=max(5.0, deadline - time.monotonic()))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        raise AssertionError("region %s hung:\n%s" % (mode, err[-4000:]))
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert proc.returncode == 0, \
+        "region %s failed rc=%s:\n%s" % (mode, proc.returncode,
+                                         err[-4000:])
+    assert "REGION_REPORT " in out, out[-2000:]
+    assert live is not None, "stats endpoint never answered mid-run"
+    assert "events" in live and "roles" in live and "clients" in live
+    with open(report) as f:
+        return json.load(f), live
+
+
+@pytest.mark.chaos
+def test_region_smoke_drill(tmp_path):
+    """The tier-1-sized composed drill: 1 data server -> supervised
+    trainer -> 1-replica fleet -> closed-loop clients, with one
+    rot-injected publish.  Zero dropped requests, the rot rejected at
+    the rollout gate, and the served epoch advances bit-verified."""
+    doc, live = _run_region("smoke", tmp_path, timeout=300)
+    assert doc["ok"], doc["checks"]
+    stats = doc["stats"]
+    assert stats["clients"]["dropped"] == 0
+    # every request resolved OK (at most one per client thread may be
+    # in flight at the instant the report is cut)
+    assert stats["clients"]["requests"] - stats["clients"]["ok"] \
+        <= doc["spec"]["clients"]
+    assert stats["events"].get("publish_rejected", 0) >= 1
+    # the supervision tree's exit-code discipline is visible: the
+    # trainer completed (rc 0) as a counted named event
+    assert stats["events"].get("exit:trainer:rc=0") == 1
+    assert stats["served_epochs"] == {"0": doc["spec"]["epochs"]}
+    assert stats["freshness_ms"] is not None
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_region_storm_drill(tmp_path):
+    """The full STORM: data-server SIGKILL, a mid-run world-size
+    change (SIGKILL + respawn at different --devices), a rot-injected
+    publish, and a replica SIGKILL — all in one window.  Zero dropped
+    or errored client requests, a bit-verified served-epoch advance
+    across the whole storm, every scheduled fault a counted named
+    event on /region/stats."""
+    doc, live = _run_region("storm", tmp_path, timeout=480)
+    assert doc["ok"], doc["checks"]
+    events = doc["stats"]["events"]
+    for label in ("kill:data#0", "resize:trainer",
+                  "arm:trainer:rot_checkpoint", "kill:replica#1"):
+        assert events.get(label) == 1, events
+    assert doc["stats"]["clients"]["dropped"] == 0
+    epochs = doc["spec"]["epochs"]
+    assert doc["stats"]["served_epochs"] == {"0": epochs, "1": epochs}
+    assert doc["stats"]["trainer"]["world"] == 4    # the resize landed
+    assert events.get("data_reconnect", 0) >= 1     # the data plane
+    assert events.get("publish_rejected", 0) >= 1   # the rot
+    assert doc["stats"]["freshness_ms"] is not None

@@ -117,6 +117,13 @@ class _FakeReplica(object):
         #: advances it (or refuses when swap_refuse is set)
         self.epochs = {}
         self.swap_refuse = False
+        #: seam hooks for /swap/<model> while the POST is IN FLIGHT:
+        #: ``on_swap(model, epoch)`` runs before the reply (the rollout
+        #: race tests land a concurrent publish there); ``swap_drop``
+        #: then kills the response — no status line, dead socket (the
+        #: replica died mid-swap)
+        self.on_swap = None
+        self.swap_drop = False
         self._lock = threading.Lock()
         self._server = None
         self._thread = None
@@ -176,6 +183,13 @@ class _FakeReplica(object):
                                           "problems": ["refused"]})
                         return
                     epoch = json.loads(body.decode()).get("epoch")
+                    hook = fake.on_swap
+                    if hook is not None:
+                        hook(model, epoch)
+                    if fake.swap_drop:
+                        # died mid-swap: no status line, dead socket
+                        self.close_connection = True
+                        return
                     with fake._lock:
                         fake.epochs[model] = epoch
                     self._reply(200, {"ok": True, "action": "promoted",
@@ -781,3 +795,151 @@ def test_rolling_swap_halts_when_a_replica_refuses(two_fakes,
     assert roll.check_once() == {"a": "complete"}
     assert two_fakes[0].epochs["a"] == 2
     assert two_fakes[1].epochs["a"] == 2
+
+
+# ---------------------------------------------------------------------------
+# seam: a rollout racing the elastic trainer's resume (the mxregion
+# composition — a world-size-changed trainer respawns with
+# MXTPU_RESUME=1 and republishes while RollingSwap is mid-rollout)
+# ---------------------------------------------------------------------------
+
+def test_rolling_swap_races_elastic_resume_publish(two_fakes, tmp_path):
+    """While replica 1's swap to epoch 2 is IN FLIGHT, the resumed
+    trainer (respawned at a different world size) rewrites epoch 2's
+    files AND publishes epoch 3.  The in-flight rollout must settle
+    cleanly: complete on the epoch it started (every replica
+    consistent, nothing left fenced), and the racing publish rolls on
+    the NEXT poll — never a mixed-epoch fleet or a wedged fence."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_epoch(ckpt, 1, b"epoch-one")
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, log=lambda m: None)
+    _publish_epoch(ckpt, 2, b"epoch-two")
+
+    fired = []
+
+    def resume_lands(model, epoch):
+        if fired:
+            return
+        fired.append(epoch)
+        # the elastic resume republishes from its reloaded state...
+        _publish_epoch(ckpt, 2, b"epoch-two-resume-rewrite")
+        # ...and its next epoch lands while the rollout is in flight
+        _publish_epoch(ckpt, 3, b"epoch-three-from-new-world")
+
+    two_fakes[1].on_swap = resume_lands
+    assert roll.check_once() == {"a": "complete"}
+    assert fired == [2], "the race never fired"
+    assert two_fakes[0].epochs["a"] == 2
+    assert two_fakes[1].epochs["a"] == 2
+    assert router.fenced() == []
+    # the racing publish is not lost: the next poll rolls epoch 3
+    two_fakes[1].on_swap = None
+    assert roll.check_once() == {"a": "complete"}
+    assert all(f.epochs["a"] == 3 for f in two_fakes)
+    assert router.fenced() == []
+    st = router.stats_payload()["rollout"]["state"]
+    assert st["state"] == "complete" and st["epoch"] == 3
+
+
+def test_rolling_swap_halts_cleanly_when_resume_races_a_dying_replica(
+        two_fakes, tmp_path):
+    """The ugly corner of the same seam: the trainer's resume publish
+    lands just as the replica being swapped DIES mid-swap (no response
+    on the wire).  The rollout must halt cleanly — nothing fenced, the
+    survivor keeps serving its consistent epoch — and once the replica
+    is back the next poll completes on the resume's newest epoch."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_epoch(ckpt, 1, b"epoch-one")
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, log=lambda m: None)
+    _publish_epoch(ckpt, 2, b"epoch-two")
+
+    def die_mid_swap(model, epoch):
+        _publish_epoch(ckpt, 3, b"epoch-three-resumed")
+        two_fakes[1].swap_drop = True
+
+    two_fakes[1].on_swap = die_mid_swap
+    assert roll.check_once() == {"a": "halted"}
+    assert roll.counters["halted"] == 1
+    # clean halt: no fence held, the survivor serves epoch 2, the dead
+    # replica was never marked swapped
+    assert router.fenced() == []
+    assert two_fakes[0].epochs["a"] == 2
+    assert two_fakes[1].epochs["a"] == 1
+    # the replica's supervisor brings it back; the next poll resumes
+    # the rollout on the NEWEST publish (the resume's epoch 3)
+    two_fakes[1].swap_drop = False
+    two_fakes[1].on_swap = None
+    router.probe()
+    assert roll.check_once() == {"a": "complete"}
+    assert all(f.epochs["a"] == 3 for f in two_fakes)
+    assert router.fenced() == []
+
+
+# ---------------------------------------------------------------------------
+# seam: spill pressure racing a rollout's fence (the router must never
+# spill onto a fenced replica, and the N-1 floor holds under load)
+# ---------------------------------------------------------------------------
+
+def test_spill_under_rollout_fence_never_targets_fenced_replica():
+    """A home past its spill bar sheds load while a RollingSwap fence
+    holds one replica out: under concurrent spill traffic the fenced
+    replica is NEVER chosen, every request still lands somewhere, and
+    fencing can never cross the N-1 capacity floor."""
+    fakes = [_FakeReplica() for _ in range(3)]
+    try:
+        router = _mk_router(fakes, models=("a",))
+        router.probe()
+        home = router.manifest.home("a") % 3
+        others = [r for r in range(3) if r != home]
+        fenced_rid, spill_rid = others
+        # script the home past the spill bar (spill_queue=4)
+        fakes[home].depths["a"] = 10
+        router.probe()
+        router.fence(fenced_rid)       # a rollout holds this one
+
+        hits, errs = [], []
+
+        def worker():
+            for _ in range(25):
+                try:
+                    hits.append(router.route("a"))
+                except MXNetError as e:  # noqa: PERF203 — seam assert
+                    errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs[:3]
+        assert len(hits) == 100
+        # no request ever landed on the fenced replica...
+        assert all(rid != fenced_rid for rid, _ in hits), hits[:5]
+        # ...and the overloaded home spilled to the unfenced sibling
+        assert {rid for rid, _ in hits} == {spill_rid}
+        assert all(reason == "spilled" for _, reason in hits)
+
+        # N-1 floor under the same pressure: fencing the spill target
+        # leaves only the (overloaded) home — allowed, traffic falls
+        # back to it — but fencing the LAST routable replica is refused
+        router.fence(spill_rid)
+        rid, reason = router.route("a")
+        assert rid == home and reason is None
+        with pytest.raises(MXNetError, match="no routable"):
+            router.fence(home)
+        router.unfence(spill_rid)
+        router.unfence(fenced_rid)
+        assert router.healthy() == [0, 1, 2]
+    finally:
+        for f in fakes:
+            f.close()

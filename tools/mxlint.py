@@ -100,6 +100,12 @@ def main(argv=None):
                              "(default: $MXTPU_ANALYZE_REPORT if set)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule subset to run")
+    parser.add_argument("--list-faults", action="store_true",
+                        help="print the fault-point registry (every "
+                             "statically resolvable faults.maybe_* "
+                             "site under the paths) and exit — the "
+                             "mechanical source for docs/how_to/"
+                             "fault_tolerance.md's list")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the human report (exit code and "
                              "--json only)")
@@ -116,6 +122,15 @@ def main(argv=None):
     paths = list(args.paths)
     if not paths:
         paths = [os.path.join(_REPO, "mxnet_tpu")]
+    if args.list_faults:
+        points = ast_lint.collect_fault_points(paths)
+        for name in sorted(points):
+            sites = ", ".join(
+                "%s:%d" % (os.path.relpath(f, _REPO), line)
+                for f, line, _ in points[name])
+            print("%-22s %s" % (name, sites))
+        print("mxlint: %d fault point(s)" % len(points))
+        return 0
     if args.lint_self:
         paths.append(os.path.abspath(__file__))
     # the registry, collected STATICALLY from the package (register_env

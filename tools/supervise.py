@@ -41,6 +41,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 # keep in sync with mxnet_tpu/resilience.py (asserted by test_chaos.py)
@@ -48,6 +49,188 @@ PREEMPT_EXIT_CODE = 85
 WATCHDOG_EXIT_CODE = 87
 
 RESUME_ENV = "MXTPU_RESUME"
+
+
+def relaunch_decision(rc, restarts, max_restarts, retry_any=False):
+    """The exit-code policy, shared by the blocking :func:`supervise`
+    loop and the role-oriented :class:`Supervisor`: returns
+    ``(verdict, why)`` with verdict one of ``"done"`` (rc 0),
+    ``"relaunch"`` (preempt/watchdog — or any death under
+    ``retry_any`` — with budget left) or ``"propagate"``."""
+    if rc == 0:
+        return "done", "completed"
+    resumable = rc in (PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE)
+    why = {PREEMPT_EXIT_CODE: "graceful preemption",
+           WATCHDOG_EXIT_CODE: "watchdog abort (hung step)"}.get(
+               rc, "exit code %d" % rc)
+    if not resumable and not retry_any:
+        return "propagate", why + " (not a preempt/watchdog code)"
+    if restarts >= max_restarts:
+        return "propagate", why + " (restart budget %d spent)" \
+            % max_restarts
+    return "relaunch", why
+
+
+class Supervisor(object):
+    """The :func:`supervise` policy as a NON-BLOCKING object: one
+    instance per role, each with its own monitor thread, so a composed
+    launcher (``tools/region.py``) can run a heterogeneous process tree
+    — data servers, an elastic trainer, a serving fleet — under one
+    exit-code discipline without dedicating its control flow to any
+    single child.
+
+    ``command`` is a list, or a callable ``(restarts) -> list`` so a
+    respawn can change flags (the elastic resize path respawns the
+    trainer at a different ``--devices``).  ``env`` likewise: a dict or
+    ``(restarts) -> dict`` — the region drill re-derives it per spawn
+    so one role's armed ``MXTPU_FAULTS`` never leaks into (or survives
+    on) a respawned sibling, and fired faults fire once.  Respawns get
+    ``MXTPU_RESUME=1`` exactly like :func:`supervise` relaunches.
+    ``on_exit(role, rc, relaunching)`` is invoked on every child death
+    (the region's named-event counter).  A deliberate :meth:`kill`
+    (chaos SIGKILL) is just a death: the policy decides — region roles
+    run with ``retry_any=True`` so the storm's kills respawn.
+    """
+
+    def __init__(self, role, command, env=None, max_restarts=3,
+                 backoff=0.5, retry_any=False, log=None, on_exit=None,
+                 stdout=None, stderr=None):
+        self.role = role
+        self._command = command
+        self._env = env
+        self.max_restarts = max_restarts
+        self.backoff = backoff
+        self.retry_any = retry_any
+        self.restarts = 0
+        self.last_rc = None
+        self.state = "new"       # new/running/backoff/done/failed/stopped
+        self._log = log or (lambda m: sys.stderr.write(m + "\n"))
+        self._on_exit = on_exit
+        self._stdout, self._stderr = stdout, stderr
+        self._proc = None
+        self._stopping = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    # -- observation -------------------------------------------------------
+    @property
+    def pid(self):
+        proc = self._proc
+        return proc.pid if proc is not None and proc.poll() is None \
+            else None
+
+    def snapshot(self):
+        return {"role": self.role, "state": self.state, "pid": self.pid,
+                "restarts": self.restarts, "last_rc": self.last_rc}
+
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- lifecycle ---------------------------------------------------------
+    def _spawn(self):
+        command = self._command(self.restarts) \
+            if callable(self._command) else list(self._command)
+        env = self._env(self.restarts) if callable(self._env) \
+            else dict(os.environ if self._env is None else self._env)
+        if self.restarts > 0:
+            env[RESUME_ENV] = "1"
+        self._proc = subprocess.Popen(command, env=env,
+                                      stdout=self._stdout,
+                                      stderr=self._stderr)
+        self.state = "running"
+        return self._proc
+
+    def start(self):
+        """Spawn the child and the monitor thread; returns self."""
+        with self._lock:
+            if self.running():
+                return self
+            self._stopping.clear()
+            self._spawn()
+        self._thread = threading.Thread(
+            target=self._monitor, name="supervise-%s" % self.role,
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _monitor(self):
+        while True:
+            rc = self._proc.wait()
+            self.last_rc = rc
+            if self._stopping.is_set():
+                self.state = "stopped"
+                if self._on_exit is not None:
+                    self._on_exit(self.role, rc, False)
+                return
+            verdict, why = relaunch_decision(
+                rc, self.restarts, self.max_restarts,
+                retry_any=self.retry_any)
+            if self._on_exit is not None:
+                self._on_exit(self.role, rc, verdict == "relaunch")
+            if verdict == "done":
+                self.state = "done"
+                return
+            if verdict == "propagate":
+                self.state = "failed"
+                self._log("supervise[%s]: %s — giving up (rc %d)"
+                          % (self.role, why, rc))
+                return
+            self.restarts += 1
+            self._log("supervise[%s]: %s — relaunch %d/%d with %s=1"
+                      % (self.role, why, self.restarts,
+                         self.max_restarts, RESUME_ENV))
+            self.state = "backoff"
+            if self._stopping.wait(self.backoff):
+                self.state = "stopped"
+                return
+            with self._lock:
+                if self._stopping.is_set():
+                    self.state = "stopped"
+                    return
+                self._spawn()
+
+    def kill(self, sig=signal.SIGKILL):
+        """Send ``sig`` to the CURRENT child (a chaos event, not a
+        drain: the monitor thread sees the death and applies the
+        policy).  Returns the signalled pid, or None if between
+        children."""
+        with self._lock:
+            proc = self._proc
+            if proc is None or proc.poll() is not None:
+                return None
+            pid = proc.pid
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                return None
+            return pid
+
+    def drain(self, timeout=30.0, sig=signal.SIGTERM):
+        """Stop supervising, forward ``sig``, await the exit.  Returns
+        the final rc (None if the child had to be SIGKILLed)."""
+        self._stopping.set()
+        with self._lock:
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(sig)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                self.last_rc = None
+                self.state = "stopped"
+                if self._thread is not None:
+                    self._thread.join(timeout=5.0)
+                return None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self.state not in ("done", "failed"):
+            self.state = "stopped"
+        return self.last_rc
 
 
 def supervise(command, max_restarts=3, backoff=1.0, retry_any=False,
@@ -92,24 +275,25 @@ def supervise(command, max_restarts=3, backoff=1.0, retry_any=False,
                 log("supervise: forwarded signal %d; child exited %d — "
                     "not relaunching" % (forwarded["sig"], rc))
                 return rc
-            if rc == 0:
+            verdict, why = relaunch_decision(rc, restarts, max_restarts,
+                                             retry_any=retry_any)
+            if verdict == "done":
                 if restarts:
                     log("supervise: run completed after %d relaunch(es)"
                         % restarts)
                 return 0
-            resumable = rc in (PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE)
-            if not resumable and not retry_any:
-                log("supervise: child exited %d (not a preempt/watchdog "
-                    "code) — propagating" % rc)
-                return rc
-            if restarts >= max_restarts:
-                log("supervise: restart budget (%d) exhausted; last exit "
-                    "code %d" % (max_restarts, rc))
+            if verdict == "propagate":
+                if rc in (PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE) or \
+                        retry_any:
+                    log("supervise: restart budget (%d) exhausted; last "
+                        "exit code %d" % (max_restarts, rc))
+                else:
+                    log("supervise: child exited %d (not a "
+                        "preempt/watchdog code) — propagating" % rc)
                 return rc
             restarts += 1
-            why = {PREEMPT_EXIT_CODE: "graceful preemption",
-                   WATCHDOG_EXIT_CODE: "watchdog abort (hung step)"}.get(
-                       rc, "exit code %d (--retry-any)" % rc)
+            if rc not in (PREEMPT_EXIT_CODE, WATCHDOG_EXIT_CODE):
+                why += " (--retry-any)"
             log("supervise: %s — relaunch %d/%d with %s=1 in %.1fs"
                 % (why, restarts, max_restarts, RESUME_ENV, backoff))
             if backoff > 0:
