@@ -2039,9 +2039,12 @@ def _fleet_warm_run(specs, buckets, cache_dir, timeout=600):
 
 
 def _fleet_up(specs, buckets, store, run_dir, replicas, extra_env=None,
-              timeout=600):
+              timeout=600, workers=None, autoscale=False):
     """Boot a fleet (router + ``replicas`` daemons) on an ephemeral
-    port; returns ``(proc, port)`` once the port file appears."""
+    port; returns ``(proc, port)`` once the port file appears.
+    ``workers`` > 1 shards the front end into reuseport router workers;
+    ``autoscale`` closes the replica-count loop (both: the overdrive
+    mode)."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2051,6 +2054,10 @@ def _fleet_up(specs, buckets, store, run_dir, replicas, extra_env=None,
            "--buckets", buckets, "--warm-store", store,
            "--run-dir", run_dir, "--port", "0",
            "--port-file", port_file]
+    if workers is not None:
+        cmd += ["--workers", str(workers)]
+    if autoscale:
+        cmd += ["--autoscale"]
     for name, (prefix, epoch, sample) in specs.items():
         cmd += ["--model", "%s=%s:%d" % (name, prefix, epoch),
                 "--input-shape",
@@ -2215,6 +2222,305 @@ def _fleet_bench(seconds=2.5):
             out["fleet_qps_ok"] = bool(out["fleet_qps_x"] >= 1.6)
         proc.send_signal(_signal.SIGTERM)
         out["fleet_drain_rc"] = proc.wait(timeout=90)
+        proc = None
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _tenant_load(port, model, sample, tenants, seconds, warmup_s=0.5):
+    """Closed-loop load with per-TENANT client pools: ``tenants`` is a
+    list of ``(name, nthreads, pause_s)`` rows (``pause_s`` > 0 makes a
+    pool well-behaved — it yields between requests instead of hammering
+    back-to-back).  Returns {tenant: {"p50", "p99", "ok", "shed",
+    "errors"}} from the CLIENT side — the flood's damage, if any, shows
+    up in the quiet tenants' p99, not in a server-side counter."""
+    import threading
+
+    from mxnet_tpu.serving import ServeClient
+    from mxnet_tpu.serving.frontend import _percentile
+
+    rs = np.random.RandomState(3)
+    stop = threading.Event()
+    lock = threading.Lock()
+    acc = {name: {"lat": [], "shed": 0, "errors": 0}
+           for name, _, _ in tenants}
+
+    def worker(tenant, pause_s, i):
+        cli = ServeClient("127.0.0.1", port)
+        x = rs.rand(*sample).astype("f") + i
+        mine, shed, errors = [], 0, 0
+        try:
+            while not stop.is_set():
+                tic = time.perf_counter()
+                try:
+                    status, _ = cli.predict(model, x, npy=True,
+                                            tenant=tenant)
+                except Exception:  # noqa: BLE001 — connection loss
+                    status = -1
+                dt = (time.perf_counter() - tic) * 1e3
+                if status == 200:
+                    mine.append((tic, dt))
+                elif status == 429:
+                    shed += 1
+                else:
+                    errors += 1
+                if pause_s:
+                    time.sleep(pause_s)
+        finally:
+            cli.close()
+        with lock:
+            acc[tenant]["lat"].extend(mine)
+            acc[tenant]["shed"] += shed
+            acc[tenant]["errors"] += errors
+
+    threads = []
+    for name, nthreads, pause_s in tenants:
+        threads += [threading.Thread(target=worker,
+                                     args=(name, pause_s, i))
+                    for i in range(nthreads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(warmup_s + seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    cut = t0 + warmup_s
+    out = {}
+    for name, row in acc.items():
+        window = sorted(d for (tic, d) in row["lat"] if tic >= cut)
+        out[name] = {
+            "ok": len(window),
+            "p50": round(_percentile(window, 50), 3) if window else None,
+            "p99": round(_percentile(window, 99), 3) if window else None,
+            "shed": row["shed"], "errors": row["errors"]}
+    return out
+
+
+def _view_healthy_count(view_path):
+    """Healthy-replica count straight from the published fleet-view
+    snapshot (the same doc every router worker routes off) — None when
+    the file is missing/torn (the reader's last-good rule; the bench
+    just polls again)."""
+    try:
+        with open(view_path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    reps = doc.get("replicas") or {}
+    return sum(1 for rep in reps.values() if rep.get("healthy"))
+
+
+def _overdrive_bench(seconds=2.5):
+    """The ``bench.py overdrive`` mode (docs/how_to/fleet.md "Sharding
+    the front end"): the sharded front end's three claims, measured on
+    the dispatch-bound tiny MLP — the opposite regime from ``fleet``'s
+    compute-heavy resnet, and exactly the one where a single router
+    process IS the fleet's QPS ceiling.
+
+    - ``overdrive_qps`` / ``overdrive_qps_x`` = closed-loop QPS through
+      4 SO_REUSEPORT router workers, and its ratio over the measured
+      1-worker ceiling, with ONE identical replica behind both — the
+      delta is pure front-end dispatch, nothing else changes.  Bar:
+      >= 4x on a host with cores for clients + 4 workers + replica;
+      smaller hosts emit ``overdrive_note`` and only the SHAPE key is
+      gate-exempt (the SCALING_SHAPE_KEYS honesty rule — the absolute
+      ``overdrive_qps`` still gates round over round).
+    - ``overdrive_tenant_p99_ms`` (LOWER is better) = the worst
+      WELL-BEHAVED tenant's client-side p99 while one tenant floods
+      back-to-back at ~10x its queued-request quota through the same
+      sharded front end.  The flood gets quota-shed
+      (``overdrive_tenant_flood_shed`` > 0 proves the quota engaged);
+      the quiet tenants must hold inside ``overdrive_tenant_slo_ms``.
+    - ``overdrive_drop_free`` = 1.0 iff client-visible errors were ZERO
+      across one autoscale-up (watermark breach -> warm AOT
+      ``add_replica``) and one fenced scale-down (fence -> publish ->
+      drain -> stop) under continuous traffic — capacity moved both
+      ways and no request was dropped in either direction.
+    """
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    buckets = "1,2,4,8"
+    tmp = tempfile.mkdtemp(prefix="bench_overdrive_")
+    out = {}
+    proc = None
+    try:
+        specs = _save_serving_models(tmp)
+        specs = {"mlp": specs["mlp"]}
+        sample = specs["mlp"][2]
+        store = os.path.join(tmp, "warm_store")
+        os.makedirs(store)
+        from mxnet_tpu.fleet import build_warm_store
+        build_warm_store(_fleet_manifest(specs, buckets), store)
+
+        base_env = {
+            "MXTPU_FLEET_HEARTBEAT_S": "0.25",
+            "MXTPU_FLEET_VIEW_REFRESH_S": "0.2",
+            "MXTPU_SERVE_MAX_WAIT_MS": "2",
+        }
+
+        def _qps_row(port):
+            # best-of-2: scheduler noise on a shared box is larger
+            # than the gate tolerance on a single short window
+            return max(_serve_load(port, "mlp", sample, 8, seconds,
+                                   npy=True)[0] for _ in range(2))
+
+        # --- 1-worker ceiling vs 4 reuseport workers ---------------------
+        run1 = os.path.join(tmp, "run1w")
+        proc, port = _fleet_up(specs, buckets, store, run1, 1,
+                               extra_env=base_env, workers=1)
+        out["overdrive_qps_1w"] = _qps_row(port)
+        proc.send_signal(_signal.SIGTERM)
+        out["overdrive_drain_rc_1w"] = proc.wait(timeout=90)
+        proc = None
+
+        run4 = os.path.join(tmp, "run4w")
+        proc, port = _fleet_up(specs, buckets, store, run4, 1,
+                               extra_env=base_env, workers=4)
+        out["overdrive_workers"] = 4
+        out["overdrive_qps"] = _qps_row(port)
+        proc.send_signal(_signal.SIGTERM)
+        out["overdrive_drain_rc_4w"] = proc.wait(timeout=90)
+        proc = None
+        if out["overdrive_qps_1w"]:
+            out["overdrive_qps_x"] = round(
+                out["overdrive_qps"] / out["overdrive_qps_1w"], 2)
+        ncores = os.cpu_count() or 1
+        out["overdrive_ncores"] = ncores
+        if ncores < 6:
+            # clients + 4 workers + replica + publisher want >= 6
+            # cores; with fewer, the kernel balances connections across
+            # workers that all share one core — flat by construction,
+            # the gate skips the SHAPE key only
+            out["overdrive_note"] = \
+                "flat_by_construction_%dcore" % ncores
+        elif "overdrive_qps_x" in out:
+            out["overdrive_qps_ok"] = bool(out["overdrive_qps_x"] >= 4.0)
+
+        # --- tenant flood through the sharded front end ------------------
+        # quota 2 queued; the flood pool runs 8 back-to-back threads
+        # (~10x the share a 2-slot quota represents under 3 pools),
+        # each quiet pool is 1 paced thread
+        runt = os.path.join(tmp, "runt")
+        tenant_env = dict(base_env, MXTPU_SERVE_TENANT_QUOTA="2")
+        proc, port = _fleet_up(specs, buckets, store, runt, 1,
+                               extra_env=tenant_env, workers=4)
+        rows = _tenant_load(port, "mlp", sample,
+                            [("flood", 8, 0.0),
+                             ("quiet-a", 1, 0.005),
+                             ("quiet-b", 1, 0.005)], 2.0 + seconds)
+        proc.send_signal(_signal.SIGTERM)
+        out["overdrive_drain_rc_tenant"] = proc.wait(timeout=90)
+        proc = None
+        quiet_p99 = [rows[t]["p99"] for t in ("quiet-a", "quiet-b")
+                     if rows[t]["p99"] is not None]
+        if quiet_p99:
+            out["overdrive_tenant_p99_ms"] = max(quiet_p99)
+        if rows["flood"]["p99"] is not None:
+            out["overdrive_tenant_flood_p99_ms"] = rows["flood"]["p99"]
+        out["overdrive_tenant_flood_shed"] = rows["flood"]["shed"]
+        out["overdrive_tenant_errors"] = sum(
+            r["errors"] for r in rows.values())
+        out["overdrive_tenant_slo_ms"] = 500.0
+        out["overdrive_tenant_ok"] = bool(
+            quiet_p99 and max(quiet_p99) <= 500.0
+            and rows["flood"]["shed"] > 0
+            and out["overdrive_tenant_errors"] == 0)
+
+        # --- the autoscale round trip, drop-free -------------------------
+        # watermarks scaled to the MLP's ms-scale waits; cooldown short
+        # so the drill finishes inside the mode budget
+        runa = os.path.join(tmp, "runa")
+        scale_env = dict(base_env,
+                         MXTPU_FLEET_SCALE_HIGH_MS="1.0",
+                         MXTPU_FLEET_SCALE_LOW_MS="0.25",
+                         MXTPU_FLEET_SCALE_COOLDOWN_S="2",
+                         MXTPU_FLEET_MIN_REPLICAS="1",
+                         MXTPU_FLEET_MAX_REPLICAS="2")
+        proc, port = _fleet_up(specs, buckets, store, runa, 1,
+                               extra_env=scale_env, workers=2,
+                               autoscale=True)
+        view_path = os.path.join(runa, "fleet-view.json")
+        import threading
+
+        from mxnet_tpu.serving import ServeClient
+
+        stop_flood = threading.Event()
+        stop_all = threading.Event()
+        errors = [0]
+        sheds = [0]
+        requests = [0]
+        lock = threading.Lock()
+        rs = np.random.RandomState(11)
+
+        def drill_worker(i, flood):
+            cli = ServeClient("127.0.0.1", port)
+            x = rs.rand(*sample).astype("f") + i
+            mine_err = mine_shed = mine_n = 0
+            gate = stop_flood if flood else stop_all
+            try:
+                while not gate.is_set():
+                    try:
+                        status, _ = cli.predict("mlp", x, npy=True)
+                    except Exception:  # noqa: BLE001 — conn loss
+                        status = -1
+                    mine_n += 1
+                    if status == 429:
+                        mine_shed += 1
+                    elif status != 200:
+                        mine_err += 1
+                    if not flood:
+                        time.sleep(0.05)  # the trickle keeps the
+                        # signal under the LOW watermark
+            finally:
+                cli.close()
+            with lock:
+                errors[0] += mine_err
+                sheds[0] += mine_shed
+                requests[0] += mine_n
+
+        threads = [threading.Thread(target=drill_worker,
+                                    args=(i, True)) for i in range(8)]
+        threads.append(threading.Thread(target=drill_worker,
+                                        args=(99, False)))
+        for t in threads:
+            t.start()
+
+        def _wait_healthy(n, deadline_s, what):
+            deadline = time.monotonic() + deadline_s
+            tic = time.monotonic()
+            while _view_healthy_count(view_path) != n:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "overdrive autoscale drill: %s never happened "
+                        "(healthy=%s)" % (what,
+                                          _view_healthy_count(view_path)))
+                time.sleep(0.1)
+            return time.monotonic() - tic
+
+        out["overdrive_scale_up_s"] = round(
+            _wait_healthy(2, 120, "scale-up to 2 replicas"), 2)
+        stop_flood.set()    # trickle only -> signal under LOW
+        out["overdrive_scale_down_s"] = round(
+            _wait_healthy(1, 120, "fenced scale-down to 1 replica"), 2)
+        time.sleep(2.0)     # traffic across the post-fence drain too
+        stop_all.set()
+        for t in threads:
+            t.join(timeout=30)
+        out["overdrive_drill_requests"] = requests[0]
+        out["overdrive_drill_errors"] = errors[0]
+        if sheds[0]:
+            out["overdrive_drill_shed"] = sheds[0]
+        out["overdrive_drop_free"] = \
+            1.0 if errors[0] == 0 and requests[0] > 0 else 0.0
+        proc.send_signal(_signal.SIGTERM)
+        out["overdrive_drain_rc"] = proc.wait(timeout=90)
         proc = None
     finally:
         if proc is not None and proc.poll() is None:
@@ -2596,8 +2902,8 @@ def _run_mode(mode):
         mode = "data-net"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
                 "resume", "checkpoint", "analyze", "serve", "fleet",
-                "hotswap", "data-service", "data-net", "roofline",
-                "zero3", "plan"):
+                "overdrive", "hotswap", "data-service", "data-net",
+                "roofline", "zero3", "plan"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
@@ -2622,6 +2928,8 @@ def _run_mode(mode):
         out.update(_serve_bench())
     elif mode == "fleet":
         out.update(_fleet_bench())
+    elif mode == "overdrive":
+        out.update(_overdrive_bench())
     elif mode == "region":
         out.update(_region_bench())
     elif mode == "hotswap":
@@ -2695,7 +3003,8 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "data-net", "data_net",
     "fed-cpu", "pipeline", "compile-probe", "resume", "checkpoint",
-    "analyze", "serve", "fleet", "hotswap", "region", "roofline", "zero3",
+    "analyze", "serve", "fleet", "overdrive", "hotswap", "region",
+    "roofline", "zero3",
     "plan", "fed", "compute",
     "compute-large", "inception-bn", "resnet-152", "lstm",
 ))
@@ -2778,6 +3087,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "inception_gap_frac",
              "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
              "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff",
+             "overdrive_qps", "overdrive_qps_x",
+             "overdrive_tenant_p99_ms", "overdrive_drop_free",
              "hotswap_drop_free", "hotswap_swap_ms",
              "region_drop_free", "region_goodput_chaos_frac",
              "region_freshness_ms",
@@ -2788,7 +3099,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
 #: higher-is-better rule would fail every improvement and bless every
 #: regression
 LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms", "plan_decide_ms",
-                                  "plan_step_ms", "region_freshness_ms"))
+                                  "plan_step_ms", "region_freshness_ms",
+                                  "overdrive_tenant_p99_ms"))
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -2805,6 +3117,9 @@ SCALING_SHAPE_KEYS = {
     # clients + router + 2 replicas need >= 4 cores to scale; smaller
     # hosts note it and only the SHAPE key is exempted
     "fleet_qps_x": "fleet_scaling_note",
+    # clients + 4 reuseport workers + replica need >= 6 cores; the
+    # absolute overdrive_qps always gates
+    "overdrive_qps_x": "overdrive_note",
 }
 
 #: keys whose absolute value is a property of the ACCELERATOR tier the
@@ -3029,6 +3344,9 @@ def main():
         parts.update(_collect("serve"))
         parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
+        # the sharded front end: reuseport worker scaling, tenant
+        # isolation under flood, the drop-free autoscale round trip
+        parts.update(_collect("overdrive", timeout=600))
         # the composed region drill (tools/region.py smoke): trainer
         # bring-up + fleet bring-up + the settled storm window
         parts.update(_collect("region", timeout=600))

@@ -25,10 +25,22 @@ daemons into one serving system:
   forward into ``MXTPU_COMPILE_CACHE`` so a fresh or respawned replica
   warms from disk instead of from XLA (``fleet_warm_start_x`` in
   ``bench.py fleet`` measures the win; >= 3x is the bar).
+- :mod:`.view` — the shared fleet view that shards the front end: ONE
+  controller-side prober publishes manifest + health + the fenced set
+  into an atomic JSON snapshot with a generation counter; N
+  ``FleetRouter`` worker processes (:class:`~.view.RouterWorkerSet`)
+  accept on the SAME public port via SO_REUSEPORT and route off the
+  snapshot — workers never probe and never coordinate.
+- :mod:`.autoscale` — the loop that ACTS on the aggregated
+  ``est_wait_ms`` signal: hysteresis + cooldown, scale-up through
+  :meth:`~.controller.ReplicaController.add_replica` (warm AOT
+  bring-up), scale-down through the mxswap fence -> drain -> stop
+  path (never below the capacity floor).
 
-``tools/fleet.py`` is the CLI (``serve`` + ``warmup`` subcommands);
-``bench.py fleet`` is the load generator and self-proof.  All four
-``MXTPU_FLEET_*`` knobs are registered EAGERLY at their owner modules
+``tools/fleet.py`` is the CLI (``serve`` + ``warmup`` +
+``router-worker`` subcommands); ``bench.py fleet`` / ``bench.py
+overdrive`` are the load generators and self-proof.  Every
+``MXTPU_FLEET_*`` knob is registered EAGERLY at its owner module
 below (the PR-7 lazy-registration lesson); this package never imports
 jax — the router and controller are pure-host processes by design.
 """
@@ -41,10 +53,23 @@ from .router import (FleetRouter, NoHealthyReplica, ReplicaDead,
                      ENV_FLEET_EVICT_S)
 from .warm import build_warm_store, warm_store_manifest
 from .deploy import RollingSwap
+from .view import (FleetViewPublisher, FleetViewReader, RouterWorkerSet,
+                   reserve_port, ENV_FLEET_WORKERS,
+                   ENV_FLEET_VIEW_REFRESH_S)
+from .autoscale import (Autoscaler, ENV_FLEET_SCALE_HIGH_MS,
+                        ENV_FLEET_SCALE_LOW_MS,
+                        ENV_FLEET_SCALE_COOLDOWN_S,
+                        ENV_FLEET_MIN_REPLICAS, ENV_FLEET_MAX_REPLICAS)
 
 __all__ = ["FleetManifest", "parse_shape_specs", "replica_device_env",
            "default_serve_py", "Replica", "ReplicaController",
            "FleetRouter", "NoHealthyReplica", "ReplicaDead",
            "build_warm_store", "warm_store_manifest", "RollingSwap",
+           "FleetViewPublisher", "FleetViewReader", "RouterWorkerSet",
+           "reserve_port", "Autoscaler",
            "ENV_FLEET_REPLICAS", "ENV_FLEET_SPILL_QUEUE",
-           "ENV_FLEET_HEARTBEAT_S", "ENV_FLEET_EVICT_S"]
+           "ENV_FLEET_HEARTBEAT_S", "ENV_FLEET_EVICT_S",
+           "ENV_FLEET_WORKERS", "ENV_FLEET_VIEW_REFRESH_S",
+           "ENV_FLEET_SCALE_HIGH_MS", "ENV_FLEET_SCALE_LOW_MS",
+           "ENV_FLEET_SCALE_COOLDOWN_S", "ENV_FLEET_MIN_REPLICAS",
+           "ENV_FLEET_MAX_REPLICAS"]

@@ -102,18 +102,22 @@ class ReplicaController(object):
             if cpu_affinity else [None] * manifest.replicas
         self.replicas = []
         for i in range(manifest.replicas):
-            port_file = os.path.join(run_dir, "replica-%d.port" % i)
-            log_path = os.path.join(run_dir, "replica-%d.log" % i)
-            argv = manifest.serve_argv(self.serve_py, port_file=port_file,
-                                       port=0, python=python)
-            env = dict(os.environ)
-            env.update(replica_device_env(manifest.device_sets, i))
-            env.update(self.extra_env)
-            if warm_store:
-                env["MXTPU_COMPILE_CACHE"] = warm_store
-            self.replicas.append(Replica(i, argv, env, port_file,
-                                         log_path,
-                                         affinity=affinities[i]))
+            self.replicas.append(self._make_replica(
+                i, affinity=affinities[i]))
+
+    def _make_replica(self, rid, affinity=None):
+        port_file = os.path.join(self.run_dir, "replica-%d.port" % rid)
+        log_path = os.path.join(self.run_dir, "replica-%d.log" % rid)
+        argv = self.manifest.serve_argv(self.serve_py,
+                                        port_file=port_file, port=0,
+                                        python=self.python)
+        env = dict(os.environ)
+        env.update(replica_device_env(self.manifest.device_sets, rid))
+        env.update(self.extra_env)
+        if self.warm_store:
+            env["MXTPU_COMPILE_CACHE"] = self.warm_store
+        return Replica(rid, argv, env, port_file, log_path,
+                       affinity=affinity)
 
     @staticmethod
     def _affinity_sets(n):
@@ -133,12 +137,71 @@ class ReplicaController(object):
     def start(self):
         for rep in self.replicas:
             self._spawn(rep, resume=False)
-            t = threading.Thread(target=self._supervise, args=(rep,),
-                                 name="mxfleet-sup-%d" % rep.id,
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._watch(rep)
         return self
+
+    def _watch(self, rep):
+        t = threading.Thread(target=self._supervise, args=(rep,),
+                             name="mxfleet-sup-%d" % rep.id,
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+    # -- autoscaling (fleet/autoscale.py) ----------------------------------
+    def add_replica(self):
+        """Scale-up: spawn ONE new replica (next free id) and supervise
+        it like the rest.  It comes up warm via the AOT store and joins
+        routing the moment its port file appears and a probe succeeds.
+        Dynamic replicas get no CPU pinning — the boot-time core
+        partition is not re-balanced under scale."""
+        with self._lock:
+            if self._draining:
+                raise MXNetError("fleet is draining — no scale-up")
+            rid = max((r.id for r in self.replicas), default=-1) + 1
+            rep = self._make_replica(rid)
+            self.replicas.append(rep)
+        self._spawn(rep, resume=False)
+        self._watch(rep)
+        return rep
+
+    def stop_replica(self, rid, timeout=30.0):
+        """Scale-down endpoint: SIGTERM ONE replica (it drains its
+        accepted work and exits 0 — the mxserve contract) and never
+        respawn it.  The CALLER owns the safety dance first: fence the
+        replica at the router/publisher (the capacity floor is checked
+        there) and wait out its queue — this method just retires the
+        process.  Returns the exit code."""
+        with self._lock:
+            rep = next((r for r in self.replicas if r.id == rid), None)
+            if rep is None:
+                raise MXNetError("no replica %s to stop" % (rid,))
+            rep.state = "scaling_down"
+            proc = rep.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:         # pragma: no cover — just died
+                pass
+        rc = None
+        if proc is not None:
+            try:
+                rc = proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait()
+                self._log("fleet: replica %d did not drain in %.0fs on "
+                          "scale-down — killed" % (rep.id, timeout))
+        with self._lock:
+            rep.state = "scaled_down"
+            rep.last_rc = rc
+        # a scaled-down replica's port must never route again
+        try:
+            os.unlink(rep.port_file)
+        except OSError:
+            pass
+        self._log("fleet: replica %d scaled down (rc=%s)" % (rid, rc))
+        return rc
 
     def _spawn(self, rep, resume):
         env = dict(rep.env)
@@ -176,6 +239,11 @@ class ReplicaController(object):
                 if self._draining:
                     rep.state = "drained" if rc == 0 else "exited"
                     return
+                if rep.state in ("scaling_down", "scaled_down"):
+                    # the autoscaler retired this replica on purpose —
+                    # its death is the plan, not a capacity loss
+                    rep.state = "scaled_down"
+                    return
                 lived = time.monotonic() - rep.spawned_at
                 if lived >= self.stable_s:
                     rep.streak = 0
@@ -200,6 +268,9 @@ class ReplicaController(object):
                 if self._draining:
                     rep.state = "exited"
                     return
+                if rep.state in ("scaling_down", "scaled_down"):
+                    rep.state = "scaled_down"
+                    return
                 self._spawn(rep, resume=resumable)
 
     # -- observation -------------------------------------------------------
@@ -208,7 +279,11 @@ class ReplicaController(object):
         its daemon finished warmup and wrote the port file (re-read
         after every respawn: ephemeral ports change)."""
         out = {}
-        for rep in self.replicas:
+        with self._lock:
+            reps = list(self.replicas)
+        for rep in reps:
+            if rep.state == "scaled_down":
+                continue            # retired on purpose — never routes
             if rep.port is None and os.path.exists(rep.port_file):
                 try:
                     with open(rep.port_file) as f:
@@ -222,7 +297,9 @@ class ReplicaController(object):
 
     def snapshot(self):
         self.ports()
-        return [rep.snapshot() for rep in self.replicas]
+        with self._lock:
+            reps = list(self.replicas)
+        return [rep.snapshot() for rep in reps]
 
     def wait_ready(self, timeout=300.0):
         """Block until every replica wrote its port file (i.e. finished
@@ -238,11 +315,13 @@ class ReplicaController(object):
                 # drained to rc 0 and will never write port files —
                 # waiting out the timeout would just hang the drain
                 raise MXNetError("fleet drained during bring-up")
-            if any(r.state == "failed" for r in self.replicas):
+            with self._lock:
+                failed = [r.id for r in self.replicas
+                          if r.state == "failed"]
+            if failed:
                 raise MXNetError(
                     "replica(s) %s failed during bring-up — see logs "
-                    "under %r" % ([r.id for r in self.replicas
-                                   if r.state == "failed"], self.run_dir))
+                    "under %r" % (failed, self.run_dir))
             if time.monotonic() > deadline:
                 raise MXNetError(
                     "replicas %s never became ready within %.0fs"
@@ -282,7 +361,8 @@ class ReplicaController(object):
         """SIGKILL everything (test cleanup, not a drain)."""
         with self._lock:
             self._draining = True
-        for rep in self.replicas:
+            reps = list(self.replicas)
+        for rep in reps:
             if rep.proc is not None and rep.proc.poll() is None:
                 rep.proc.kill()
                 rep.proc.wait()

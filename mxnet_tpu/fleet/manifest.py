@@ -97,7 +97,7 @@ class FleetManifest(object):
     """
 
     def __init__(self, models, replicas=None, buckets=None,
-                 device_sets=None):
+                 device_sets=None, router_workers=None):
         if not models:
             raise MXNetError("a fleet manifest needs at least one model")
         self.models = {}
@@ -121,6 +121,14 @@ class FleetManifest(object):
                              % self.replicas)
         self.buckets = buckets
         self.device_sets = device_sets
+        #: router worker processes sharing the public port (the sharded
+        #: front end); None = the MXTPU_FLEET_WORKERS default at serve
+        #: time, 1 = the in-line single-process router
+        self.router_workers = None if router_workers is None \
+            else int(router_workers)
+        if self.router_workers is not None and self.router_workers < 1:
+            raise MXNetError("router_workers must be >= 1, got %d"
+                             % self.router_workers)
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -149,7 +157,8 @@ class FleetManifest(object):
         return cls(doc.get("models") or {},
                    replicas=doc.get("replicas"),
                    buckets=doc.get("buckets"),
-                   device_sets=doc.get("device_sets"))
+                   device_sets=doc.get("device_sets"),
+                   router_workers=doc.get("router_workers"))
 
     def to_doc(self):
         return {"models": {n: {"target": s["target"],
@@ -159,7 +168,8 @@ class FleetManifest(object):
                            for n, s in self.models.items()},
                 "replicas": self.replicas,
                 "buckets": self.buckets,
-                "device_sets": self.device_sets}
+                "device_sets": self.device_sets,
+                "router_workers": self.router_workers}
 
     def save(self, path):
         from ..resilience import atomic_write

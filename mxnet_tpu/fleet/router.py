@@ -38,14 +38,18 @@ plus the router-measured fleet-level p50/p99.
 """
 from __future__ import annotations
 
+import glob
 import json
+import os
 import signal
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..base import MXNetError, get_env, register_env
 from ..serving.frontend import Stats
+from .view import FleetViewReader, worker_stats_path
 
 __all__ = ["FleetRouter", "NoHealthyReplica", "ReplicaDead",
            "ENV_FLEET_SPILL_QUEUE", "ENV_FLEET_HEARTBEAT_S",
@@ -97,14 +101,26 @@ class _ReplicaView(object):
 
 class FleetRouter(object):
     """``endpoints``: a :class:`~.controller.ReplicaController` (live
-    port discovery + drain forwarding) or a static ``{id: (host,
-    port)}`` dict (tests, external replicas)."""
+    port discovery + drain forwarding), a static ``{id: (host, port)}``
+    dict (tests, external replicas), or a
+    :class:`~.view.FleetViewReader` — **view mode**, the sharded front
+    end's worker: health, addresses, per-replica stats and the fenced
+    set all come from the published snapshot, this process never probes
+    and never fences.  ``reuse_port`` binds the public port with
+    SO_REUSEPORT so N workers share it; ``worker_id`` + ``run_dir``
+    turn on the periodic counter dump that lets ANY worker answer
+    ``/stats`` for the whole shard (sibling dumps merged with live
+    counters)."""
 
     def __init__(self, endpoints, manifest, host="127.0.0.1", port=0,
                  spill_queue=None, heartbeat_s=None, evict_s=None,
-                 slo_ms=0.0, request_timeout=60.0):
+                 slo_ms=0.0, request_timeout=60.0, reuse_port=False,
+                 worker_id=None, run_dir=None):
         self.manifest = manifest
         self.host, self.port = host, int(port)
+        self.reuse_port = bool(reuse_port)
+        self.worker_id = worker_id
+        self.run_dir = run_dir
         self.spill_queue = int(get_env(ENV_FLEET_SPILL_QUEUE)
                                if spill_queue is None else spill_queue)
         self.heartbeat_s = float(get_env(ENV_FLEET_HEARTBEAT_S)
@@ -117,19 +133,23 @@ class FleetRouter(object):
         self.draining = False
         self._controller = None
         self._static = None
-        if hasattr(endpoints, "ports"):
+        self._view = None
+        self._views = {}
+        if isinstance(endpoints, FleetViewReader):
+            self._view = endpoints      # worker: snapshot-fed, no probe
+        elif hasattr(endpoints, "ports"):
             self._controller = endpoints
-            n = len(endpoints.replicas)
+            if len(endpoints.replicas) < 1:
+                raise MXNetError("a fleet needs at least one replica")
+            for rid in range(len(endpoints.replicas)):
+                self._views[rid] = _ReplicaView(rid)
         else:
             self._static = {rid: tuple(addr)
                             for rid, addr in dict(endpoints).items()}
-            n = len(self._static)
-        if n < 1:
-            raise MXNetError("a fleet needs at least one replica")
-        self._views = {}
-        for rid in (self._static if self._static is not None
-                    else range(n)):
-            self._views[rid] = _ReplicaView(rid)
+            if len(self._static) < 1:
+                raise MXNetError("a fleet needs at least one replica")
+            for rid in self._static:
+                self._views[rid] = _ReplicaView(rid)
         self._order = sorted(self._views)
         #: replicas held out of routing by a rolling swap
         #: (fleet/deploy.py): fenced != evicted — the replica is
@@ -145,6 +165,8 @@ class FleetRouter(object):
         self._stopped = threading.Event()
         self._stop_health = threading.Event()
         self._health_thread = None
+        self._stop_dump = threading.Event()
+        self._dump_thread = None
         #: serve/drain handshake: a drain that arrives BEFORE the
         #: accept loop starts marks _aborted so serve_forever returns
         #: immediately instead of serving a drained fleet forever;
@@ -161,8 +183,51 @@ class FleetRouter(object):
     def _addresses(self):
         if self._static is not None:
             return dict(self._static)
-        return {rid: ("127.0.0.1", port) if port is not None else None
-                for rid, port in self._controller.ports().items()}
+        if self._view is not None:
+            self._sync_view()
+            with self._lock:
+                return {rid: v.addr for rid, v in self._views.items()}
+        addrs = {rid: ("127.0.0.1", port) if port is not None else None
+                 for rid, port in self._controller.ports().items()}
+        # the replica SET is dynamic under autoscaling: adopt new
+        # replicas, drop scaled-down ones (their fences go with them)
+        with self._lock:
+            for rid in addrs:
+                if rid not in self._views:
+                    self._views[rid] = _ReplicaView(rid)
+            for rid in [r for r in self._views if r not in addrs]:
+                del self._views[rid]
+                self._fenced.discard(rid)
+            self._order = sorted(self._views)
+        return addrs
+
+    def _sync_view(self):
+        """View mode: refresh the routing state from the published
+        snapshot (addresses, per-replica stats, health, the fenced
+        set).  A replica the snapshot calls healthy is routable NOW —
+        even off a stale snapshot (publisher hiccup): routing to a
+        last-known-healthy replica is safe, because a death since the
+        snapshot surfaces as the established fail-once 502, never a
+        resend.  Worker-local inflight/error counters survive the
+        sync."""
+        doc = self._view.doc()
+        now = time.monotonic()
+        with self._lock:
+            seen = set()
+            for key, ent in (doc.get("replicas") or {}).items():
+                rid = ent.get("id", key)
+                seen.add(rid)
+                view = self._views.get(rid)
+                if view is None:
+                    view = self._views[rid] = _ReplicaView(rid)
+                addr = ent.get("addr")
+                view.addr = tuple(addr) if addr else None
+                view.stats = ent.get("stats")
+                view.last_ok = now if ent.get("healthy") else None
+            for rid in [r for r in self._views if r not in seen]:
+                del self._views[rid]
+            self._fenced = set(doc.get("fenced") or [])
+            self._order = sorted(self._views)
 
     def _probe_one(self, view, addr):
         """One /healthz (+ /stats) round trip; returns ``"ok"``,
@@ -224,9 +289,13 @@ class FleetRouter(object):
         not stretch the pass past ``evict_s`` and age out the healthy
         replicas that were stamped at the start of it."""
         import random
+        if self._view is not None:
+            # workers NEVER probe — that is the whole point of the
+            # shared view (one prober, N consumers)
+            return self.healthy()
         addrs = self._addresses()
         misses = []
-        for rid, view in self._views.items():
+        for rid, view in list(self._views.items()):
             view.probes += 1
             addr = addrs.get(rid)
             if addr is None:
@@ -262,7 +331,11 @@ class FleetRouter(object):
 
     def healthy(self):
         """Routable replica ids: probed OK within the eviction window
-        and not fenced by a rolling swap."""
+        and not fenced by a rolling swap (view mode: as the published
+        snapshot says — the sync stamps healthy replicas fresh, so a
+        stale snapshot keeps its last-known-healthy set routable)."""
+        if self._view is not None:
+            self._sync_view()
         now = time.monotonic()
         with self._lock:
             return [rid for rid in self._order
@@ -277,6 +350,11 @@ class FleetRouter(object):
         its in-flight work finishes normally).  Raises when fencing it
         would leave NO routable replica — a rollout must never take
         the last server away (capacity floor N-1)."""
+        if self._view is not None:
+            raise MXNetError(
+                "fencing is the controller's job in sharded mode — "
+                "fence via the publisher-side router, the snapshot "
+                "carries it to every worker")
         now = time.monotonic()
         with self._lock:
             others = [r for r in self._order
@@ -303,6 +381,27 @@ class FleetRouter(object):
     def fenced(self):
         with self._lock:
             return sorted(self._fenced)
+
+    def view_export(self):
+        """Per-replica routing state for the shared fleet view
+        (fleet/view.py publishes it; router workers consume it).  The
+        ``healthy`` flag already folds in fencing — a worker needs one
+        bit, not the derivation."""
+        healthy = set(self.healthy())
+        ctrl = {r["id"]: r for r in self._controller.snapshot()} \
+            if self._controller is not None else {}
+        out = {}
+        with self._lock:
+            for rid in self._order:
+                view = self._views[rid]
+                out[str(rid)] = {
+                    "id": rid,
+                    "addr": list(view.addr) if view.addr else None,
+                    "healthy": rid in healthy,
+                    "stats": view.stats,
+                    "forward_errors": view.errors,
+                    "state": ctrl.get(rid, {}).get("state")}
+        return out
 
     # -- routing policy ----------------------------------------------------
     def _load(self, view, model=None):
@@ -333,6 +432,13 @@ class FleetRouter(object):
             raise NoHealthyReplica(
                 "no healthy replica for %r (fleet of %d, all evicted "
                 "or starting)" % (model, len(self._views)))
+        if self._view is not None:
+            age = self._view.age_s()
+            if age is not None and age > self.evict_s:
+                # routing on a stale snapshot is SAFE (fail-once covers
+                # any death since) but worth counting: a climbing
+                # stale_view_routes means the publisher is gone
+                self.stats.inc("stale_view_routes")
         home = self._order[self.manifest.home(model) % len(self._order)]
         with self._lock:
             if home in candidates:
@@ -509,7 +615,11 @@ class FleetRouter(object):
                         fleet_counters[k] = fleet_counters.get(k, 0) + v
                 entry.update(ctrl.get(rid, {}))
                 replicas[rid] = entry
-        payload = {"router": self.stats.snapshot(),
+        if self._view is not None and self.run_dir is not None:
+            router_block, workers = self._merged_worker_stats()
+        else:
+            router_block, workers = self.stats.snapshot(), None
+        payload = {"router": router_block,
                    "replicas": replicas,
                    "fleet": {"counters": fleet_counters,
                              "models": self.manifest.names(),
@@ -518,11 +628,67 @@ class FleetRouter(object):
                              "freshness_ms":
                                  max(freshness) if freshness else None},
                    "draining": self.draining}
-        # fleet p50/p99 = the router's own end-to-end window
+        # fleet p50/p99 = the router tier's end-to-end window (merged
+        # across every worker in sharded mode — any worker can answer)
         payload["fleet"]["latency_ms"] = payload["router"]["latency_ms"]
+        if workers is not None:
+            payload["workers"] = workers
+        if self._view is not None:
+            age = self._view.age_s()
+            payload["view"] = {"generation": self._view.generation,
+                               "age_s": round(age, 3)
+                               if age is not None else None,
+                               "read_errors": self._view.read_errors}
+            rollout = self._view.doc().get("rollout")
+            if rollout is not None:
+                payload["rollout"] = rollout
         if self.deploy is not None:
             payload["rollout"] = self.deploy.stats()
         return payload
+
+    def _merged_worker_stats(self):
+        """Any worker answers /stats for the WHOLE front end: its live
+        counters merged with every sibling's periodic dump (counters
+        summed, latency windows concatenated for shard-wide p50/p99).
+        Siblings are per-file best-effort — a worker mid-respawn just
+        contributes its last dump or nothing."""
+        exports = [self.stats.export()]
+        workers = {str(self.worker_id): {"pid": os.getpid(),
+                                         "live": True}}
+        pattern = os.path.join(self.run_dir, "rworker-*.stats.json")
+        for path in sorted(glob.glob(pattern)):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue            # mid-replace or mid-respawn
+            wid = doc.get("worker")
+            if wid is None or wid == self.worker_id:
+                continue
+            exports.append(doc.get("router") or {})
+            workers[str(wid)] = {
+                "pid": doc.get("pid"),
+                "age_s": round(max(0.0, time.time()
+                                   - float(doc.get("updated_at") or 0)),
+                               3),
+                "generation": doc.get("generation")}
+        return Stats.merged_snapshot(exports), workers
+
+    def dump_worker_stats(self):
+        """Write this worker's counters next to the view file (the
+        sibling-merge input and the worker-set readiness marker)."""
+        if self.worker_id is None or self.run_dir is None:
+            return None
+        from ..resilience import atomic_write
+        doc = {"worker": self.worker_id, "pid": os.getpid(),
+               "updated_at": time.time(),
+               "router": self.stats.export(),
+               "generation": self._view.generation
+               if self._view is not None else None}
+        path = worker_stats_path(self.run_dir, self.worker_id)
+        atomic_write(path, json.dumps(doc).encode("utf-8"),
+                     fault_point="worker_stats_dump")
+        return path
 
     def healthz_payload(self):
         healthy = self.healthy()
@@ -531,11 +697,21 @@ class FleetRouter(object):
                 "replicas_healthy": len(healthy),
                 "healthy_ids": healthy}
 
+    def _dump_loop(self):
+        period = self._view.refresh_s if self._view is not None else 0.5
+        while not self._stop_dump.wait(max(0.1, period)):
+            try:
+                self.dump_worker_stats()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                pass
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         """Bind the public port, run one synchronous probe pass, start
         the health loop.  Returns self (``self.port`` holds the real
-        port)."""
+        port).  View mode starts NO probe/health machinery — the
+        snapshot is the health signal — and instead dumps its counters
+        (first dump immediately: the worker-set readiness marker)."""
         if self._server is not None:
             return self
         router = self
@@ -543,11 +719,21 @@ class FleetRouter(object):
         class Handler(_Handler):
             rt = router
 
-        self._server = ThreadingHTTPServer((self.host, self.port),
-                                           Handler)
+        server_cls = _ReuseportHTTPServer if self.reuse_port \
+            else ThreadingHTTPServer
+        self._server = server_cls((self.host, self.port), Handler)
         self._server.daemon_threads = False
         self._server.block_on_close = True
         self.port = self._server.server_address[1]
+        if self._view is not None:
+            self._sync_view()
+            if self.worker_id is not None and self.run_dir is not None:
+                self.dump_worker_stats()
+                self._dump_thread = threading.Thread(
+                    target=self._dump_loop, name="mxfleet-stats-dump",
+                    daemon=True)
+                self._dump_thread.start()
+            return self
         self.probe()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="mxfleet-health", daemon=True)
@@ -590,6 +776,13 @@ class FleetRouter(object):
                     break
             time.sleep(0.05)
         self._stop_health.set()
+        self._stop_dump.set()
+        try:
+            # final counter dump so a sibling's post-drain /stats merge
+            # still sees this worker's full ledger
+            self.dump_worker_stats()
+        except Exception:  # noqa: BLE001 — best-effort observability
+            pass
         if self._controller is not None:
             self.replica_rcs = self._controller.drain(
                 timeout=max(1.0, deadline - time.monotonic()))
@@ -611,6 +804,22 @@ class FleetRouter(object):
 
     def wait_stopped(self, timeout=None):
         return self._stopped.wait(timeout)
+
+
+class _ReuseportHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that binds with SO_REUSEPORT: N router
+    workers listen on the SAME public port and the kernel balances new
+    connections across them (established keep-alive connections stay
+    with their worker — per-worker connection pools and the fail-once
+    502 stance are untouched)."""
+
+    def server_bind(self):
+        if not hasattr(socket, "SO_REUSEPORT"):
+            raise MXNetError(
+                "SO_REUSEPORT is not available on this platform — the "
+                "sharded front end needs Linux")
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        ThreadingHTTPServer.server_bind(self)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -655,7 +864,8 @@ class _Handler(BaseHTTPRequestHandler):
         fwd_headers = {"Content-Type":
                        self.headers.get("Content-Type")
                        or "application/json"}
-        for h in ("X-MXTPU-Priority", "X-MXTPU-Deadline-Ms"):
+        for h in ("X-MXTPU-Priority", "X-MXTPU-Deadline-Ms",
+                  "X-MXTPU-Tenant"):
             if self.headers.get(h) is not None:
                 fwd_headers[h] = self.headers[h]
         status, data, ctype = self.rt.proxy_predict(model, body,

@@ -27,6 +27,24 @@ latency hostage; priority ordering bounds that blast radius without
 touching the bit-exactness contract (a request's result never depends
 on its co-batched rows — only WHEN it runs changes).
 
+TENANT FAIRNESS (weighted-fair queueing): a request may also carry a
+``tenant`` label.  Each tenant gets its own queue and a **stride
+scheduler** picks which tenant fills the next bucket slot: every pop
+charges the tenant's virtual *pass* by ``1/weight``
+(``MXTPU_SERVE_TENANT_WEIGHTS``, e.g. ``gold:4,free:1``; unlisted
+tenants weigh 1) and the lowest pass goes next — so over any window,
+service converges to the weight ratio NO MATTER how hard one tenant
+floods.  A tenant reactivating after idling is clamped to the current
+virtual time (no banked credit), a per-tenant queued-request quota
+(``MXTPU_SERVE_TENANT_QUOTA``) sheds a flooder at admission with
+:class:`TenantQuotaExceeded` (HTTP 429, ``shed_tenant``) before it
+occupies the shared queue bound, and the existing semantics survive
+inside each tenant untouched: priority desc / FIFO within a level per
+tenant, deadline expiry everywhere, and the global anti-starvation
+floor rides the ELDEST queued request across all tenants.  Requests
+that never set a tenant share one default bucket — single-tenant
+traffic dispatches in the exact historical order.
+
 BIT-EXACTNESS CONTRACT: a request's result depends only on its own
 bytes and the bucket shape it ran at — never on batch fill, its row
 position, or co-batched requests.  (XLA re-tiles reductions per batch
@@ -51,8 +69,10 @@ from ..base import MXNetError, get_env, register_env
 from ..resilience import faults
 
 __all__ = ["BucketBatcher", "QueueFull", "Draining", "DeadlineExpired",
-           "parse_buckets", "pick_bucket", "pad_to_bucket",
-           "ENV_SERVE_BUCKETS", "ENV_SERVE_MAX_WAIT_MS"]
+           "TenantQuotaExceeded", "parse_buckets", "pick_bucket",
+           "pad_to_bucket", "parse_tenant_weights", "DEFAULT_TENANT",
+           "ENV_SERVE_BUCKETS", "ENV_SERVE_MAX_WAIT_MS",
+           "ENV_SERVE_TENANT_WEIGHTS", "ENV_SERVE_TENANT_QUOTA"]
 
 ENV_SERVE_BUCKETS = register_env(
     "MXTPU_SERVE_BUCKETS", default="1,2,4,8,16,32",
@@ -62,6 +82,20 @@ ENV_SERVE_MAX_WAIT_MS = register_env(
     "MXTPU_SERVE_MAX_WAIT_MS", default=2.0,
     doc="How long a dispatching batch may hold the queue open for "
         "stragglers, measured from the oldest queued request (ms)")
+ENV_SERVE_TENANT_WEIGHTS = register_env(
+    "MXTPU_SERVE_TENANT_WEIGHTS", default="",
+    doc="Weighted-fair tenant shares for the serving batcher, e.g. "
+        "'gold:4,free:1'; unlisted tenants (and requests with no "
+        "tenant) weigh 1; empty = all tenants equal")
+ENV_SERVE_TENANT_QUOTA = register_env(
+    "MXTPU_SERVE_TENANT_QUOTA", default=0,
+    doc="Per-tenant queued-request bound in the serving batcher: a "
+        "tenant at its quota is shed with HTTP 429 (shed_tenant) while "
+        "everyone else keeps queueing; 0 = unbounded")
+
+#: the tenant label for requests that never set one — single-tenant
+#: traffic all lands here and dispatches in the exact pre-WFQ order
+DEFAULT_TENANT = ""
 
 #: fault points on the batch forward: ``serve_forward`` (arm = failing
 #: model, arm_hang = a timed stall) and ``hang_serve_forward`` (a
@@ -86,6 +120,13 @@ class DeadlineExpired(MXNetError):
     serving it would burn a bucket slot on dead work."""
 
 
+class TenantQuotaExceeded(MXNetError):
+    """The request's tenant already has ``MXTPU_SERVE_TENANT_QUOTA``
+    requests queued (HTTP 429, ``shed_tenant``) — the flood is shed at
+    admission, before it can occupy the shared queue bound and starve
+    every other tenant's admission too."""
+
+
 def parse_buckets(spec=None):
     """``"1,2,4,8"`` (or an int list) -> validated ascending tuple."""
     if spec is None:
@@ -104,6 +145,44 @@ def parse_buckets(spec=None):
         raise MXNetError("buckets must be positive, ascending, unique: %r"
                          % (buckets,))
     return buckets
+
+
+def parse_tenant_weights(spec=None):
+    """``"gold:4,free:1"`` (or a dict) -> ``{tenant: weight}``; empty
+    means every tenant weighs 1.  Weights must be > 0 — a zero share is
+    a ban, and bans belong at admission (the quota), not in the
+    scheduler where they would starve silently."""
+    if spec is None:
+        spec = get_env(ENV_SERVE_TENANT_WEIGHTS)
+    if isinstance(spec, dict):
+        pairs = list(spec.items())
+    else:
+        spec = (spec or "").strip()
+        if not spec:
+            return {}
+        pairs = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                raise MXNetError("bad tenant weight %r (want "
+                                 "'tenant:share')" % (part,))
+            name, share = part.rsplit(":", 1)
+            pairs.append((name.strip(), share))
+    out = {}
+    for name, share in pairs:
+        try:
+            w = float(share)
+        except (TypeError, ValueError):
+            raise MXNetError("bad tenant weight share %r for %r"
+                             % (share, name))
+        if w <= 0:
+            raise MXNetError(
+                "tenant %r weight must be > 0 (got %r) — to ban a "
+                "tenant use the quota, not a zero share" % (name, w))
+        out[name] = w
+    return out
 
 
 def pick_bucket(n, buckets):
@@ -160,25 +239,28 @@ class _Future(object):
 
 class _Request(object):
     __slots__ = ("inputs", "future", "enqueued_at", "priority",
-                 "deadline", "seq")
+                 "deadline", "seq", "tenant")
 
-    def __init__(self, inputs, priority=0, deadline=None, seq=0):
+    def __init__(self, inputs, priority=0, deadline=None, seq=0,
+                 tenant=DEFAULT_TENANT):
         self.inputs = inputs
         self.future = _Future()
         self.enqueued_at = time.monotonic()
         self.priority = int(priority)
         self.deadline = deadline            # absolute monotonic, or None
         self.seq = seq
+        self.tenant = tenant
 
     def heap_key(self):
-        """Dispatch order: highest priority first, FIFO (arrival seq)
-        within a priority level — the historical strict-FIFO order is
-        the seq tiebreak, so equal-priority traffic is untouched."""
+        """Dispatch order WITHIN a tenant: highest priority first, FIFO
+        (arrival seq) within a priority level — the historical
+        strict-FIFO order is the seq tiebreak, so equal-priority
+        traffic is untouched."""
         return (-self.priority, self.seq)
 
 
 class BucketBatcher(object):
-    """One model's queue + dispatcher thread.
+    """One model's queues + dispatcher thread.
 
     ``runner(inputs, n_valid)`` receives ``{input_name: (bucket, *sample)
     float32 array}`` and returns a list of per-output ``(bucket, ...)``
@@ -187,8 +269,15 @@ class BucketBatcher(object):
     the underlying ``Predictor`` needs no locking.
     """
 
+    #: bound on DISTINCT tenant queues (the fairness table must stay a
+    #: scan-able dict, not an unbounded attacker-controlled map):
+    #: tenant number MAX_TENANTS+1 folds into the default bucket — it
+    #: still gets served, it just shares the default tenant's turn
+    MAX_TENANTS = 64
+
     def __init__(self, runner, buckets=None, max_wait_ms=None,
-                 max_queue=None, name="model", watchdog=None, stats=None):
+                 max_queue=None, name="model", watchdog=None, stats=None,
+                 tenant_weights=None, tenant_quota=None):
         self.runner = runner
         self.name = name
         self.buckets = parse_buckets(buckets)
@@ -198,14 +287,25 @@ class BucketBatcher(object):
         self.max_queue = max_queue          # None = unbounded (frontend
         self.watchdog = watchdog            # owns admission control)
         self.stats = stats
+        self.tenant_weights = parse_tenant_weights(tenant_weights)
+        self.tenant_quota = int(get_env(ENV_SERVE_TENANT_QUOTA)
+                                if tenant_quota is None else tenant_quota)
         self._cv = threading.Condition()
-        #: heap of (heap_key, _Request): highest priority first, FIFO
-        #: within a level (seq tiebreak)
-        self._queue = []
+        #: {tenant: heap of (heap_key, _Request)} — per-tenant queues;
+        #: single-tenant traffic all lives under DEFAULT_TENANT and
+        #: dispatches in the exact pre-WFQ heap order
+        self._queues = {}
+        #: {tenant: virtual pass} — the stride scheduler state: every
+        #: pop charges 1/weight; lowest pass fills the next slot
+        self._passes = {}
+        #: current virtual time = the pass of the last tenant chosen
+        #: (pre-charge); a reactivating tenant is clamped up to it so
+        #: idling never banks credit
+        self._vtime = 0.0
         self._seq = itertools.count()
         #: queued requests carrying a deadline — the common
         #: deadline-less workload keeps the dispatcher's expiry check
-        #: O(1) instead of scanning the heap every wake
+        #: O(1) instead of scanning the heaps every wake
         self._deadlines = 0
         self._inflight = 0
         self._draining = False
@@ -220,12 +320,46 @@ class BucketBatcher(object):
             target=self._loop, name="mxserve-batch-%s" % name, daemon=True)
         self._thread.start()
 
+    # -- WFQ internals (call with _cv held) --------------------------------
+    def _qtotal_locked(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def _weight(self, tenant):
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    def _charge_locked(self, tenant):
+        self._passes[tenant] = self._passes.get(tenant, 0.0) \
+            + 1.0 / self._weight(tenant)
+
+    def _pop_next_locked(self):
+        """One stride-scheduler step: lowest-pass tenant with queued
+        work pops ITS best request (priority desc, FIFO within) and
+        pays 1/weight.  Name tiebreak keeps ties deterministic."""
+        tenant = min((t for t, q in self._queues.items() if q),
+                     key=lambda t: (self._passes.get(t, 0.0), t))
+        self._vtime = self._passes.get(tenant, 0.0)
+        req = heapq.heappop(self._queues[tenant])[1]
+        self._charge_locked(tenant)
+        return req
+
+    def _all_queued_locked(self):
+        for q in self._queues.values():
+            for entry in q:
+                yield entry[1]
+
+    def tenant_depths(self):
+        """{tenant: queued count} for every tenant with queued work
+        (the /stats fairness surface; the default tenant shows as
+        ``""``)."""
+        with self._cv:
+            return {t: len(q) for t, q in self._queues.items() if q}
+
     # -- producer side -----------------------------------------------------
     @property
     def depth(self):
         """Queued + in-flight request count (the admission gauge)."""
         with self._cv:
-            return len(self._queue) + self._inflight
+            return self._qtotal_locked() + self._inflight
 
     def estimate_wait_ms(self):
         """Rough time a NEW request would spend queued: the work ahead
@@ -233,20 +367,23 @@ class BucketBatcher(object):
         batches) x the EMA batch service time.  0 for an empty queue or
         until the first batch has been timed (admit optimistically)."""
         with self._cv:
-            depth = len(self._queue) + self._inflight
+            depth = self._qtotal_locked() + self._inflight
             ema = self._ema_batch_s
         if not ema or not depth:
             return 0.0
         return depth / float(self.buckets[-1]) * ema * 1000.0
 
-    def submit(self, inputs, priority=0, deadline_ms=None):
+    def submit(self, inputs, priority=0, deadline_ms=None, tenant=None):
         """Queue one request (``{input_name: per-sample float32 array}``,
         NO batch dimension) -> future.  ``priority``: higher dispatches
         first (default 0 — all-equal keeps strict FIFO).  ``deadline_ms``:
         latency budget; a request still queued when it runs out is shed
         with :class:`DeadlineExpired` (a non-positive budget sheds
-        immediately).  Raises :class:`Draining` during shutdown and
-        :class:`QueueFull` at the queue bound."""
+        immediately).  ``tenant``: the fairness label (None = the
+        shared default bucket); a tenant at its queued quota is shed
+        with :class:`TenantQuotaExceeded`.  Raises :class:`Draining`
+        during shutdown and :class:`QueueFull` at the queue bound."""
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         deadline = None
         if deadline_ms is not None:
             if float(deadline_ms) <= 0:
@@ -261,18 +398,38 @@ class BucketBatcher(object):
             if self._draining:
                 raise Draining("model %r is draining" % self.name)
             if self.max_queue is not None and \
-                    len(self._queue) >= self.max_queue:
+                    self._qtotal_locked() >= self.max_queue:
                 raise QueueFull("model %r queue is at its bound (%d)"
                                 % (self.name, self.max_queue))
+            if tenant != DEFAULT_TENANT and tenant not in self._queues \
+                    and len(self._queues) >= self.MAX_TENANTS:
+                tenant = DEFAULT_TENANT     # see MAX_TENANTS
+            q = self._queues.get(tenant)
+            if self.tenant_quota > 0 and q is not None and \
+                    len(q) >= self.tenant_quota:
+                if self.stats is not None:
+                    self.stats.inc("shed_tenant")
+                raise TenantQuotaExceeded(
+                    "model %r: tenant %r is at its queued quota (%d) — "
+                    "shed, not queued" % (self.name, tenant,
+                                          self.tenant_quota))
             if self._sample_shapes is None:
                 self._sample_shapes = shapes
             elif shapes != self._sample_shapes:
                 raise MXNetError(
                     "request shapes %s do not match the model's %s"
                     % (shapes, self._sample_shapes))
+            if q is None:
+                q = self._queues[tenant] = []
+            if not q:
+                # (re)activation: no banked credit from idling — the
+                # tenant joins at the CURRENT virtual time, it does not
+                # cash in every turn it skipped
+                self._passes[tenant] = max(
+                    self._passes.get(tenant, 0.0), self._vtime)
             req = _Request(inputs, priority=priority, deadline=deadline,
-                           seq=next(self._seq))
-            heapq.heappush(self._queue, (req.heap_key(), req))
+                           seq=next(self._seq), tenant=tenant)
+            heapq.heappush(q, (req.heap_key(), req))
             if deadline is not None:
                 self._deadlines += 1
             self._cv.notify_all()
@@ -300,17 +457,21 @@ class BucketBatcher(object):
             return                  # O(1) for deadline-less traffic
         now = time.monotonic()
         if not any(r.deadline is not None and r.deadline <= now
-                   for _, r in self._queue):
+                   for r in self._all_queued_locked()):
             return
-        live, dead = [], []
-        for entry in self._queue:
-            req = entry[1]
-            if req.deadline is not None and req.deadline <= now:
-                dead.append(req)
-            else:
-                live.append(entry)
-        heapq.heapify(live)
-        self._queue = live
+        dead = []
+        for tenant, q in self._queues.items():
+            live, mine = [], []
+            for entry in q:
+                req = entry[1]
+                if req.deadline is not None and req.deadline <= now:
+                    mine.append(req)
+                else:
+                    live.append(entry)
+            if mine:
+                heapq.heapify(live)
+                self._queues[tenant] = live
+                dead.extend(mine)
         self._deadlines -= len(dead)
         for req in dead:
             req.future.set_error(DeadlineExpired(
@@ -321,23 +482,27 @@ class BucketBatcher(object):
 
     #: anti-starvation floor: a queued request older than
     #: ``max(8 x max_wait, STARVATION_S)`` seconds claims one slot of
-    #: the next batch UNCONDITIONALLY, priority notwithstanding.
-    #: Without it, sustained higher-priority arrivals at >= service
-    #: rate could hold a low-priority request in the queue forever
-    #: (the max-wait timer forces *a* dispatch, not *its* dispatch) —
-    #: priorities delay work, they must never starve it.  One slot per
-    #: batch gives the aged head-of-line guaranteed progress while the
-    #: rest of the bucket still fills highest-priority-first.
+    #: the next batch UNCONDITIONALLY, priority and tenant passes
+    #: notwithstanding.  Without it, sustained higher-priority arrivals
+    #: at >= service rate could hold a low-priority request in the
+    #: queue forever (the max-wait timer forces *a* dispatch, not *its*
+    #: dispatch) — priorities delay work, they must never starve it.
+    #: The floor rides the GLOBAL eldest across all tenants (and still
+    #: charges its tenant's pass: guaranteed progress, not free
+    #: service).  One slot per batch gives the aged head-of-line
+    #: guaranteed progress while the rest of the bucket still fills by
+    #: the fair-share order.
     STARVATION_S = 0.25
 
     def _next_batch(self):
         """Block for the first request, then hold the batch open until
         the largest bucket fills or the oldest request ages past
         max_wait (draining skips the wait — flush what is queued).
-        Selection order is the heap's: priority desc, arrival FIFO
-        within a level — except that a request past the starvation
-        bound rides first (see :data:`STARVATION_S`); past-deadline
-        entries are expired, never dispatched."""
+        Slot-fill order is the stride scheduler's (lowest tenant pass;
+        priority desc / FIFO within the tenant) — except that a request
+        past the starvation bound rides first (see
+        :data:`STARVATION_S`); past-deadline entries are expired, never
+        dispatched."""
         cap = self.buckets[-1]
         with self._cv:
             while True:
@@ -348,29 +513,34 @@ class BucketBatcher(object):
                     # weights (a close() overrides — shutdown wins)
                     self._cv.wait(0.05)
                     continue
-                if not self._queue:
+                total = self._qtotal_locked()
+                if not total:
                     if self._closing:
                         return None
                     self._cv.wait(0.1)
                     continue
                 # max-wait is measured from the OLDEST queued request
-                # regardless of its priority — a low-priority straggler
-                # cannot be deferred past the wait bound
-                oldest = min(r.enqueued_at for _, r in self._queue)
+                # regardless of priority or tenant — a low-priority
+                # straggler cannot be deferred past the wait bound
+                oldest = min(r.enqueued_at
+                             for r in self._all_queued_locked())
                 left = self.max_wait - (time.monotonic() - oldest)
-                if len(self._queue) >= cap or self._draining or left <= 0:
+                if total >= cap or self._draining or left <= 0:
                     break
                 self._cv.wait(min(left, 0.02))
-            take = min(len(self._queue), cap)
+            take = min(self._qtotal_locked(), cap)
             batch = []
-            eldest = min(self._queue, key=lambda e: e[1].enqueued_at)
+            eldest = min(self._all_queued_locked(),
+                         key=lambda r: r.enqueued_at)
             bound = max(8.0 * self.max_wait, self.STARVATION_S)
-            if time.monotonic() - eldest[1].enqueued_at > bound:
-                self._queue.remove(eldest)
-                heapq.heapify(self._queue)
-                batch.append(eldest[1])
+            if time.monotonic() - eldest.enqueued_at > bound:
+                q = self._queues[eldest.tenant]
+                q.remove((eldest.heap_key(), eldest))
+                heapq.heapify(q)
+                self._charge_locked(eldest.tenant)
+                batch.append(eldest)
             while len(batch) < take:
-                batch.append(heapq.heappop(self._queue)[1])
+                batch.append(self._pop_next_locked())
             self._deadlines -= sum(1 for r in batch
                                    if r.deadline is not None)
             self._inflight = len(batch)
@@ -398,7 +568,7 @@ class BucketBatcher(object):
             for r in batch:
                 r.future.set_error(e)
             with self._cv:
-                if not self._queue:
+                if not self._qtotal_locked():
                     # the pinned shapes may be the very thing that made
                     # this batch fail (a malformed first request) — let
                     # the next request after a drained queue re-pin
@@ -415,7 +585,10 @@ class BucketBatcher(object):
                 [o[i] if np.ndim(o) and np.shape(o)[0] == bucket else o
                  for o in outs])
             if self.stats is not None:
-                self.stats.record_latency((now - r.enqueued_at) * 1000.0)
+                self.stats.record_latency(
+                    (now - r.enqueued_at) * 1000.0,
+                    tenant=r.tenant if r.tenant != DEFAULT_TENANT
+                    else None)
 
     def run_exclusive(self, fn, timeout=30.0):
         """Run ``fn()`` at the DISPATCH BOUNDARY: wait for the in-flight
@@ -465,7 +638,8 @@ class BucketBatcher(object):
         with self._cv:
             self._draining = True
             if not drain:
-                dropped, self._queue = [r for _, r in self._queue], []
+                dropped = list(self._all_queued_locked())
+                self._queues = {}
                 self._deadlines = 0
             else:
                 dropped = []
@@ -473,7 +647,7 @@ class BucketBatcher(object):
         for r in dropped:
             r.future.set_error(Draining("dropped: close(drain=False)"))
         with self._cv:
-            while self._queue or self._inflight:
+            while self._qtotal_locked() or self._inflight:
                 if time.monotonic() >= deadline:
                     break
                 self._cv.wait(0.1)

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..base import MXNetError, get_env, register_env
 from .batcher import (BucketBatcher, DeadlineExpired, Draining, QueueFull,
-                      parse_buckets)
+                      TenantQuotaExceeded, parse_buckets)
 
 __all__ = ["ServingFrontend", "ServeClient", "Stats",
            "ENV_SERVE_MAX_QUEUE", "ENV_SERVE_SLO_MS"]
@@ -56,7 +56,14 @@ def _percentile(sorted_vals, q):
 
 class Stats(object):
     """Thread-safe serving metrics: monotonically increasing counters, a
-    bounded latency window for percentiles, and batch-fill accounting."""
+    bounded latency window for percentiles, and batch-fill accounting.
+    ``record_latency(ms, tenant=...)`` additionally feeds a bounded
+    per-tenant window (at most :data:`MAX_TENANTS` distinct tenants —
+    past the cap new tenants fold into the shared window only, so a
+    tenant-id flood cannot grow the stats dict without bound)."""
+
+    #: distinct tenants tracked with their own latency window
+    MAX_TENANTS = 64
 
     def __init__(self, window=4096):
         self._lock = threading.Lock()
@@ -64,6 +71,7 @@ class Stats(object):
                           "shed_queue": 0, "shed_slo": 0,
                           "shed_deadline": 0, "rejected": 0}
         self._latencies = deque(maxlen=window)
+        self._tenant_lat = {}
         self._batches = 0
         self._rows = 0
         self._bucket_rows = 0
@@ -73,9 +81,16 @@ class Stats(object):
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + n
 
-    def record_latency(self, ms):
+    def record_latency(self, ms, tenant=None):
         with self._lock:
             self._latencies.append(float(ms))
+            if tenant:
+                win = self._tenant_lat.get(tenant)
+                if win is None:
+                    if len(self._tenant_lat) >= self.MAX_TENANTS:
+                        return
+                    win = self._tenant_lat[tenant] = deque(maxlen=512)
+                win.append(float(ms))
 
     def record_batch(self, n, bucket, seconds):
         with self._lock:
@@ -88,6 +103,8 @@ class Stats(object):
         with self._lock:
             lat = sorted(self._latencies)
             counters = dict(self._counters)
+            tenant_lat = {t: sorted(w)
+                          for t, w in self._tenant_lat.items()}
             batches, rows = self._batches, self._rows
             bucket_rows, batch_time = self._bucket_rows, self._batch_time
         out = {"counters": counters,
@@ -99,7 +116,55 @@ class Stats(object):
                            if bucket_rows else None,
                            "avg_ms": round(batch_time / batches * 1000.0, 3)
                            if batches else None}}
+        if tenant_lat:
+            out["tenant_latency_ms"] = {
+                t: {"count": len(w), "p50": _percentile(w, 50),
+                    "p99": _percentile(w, 99)}
+                for t, w in tenant_lat.items()}
         return out
+
+    # -- multi-process merge (the sharded fleet front end) -----------------
+    def export(self, window_cap=1024):
+        """Serializable raw state for cross-process merging: counters,
+        the latency window tail, batch accounting.  What each router
+        worker dumps; :meth:`merged_snapshot` recombines."""
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "window": list(self._latencies)[-int(window_cap):],
+                    "batches": [self._batches, self._rows,
+                                self._bucket_rows, self._batch_time]}
+
+    @classmethod
+    def merged_snapshot(cls, exports):
+        """Combine :meth:`export` dicts from N processes into one
+        ``snapshot()``-shaped payload: counters summed, percentiles over
+        the concatenated windows (each window is a bounded tail, so the
+        merged p50/p99 reflects recent traffic across the shard)."""
+        counters = {}
+        window = []
+        batches = rows = bucket_rows = 0
+        batch_time = 0.0
+        for exp in exports:
+            for k, v in (exp.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + v
+            window.extend(exp.get("window") or ())
+            b = exp.get("batches") or (0, 0, 0, 0.0)
+            batches += int(b[0])
+            rows += int(b[1])
+            bucket_rows += int(b[2])
+            batch_time += float(b[3])
+        lat = sorted(window)
+        return {"counters": counters,
+                "latency_ms": {"count": len(lat),
+                               "p50": _percentile(lat, 50),
+                               "p99": _percentile(lat, 99)},
+                "batches": {"count": batches, "rows": rows,
+                            "fill_ratio": round(rows / bucket_rows, 4)
+                            if bucket_rows else None,
+                            "avg_ms": round(batch_time / batches
+                                            * 1000.0, 3)
+                            if batches else None},
+                "merged_from": len(exports)}
 
 
 class ServingFrontend(object):
@@ -112,6 +177,9 @@ class ServingFrontend(object):
                                  (or {"data": [...]} shorthand, or a raw
                                  .npy body with Content-Type
                                  application/x-npy for the sole input)
+        POST /predict_seq/<model>  body: {"tokens": [...]} — one
+                                 variable-length token sequence, length-
+                                 bucketed + trimmed (serving/sequence.py)
         GET  /healthz           {"status": "ok"|"draining", ...}
         GET  /stats             counters + queue depth + fill + p50/p99
 
@@ -122,11 +190,22 @@ class ServingFrontend(object):
 
     def __init__(self, pool, host="127.0.0.1", port=0, buckets=None,
                  max_wait_ms=None, max_queue=None, slo_ms=None,
-                 watchdog=None, request_timeout=60.0):
+                 watchdog=None, request_timeout=60.0,
+                 tenant_weights=None, tenant_quota=None,
+                 seq_buckets=None):
         self.pool = pool
         self.host, self.port = host, int(port)
         self.buckets = parse_buckets(buckets)
+        #: sequence-LENGTH buckets for /predict_seq (spec string/ints;
+        #: None = the MXTPU_SERVE_SEQ_BUCKETS default, parsed lazily so
+        #: fixed-shape-only daemons never read the knob)
+        self.seq_buckets = seq_buckets
+        self._seq_buckets = None
         self.max_wait_ms = max_wait_ms
+        #: weighted-fair tenant config, passed to every batcher (None =
+        #: the MXTPU_SERVE_TENANT_* env defaults)
+        self.tenant_weights = tenant_weights
+        self.tenant_quota = tenant_quota
         self.max_queue = int(get_env(ENV_SERVE_MAX_QUEUE)) \
             if max_queue is None else int(max_queue)
         self.slo_ms = float(get_env(ENV_SERVE_SLO_MS)) \
@@ -181,7 +260,9 @@ class ServingFrontend(object):
                     entry.forward, buckets=self.buckets,
                     max_wait_ms=self.max_wait_ms,
                     max_queue=self.max_queue, name=model,
-                    watchdog=wd, stats=self.stats)
+                    watchdog=wd, stats=self.stats,
+                    tenant_weights=self.tenant_weights,
+                    tenant_quota=self.tenant_quota)
                 self._batchers[model] = b
         return b
 
@@ -256,13 +337,15 @@ class ServingFrontend(object):
         return True, 200, None
 
     def handle_predict(self, model, inputs, entry=None, priority=0,
-                       deadline_ms=None):
+                       deadline_ms=None, tenant=None):
         """Admission + batch + wait; returns ``(status, payload_dict)``.
         Usable without the HTTP layer (tests, in-process serving).
         ``entry`` skips the pool lookup when the caller (the HTTP
-        handler's 404 check) already resolved it.  ``priority`` and
-        ``deadline_ms`` pass through to :meth:`BucketBatcher.submit`
-        (deadline expiry answers 429 ``shed_deadline``)."""
+        handler's 404 check) already resolved it.  ``priority``,
+        ``deadline_ms`` and ``tenant`` pass through to
+        :meth:`BucketBatcher.submit` (deadline expiry answers 429
+        ``shed_deadline``; a tenant at its queued quota answers 429
+        ``shed_tenant``)."""
         if entry is None:
             entry = self.pool.get(model)
         if entry.sample_shapes is not None:
@@ -275,40 +358,113 @@ class ServingFrontend(object):
                 return 400, {"error": "input shapes %s != model's %s"
                              % (got, want), "model": model}
         b = self.batcher(model, entry=entry)
+        status, err, outs, ms = self._submit_wait(
+            b, model, inputs, priority, deadline_ms, tenant)
+        if err is not None:
+            return status, err
+        return 200, {"model": model,
+                     "outputs": [np.asarray(o).tolist() for o in outs],
+                     "ms": ms}
+
+    def _submit_wait(self, b, model, inputs, priority, deadline_ms,
+                     tenant):
+        """Admission + queue + wait on ONE batcher — the shared tail of
+        :meth:`handle_predict` and :meth:`handle_predict_seq`.  Returns
+        ``(status, error_payload_or_None, outputs, ms)``."""
         ok, status, reason = self._admit(b)
         if not ok:
-            return status, {"error": reason, "model": model}
+            return status, {"error": reason, "model": model}, None, None
         tic = time.monotonic()
         try:
             fut = b.submit(inputs, priority=priority,
-                           deadline_ms=deadline_ms)
+                           deadline_ms=deadline_ms, tenant=tenant)
             # counted only once the request actually entered the queue
             # — a submit-time shed (spent deadline, drain/bound race)
             # must not inflate `accepted` the way shed_queue/shed_slo
             # don't (the accepted-vs-completed ledger on /stats)
             self.stats.inc("accepted")
             outs = fut.result(timeout=self.request_timeout)
+        except TenantQuotaExceeded as e:
+            # shed, not failed: the batcher already counted shed_tenant
+            return 429, {"error": str(e), "model": model,
+                         "reason": "shed_tenant"}, None, None
         except DeadlineExpired as e:
             # shed, not failed: the batcher already counted
             # shed_deadline — same 429 contract as shed_queue/shed_slo
             return 429, {"error": str(e), "model": model,
-                         "reason": "shed_deadline"}
+                         "reason": "shed_deadline"}, None, None
         except (Draining, QueueFull) as e:
             # lost the race with a drain/bound between admit and submit
             self.stats.inc("rejected")
-            return 429 if isinstance(e, QueueFull) else 503, \
-                {"error": str(e), "model": model}
+            return (429 if isinstance(e, QueueFull) else 503,
+                    {"error": str(e), "model": model}, None, None)
         except TimeoutError as e:
             self.stats.inc("errors")
-            return 504, {"error": str(e), "model": model}
+            return 504, {"error": str(e), "model": model}, None, None
         except Exception as e:  # noqa: BLE001 — the model failed, not us
             self.stats.inc("errors")
             return 500, {"error": "%s: %s" % (type(e).__name__, e),
-                         "model": model}
+                         "model": model}, None, None
         self.stats.inc("completed")
-        return 200, {"model": model,
-                     "outputs": [np.asarray(o).tolist() for o in outs],
-                     "ms": round((time.monotonic() - tic) * 1000.0, 3)}
+        return 200, None, outs, \
+            round((time.monotonic() - tic) * 1000.0, 3)
+
+    # -- bucketed sequence serving (serving/sequence.py) -------------------
+    def seq_batcher(self, model, seq_len, entry=None):
+        """The (model, length-bucket) batcher, created on first use
+        under the key ``model@seq<L>`` (its own /stats row)."""
+        from .sequence import SequenceEntry, seq_batcher_name
+        key = seq_batcher_name(model, seq_len)
+        with self._lock:
+            b = self._batchers.get(key)
+        if b is not None:
+            return b
+        if entry is None:
+            entry = self.pool.get(model)
+        return self.batcher(key, entry=SequenceEntry(entry, seq_len))
+
+    def handle_predict_seq(self, model, tokens, entry=None, priority=0,
+                           deadline_ms=None, tenant=None):
+        """One variable-length token sequence in, its per-step outputs
+        (trimmed back to the TRUE length) out — the bucketed sequence
+        path (serving/sequence.py).  Same status contract as
+        :meth:`handle_predict`, plus 400 for a sequence longer than the
+        largest configured bucket."""
+        from .sequence import parse_seq_buckets, pick_seq_bucket
+        if entry is None:
+            entry = self.pool.get(model)
+        try:
+            if self._seq_buckets is None:
+                self._seq_buckets = parse_seq_buckets(self.seq_buckets)
+            arr = np.asarray(tokens, dtype=np.float32)
+            if arr.ndim != 1 or not arr.size:
+                raise MXNetError("tokens must be a non-empty flat list, "
+                                 "got shape %s" % (arr.shape,))
+            bucket = pick_seq_bucket(arr.shape[0], self._seq_buckets)
+        except MXNetError as e:
+            return 400, {"error": str(e), "model": model}
+        n = int(arr.shape[0])
+        if n < bucket:
+            # edge-pad with the LAST real token (the pad_to_bucket
+            # rule): the causal scan never lets pad steps reach the
+            # real ones, and repeating a real id can't leave the
+            # embedding table the way an invalid filler id could
+            arr = np.concatenate([arr, np.repeat(arr[-1:], bucket - n)])
+        names = getattr(entry, "input_names", None) or ["data"]
+        data_name = "data" if "data" in names else names[0]
+        b = self.seq_batcher(model, bucket, entry=entry)
+        status, err, outs, ms = self._submit_wait(
+            b, model, {data_name: arr}, priority, deadline_ms, tenant)
+        if err is not None:
+            return status, err
+        trimmed = []
+        for o in outs:
+            o = np.asarray(o)
+            if o.ndim and o.shape[0] == bucket:
+                o = o[:n]
+            trimmed.append(o.tolist())
+        return 200, {"model": model, "bucket": bucket, "len": n,
+                     "outputs": trimmed, "ms": ms}
 
     def stats_payload(self):
         payload = self.stats.snapshot()
@@ -321,6 +477,13 @@ class ServingFrontend(object):
         payload["est_wait_ms"] = {
             name: round(b.estimate_wait_ms(), 3)
             for name, b in batchers.items()}
+        # per-tenant queued depth (the fairness surface): only models
+        # with tenant-labeled work show up, so the single-tenant
+        # payload is byte-identical to before
+        tenants = {name: depths for name, b in batchers.items()
+                   for depths in [b.tenant_depths()] if depths}
+        if tenants:
+            payload["tenants"] = tenants
         payload["draining"] = self.draining
         payload["buckets"] = list(self.buckets)
         payload["epochs"] = self.epochs()
@@ -443,16 +606,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "unknown path %r" % self.path})
 
     def _qos(self, payload=None):
-        """(priority, deadline_ms) from the ``X-MXTPU-Priority`` /
-        ``X-MXTPU-Deadline-Ms`` headers, overridden by same-named JSON
-        body fields (``priority`` / ``deadline_ms``) when present."""
+        """(priority, deadline_ms, tenant) from the ``X-MXTPU-Priority``
+        / ``X-MXTPU-Deadline-Ms`` / ``X-MXTPU-Tenant`` headers,
+        overridden by same-named JSON body fields (``priority`` /
+        ``deadline_ms`` / ``tenant``) when present."""
         priority = self.headers.get("X-MXTPU-Priority")
         deadline = self.headers.get("X-MXTPU-Deadline-Ms")
+        tenant = self.headers.get("X-MXTPU-Tenant")
         if payload is not None and isinstance(payload, dict):
             priority = payload.get("priority", priority)
             deadline = payload.get("deadline_ms", deadline)
+            tenant = payload.get("tenant", tenant)
         return (int(priority) if priority is not None else 0,
-                float(deadline) if deadline is not None else None)
+                float(deadline) if deadline is not None else None,
+                str(tenant) if tenant is not None else None)
 
     def _parse_inputs(self, entry):
         length = int(self.headers.get("Content-Length", 0))
@@ -491,6 +658,28 @@ class _Handler(BaseHTTPRequestHandler):
             status, out = self.fe.handle_swap(model, epoch=epoch)
             self._reply(status, out)
             return
+        if self.path.startswith("/predict_seq/"):
+            # the bucketed-sequence path: body {"tokens": [...ids...]}
+            model = self.path[len("/predict_seq/"):].strip("/")
+            try:
+                entry = self.fe.pool.get(model)
+            except MXNetError as e:
+                self._reply(404, {"error": str(e)})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length)
+                                     .decode("utf-8"))
+                tokens = payload["tokens"]
+                priority, deadline_ms, tenant = self._qos(payload)
+            except Exception as e:  # noqa: BLE001 — malformed body
+                self._reply(400, {"error": "bad request body: %s" % (e,)})
+                return
+            status, out = self.fe.handle_predict_seq(
+                model, tokens, entry=entry, priority=priority,
+                deadline_ms=deadline_ms, tenant=tenant)
+            self._reply(status, out)
+            return
         if not self.path.startswith("/predict/"):
             self._reply(404, {"error": "unknown path %r" % self.path})
             return
@@ -501,13 +690,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": str(e)})
             return
         try:
-            inputs, (priority, deadline_ms) = self._parse_inputs(entry)
+            inputs, (priority, deadline_ms, tenant) = \
+                self._parse_inputs(entry)
         except Exception as e:  # noqa: BLE001 — malformed client body
             self._reply(400, {"error": "bad request body: %s" % (e,)})
             return
         status, payload = self.fe.handle_predict(
             model, inputs, entry=entry, priority=priority,
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms, tenant=tenant)
         self._reply(status, payload)
 
 
@@ -565,11 +755,11 @@ class ServeClient(object):
         return resp.status, payload
 
     def predict(self, model, inputs, npy=False, priority=None,
-                deadline_ms=None):
+                deadline_ms=None, tenant=None):
         """``inputs``: {name: per-sample array} (or a bare array for the
-        single-input case).  ``priority``/``deadline_ms`` ride as
-        ``X-MXTPU-*`` headers (work on both body formats).  Returns
-        ``(status, payload)``."""
+        single-input case).  ``priority``/``deadline_ms``/``tenant``
+        ride as ``X-MXTPU-*`` headers (work on both body formats).
+        Returns ``(status, payload)``."""
         if not isinstance(inputs, dict):
             inputs = {"data": inputs}
         qos = {}
@@ -577,6 +767,8 @@ class ServeClient(object):
             qos["X-MXTPU-Priority"] = str(int(priority))
         if deadline_ms is not None:
             qos["X-MXTPU-Deadline-Ms"] = str(float(deadline_ms))
+        if tenant is not None:
+            qos["X-MXTPU-Tenant"] = str(tenant)
         if npy:
             import io as _pyio
             (name, arr), = inputs.items()
@@ -590,6 +782,26 @@ class ServeClient(object):
                         for k, v in inputs.items()}}).encode("utf-8")
         return self._request(
             "POST", "/predict/%s" % model, body=body,
+            headers={"Content-Type": "application/json", **qos})
+
+    def predict_seq(self, model, tokens, priority=None,
+                    deadline_ms=None, tenant=None):
+        """POST /predict_seq/<model>: one variable-length token list;
+        the daemon buckets, batches, and trims (serving/sequence.py).
+        Returns ``(status, payload)`` with per-step ``outputs`` cut to
+        the true length."""
+        qos = {}
+        if priority is not None:
+            qos["X-MXTPU-Priority"] = str(int(priority))
+        if deadline_ms is not None:
+            qos["X-MXTPU-Deadline-Ms"] = str(float(deadline_ms))
+        if tenant is not None:
+            qos["X-MXTPU-Tenant"] = str(tenant)
+        body = json.dumps(
+            {"tokens": [int(t) for t in np.asarray(tokens).ravel()]}
+        ).encode("utf-8")
+        return self._request(
+            "POST", "/predict_seq/%s" % model, body=body,
             headers={"Content-Type": "application/json", **qos})
 
     def swap(self, model, epoch=None):

@@ -564,6 +564,84 @@ def test_fleet_mode_is_known_and_in_the_pipeline_set():
 
 
 # ---------------------------------------------------------------------------
+# overdrive mode (ISSUE 17: the sharded front end)
+# ---------------------------------------------------------------------------
+
+def test_gate_keys_cover_overdrive_metrics(tmp_path):
+    """The sharded front end's contracts are gate-guarded: absolute
+    dispatch QPS and the worker-scaling ratio (higher is better), the
+    quiet-tenant p99 under flood (a LATENCY — guarded through
+    LOWER_IS_BETTER_KEYS, so a RISE blocks and an improvement passes)
+    and the autoscale drop-free flag.  A vanished key blocks like
+    everywhere else."""
+    for key in ("overdrive_qps", "overdrive_qps_x",
+                "overdrive_tenant_p99_ms", "overdrive_drop_free"):
+        assert key in bench.GATE_KEYS
+    assert "overdrive_tenant_p99_ms" in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, overdrive_qps=3200.0, overdrive_qps_x=4.3,
+                overdrive_tenant_p99_ms=18.0, overdrive_drop_free=1.0)
+    # quiet-tenant p99 BLOWING UP (WFQ isolation broken) blocks...
+    worse = dict(base, overdrive_tenant_p99_ms=90.0)
+    rep = bench.gate(_write(tmp_path / "worse.json", worse),
+                     against=_write(tmp_path / "old.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "overdrive_tenant_p99_ms"
+    assert "rise" in rep["regressions"][0]
+    # ...while an improvement passes (the lower-is-better contract)
+    better = dict(base, overdrive_tenant_p99_ms=5.0)
+    rep = bench.gate(_write(tmp_path / "better.json", better),
+                     against=_write(tmp_path / "o2.json", base))
+    assert rep["pass"], rep
+    # a dropped request during the autoscale round trip blocks
+    dropped = dict(base, overdrive_drop_free=0.0)
+    rep = bench.gate(_write(tmp_path / "drop.json", dropped),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "overdrive_drop_free"
+    # a vanished overdrive key IS a regression (the mode timing out
+    # cannot silently un-gate the front end)
+    gone = {k: v for k, v in base.items() if k != "overdrive_qps"}
+    rep = bench.gate(_write(tmp_path / "gone.json", gone),
+                     against=_write(tmp_path / "o4.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "overdrive_qps"
+
+
+def test_gate_skips_overdrive_scaling_on_small_hosts(tmp_path):
+    """overdrive_qps_x needs clients + 4 reuseport workers + replica
+    running concurrently; a host without the cores emits
+    overdrive_note and the gate skips the SHAPE key only — the
+    absolute overdrive_qps still gates, and a note-less collapse still
+    blocks (the SCALING_SHAPE_KEYS honesty machinery)."""
+    assert bench.SCALING_SHAPE_KEYS["overdrive_qps_x"] == \
+        "overdrive_note"
+    base = dict(BASE, overdrive_qps=3200.0, overdrive_qps_x=4.3,
+                overdrive_drop_free=1.0)
+    flat = dict(base, overdrive_qps_x=1.0,
+                overdrive_note="flat_by_construction_1core")
+    rep = bench.gate(_write(tmp_path / "new.json", flat),
+                     against=_write(tmp_path / "old.json", base))
+    assert rep["pass"], rep
+    assert "overdrive_qps_x" in rep["skipped_flat_by_construction"]
+    # the absolute QPS key still gates on a noted host
+    worse = dict(flat, overdrive_qps=1000.0)
+    rep = bench.gate(_write(tmp_path / "n2.json", worse),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "overdrive_qps"
+    # no note -> a scaling collapse IS a regression
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, overdrive_qps_x=1.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "overdrive_qps_x"
+
+
+def test_overdrive_mode_is_known_and_in_the_pipeline_set():
+    assert "overdrive" in bench.KNOWN_MODES
+
+
+# ---------------------------------------------------------------------------
 # hotswap mode (ISSUE 13 satellite)
 # ---------------------------------------------------------------------------
 

@@ -798,8 +798,13 @@ def test_serving_drill_sigterm_drains_in_flight_requests(tmp_path):
         [sys.executable, SERVE, "--model", "mlp=%s:1" % prefix,
          "--input-shape", "data=32", "--port", "0",
          "--port-file", port_file, "--buckets", "32",
-         "--max-wait-ms", "1500"],   # a wide-open batch window: the
-        # 12 requests below are all still QUEUED when SIGTERM lands
+         "--max-wait-ms", "60000"],  # a wide-open batch window: the 12
+        # requests below stay QUEUED until SIGTERM lands (the drain
+        # flushes the window immediately, so the test is still fast).
+        # The window must comfortably outlast the accepted-count poll
+        # below — a 1500ms window used to dispatch the batch BEFORE the
+        # SIGTERM whenever full-suite load stretched a 0.4s sleep past
+        # it, failing the done_at >= sigterm_at assertion ~4/5 runs.
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
         text=True)
     try:
@@ -818,7 +823,25 @@ def test_serving_drill_sigterm_drains_in_flight_requests(tmp_path):
                    for i in range(12)]
         for t in threads:
             t.start()
-        time.sleep(0.4)              # requests accepted, batch window open
+        # deterministic arming: poll /stats until ALL 12 requests are
+        # verifiably accepted AND still queued (none dispatched, none
+        # refused) — a fixed sleep raced both edges under load: too
+        # short and a late arrival got the post-drain 503, too long
+        # and the batch window dispatched before the signal
+        cli = ServeClient("127.0.0.1", port)
+        try:
+            deadline = time.monotonic() + 60
+            while True:
+                _, stats = cli.stats()
+                if stats.get("counters", {}).get("accepted", 0) == 12 \
+                        and stats.get("queue_depth", {}) \
+                                 .get("mlp", 0) == 12:
+                    break
+                assert time.monotonic() < deadline, \
+                    "12 requests never all queued: %s" % (stats,)
+                time.sleep(0.02)
+        finally:
+            cli.close()
         proc.send_signal(signal.SIGTERM)
         sigterm_at = time.monotonic()
         for t in threads:

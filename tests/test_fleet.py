@@ -21,8 +21,9 @@ sys.path.insert(0, REPO)
 
 from mxnet_tpu.base import MXNetError  # noqa: E402
 from mxnet_tpu.fleet import (  # noqa: E402
-    FleetManifest, FleetRouter, ReplicaController, build_warm_store,
-    replica_device_env, warm_store_manifest)
+    Autoscaler, FleetManifest, FleetRouter, FleetViewPublisher,
+    FleetViewReader, ReplicaController, build_warm_store,
+    replica_device_env, reserve_port, warm_store_manifest)
 
 pytestmark = pytest.mark.serve
 
@@ -943,3 +944,295 @@ def test_spill_under_rollout_fence_never_targets_fenced_replica():
     finally:
         for f in fakes:
             f.close()
+
+
+# ---------------------------------------------------------------------------
+# sharded front end: the published fleet view + SO_REUSEPORT workers
+# ---------------------------------------------------------------------------
+
+def _mk_manifest(fakes, models=("a", "b")):
+    return FleetManifest.from_flags(
+        ["%s=/x:1" % m for m in models], ["data=4"],
+        replicas=len(fakes))
+
+
+def test_view_publisher_generation_and_reader_last_good(tmp_path,
+                                                        two_fakes):
+    prober = _mk_router(two_fakes)
+    path = str(tmp_path / "fleet-view.json")
+    pub = FleetViewPublisher(prober, path)
+    pub.publish_once()
+    reader = FleetViewReader(path, refresh_s=0.0)
+    doc = reader.doc()
+    assert reader.generation == 1
+    assert sorted(int(r) for r in doc["replicas"]) == [0, 1]
+    assert all(r["healthy"] for r in doc["replicas"].values())
+
+    prober.fence(1)
+    pub.publish_once()
+    assert reader.generation == 2
+    assert reader.fenced() == [1]
+    # fencing folds into the worker-visible health bit (replicas() maps
+    # back to the ORIGINAL int ids JSON stringified)
+    assert not reader.replicas()[1]["healthy"]
+
+    # a corrupt snapshot mid-write: the reader KEEPS the last good doc
+    # and counts the error — it never goes blind or backward
+    with open(path, "w") as f:
+        f.write("{half a json docum")
+    doc2 = reader.doc(force=True)
+    assert doc2["generation"] == 2
+    assert reader.read_errors >= 1
+    prober.unfence(1)
+
+
+def test_view_worker_routes_follows_fence_and_counts_stale(tmp_path,
+                                                           two_fakes):
+    """A worker routing over a STALE snapshot stays safe: it keeps
+    routing on the last-good view (fail-once 502s cover a dead addr)
+    and counts `stale_view_routes` so the operator sees the dead
+    publisher."""
+    prober = _mk_router(two_fakes)
+    prober.probe()
+    path = str(tmp_path / "fleet-view.json")
+    pub = FleetViewPublisher(prober, path)
+    pub.publish_once()
+
+    man = _mk_manifest(two_fakes)
+    worker = FleetRouter(FleetViewReader(path, refresh_s=0.0), man,
+                         port=0, evict_s=0.4, spill_queue=4)
+    sts = _predict(worker, "a", 2)          # home of "a" = replica 0
+    assert all(s == 200 for s, _, _ in sts)
+    assert len(two_fakes[0].received) == 2
+
+    # controller-side fence propagates through ONE publish, no worker
+    # coordination: new "a" traffic avoids replica 0
+    prober.fence(0)
+    pub.publish_once()
+    before = len(two_fakes[1].received)
+    sts = _predict(worker, "a", 2)
+    assert all(s == 200 for s, _, _ in sts)
+    assert len(two_fakes[0].received) == 2          # nothing new
+    assert len(two_fakes[1].received) == before + 2
+    prober.unfence(0)
+    pub.publish_once()
+
+    # no publisher for longer than evict_s: routing still works, the
+    # staleness is COUNTED rather than fatal
+    time.sleep(0.5)
+    sts = _predict(worker, "a", 1)
+    assert all(s == 200 for s, _, _ in sts)
+    assert worker.stats.snapshot()["counters"]["stale_view_routes"] >= 1
+
+
+def test_router_workers_share_reuseport_and_merge_stats(tmp_path,
+                                                        two_fakes):
+    """Two in-process view-mode workers bound to ONE kernel-balanced
+    port: every request answers, and ANY worker's /stats merges the
+    sibling dumps into one shard-wide payload."""
+    import socket as socket_mod
+    if not hasattr(socket_mod, "SO_REUSEPORT"):
+        pytest.skip("no SO_REUSEPORT on this platform")
+    import http.client
+
+    prober = _mk_router(two_fakes)
+    prober.probe()
+    path = str(tmp_path / "fleet-view.json")
+    FleetViewPublisher(prober, path).publish_once()
+
+    sock, port = reserve_port("127.0.0.1", 0)
+    man = _mk_manifest(two_fakes)
+    workers = []
+    try:
+        for i in range(2):
+            w = FleetRouter(FleetViewReader(path, refresh_s=0.05), man,
+                            host="127.0.0.1", port=port, reuse_port=True,
+                            worker_id=i, run_dir=str(tmp_path),
+                            spill_queue=8, evict_s=60.0)
+            w.serve_in_background()
+            workers.append(w)
+
+        body = json.dumps({"inputs": {"data": [0, 0, 0, 0]}}).encode()
+        for _ in range(20):             # fresh connection per request:
+            conn = http.client.HTTPConnection(      # the kernel picks
+                "127.0.0.1", port, timeout=10)      # the worker
+            conn.request("POST", "/predict/a", body=body,
+                         headers={"Content-Type": "application/json"})
+            assert conn.getresponse().status == 200
+            conn.close()
+
+        for w in workers:               # deterministic merge input
+            w.dump_worker_stats()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/stats")
+        resp = conn.getresponse()
+        payload = json.loads(resp.read().decode())
+        conn.close()
+        assert resp.status == 200
+        assert set(payload["workers"]) == {"0", "1"}
+        assert payload["router"]["merged_from"] == 2
+        # the shard-wide ledger: every request counted exactly once
+        assert payload["router"]["counters"]["routed"] == 20
+        assert payload["view"]["generation"] == 1
+    finally:
+        for w in workers:
+            w.drain_and_stop(timeout=5)
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy (fleet/autoscale.py) — synthetic signal, duck fleet
+# ---------------------------------------------------------------------------
+
+class _DuckRep(object):
+    def __init__(self, rid):
+        self.id, self.state = rid, "running"
+
+
+class _DuckController(object):
+    def __init__(self, n):
+        self.replicas = [_DuckRep(i) for i in range(n)]
+        self.log = []
+
+    def add_replica(self):
+        rep = _DuckRep(max(r.id for r in self.replicas) + 1)
+        self.replicas.append(rep)
+        self.log.append(("add", rep.id))
+        return rep
+
+    def stop_replica(self, rid, timeout=30.0):
+        self.log.append(("stop", rid))
+        for r in self.replicas:
+            if r.id == rid:
+                r.state = "scaled_down"
+        return 0
+
+
+class _DuckView(object):
+    def __init__(self):
+        self.stats = {"queue_depth": {}, "est_wait_ms": {}}
+        self.inflight = 0
+
+
+class _DuckRouter(object):
+    def __init__(self, rids):
+        self._lock = threading.Lock()
+        self._views = {r: _DuckView() for r in rids}
+        self._fenced = set()
+        self.log = []
+
+    def healthy(self):
+        return sorted(set(self._views) - self._fenced)
+
+    def fence(self, rid):
+        if len(self.healthy()) <= 1:
+            raise MXNetError("fencing replica %d would leave no "
+                             "routable replica" % rid)
+        self._fenced.add(rid)
+        self.log.append(("fence", rid))
+
+    def unfence(self, rid):
+        self._fenced.discard(rid)
+        self.log.append(("unfence", rid))
+
+
+def _mk_scaler(n=2, signal=None, **kw):
+    ctrl = _DuckController(n)
+    router = _DuckRouter(range(n))
+    sig = {"v": 0.0}
+    kw.setdefault("high_ms", 50.0)
+    kw.setdefault("low_ms", 5.0)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 2)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("settle_s", 0.0)
+    kw.setdefault("drain_wait_s", 0.5)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    scaler = Autoscaler(ctrl, router, signal_fn=lambda: sig["v"], **kw)
+    return scaler, ctrl, router, sig
+
+
+def test_autoscaler_square_wave_never_flaps():
+    """THE hysteresis pin: a signal bouncing across both watermarks
+    faster than either streak fills takes NO action, ever."""
+    scaler, ctrl, router, sig = _mk_scaler(up_after=2, down_after=2)
+    for i in range(20):
+        sig["v"] = 100.0 if i % 2 == 0 else 0.0
+        assert scaler.tick() is None
+    assert ctrl.log == [] and router.log == []
+    assert scaler.counters["scale_ups"] == 0
+    assert scaler.counters["scale_downs"] == 0
+
+
+def test_autoscaler_scales_up_after_streak_then_cooldown_blocks():
+    scaler, ctrl, router, sig = _mk_scaler(cooldown_s=60.0)
+    sig["v"] = 100.0
+    assert scaler.tick() is None            # streak 1 of 2
+    assert scaler.tick() == "up"
+    assert ctrl.log == [("add", 2)]
+    # pressure persists: the cooldown absorbs it instead of stacking a
+    # second scale-up onto capacity that has not warmed yet
+    assert scaler.tick() is None
+    assert scaler.tick() is None
+    assert scaler.counters["blocked_cooldown"] >= 1
+    assert len(ctrl.replicas) == 3
+
+
+def test_autoscaler_ceiling_blocks_scale_up():
+    scaler, ctrl, router, sig = _mk_scaler(n=4, max_replicas=4)
+    sig["v"] = 100.0
+    scaler.tick()
+    assert scaler.tick() is None
+    assert scaler.counters["blocked_max"] == 1
+    assert ctrl.log == []
+
+
+def test_autoscaler_fenced_scale_down_order_and_min_floor():
+    """Scale-down is the mxswap dance in ONE tick: fence the victim,
+    drain, stop, unfence the retired id — and the min-replica floor
+    blocks the next one."""
+    scaler, ctrl, router, sig = _mk_scaler(n=2, min_replicas=1)
+    sig["v"] = 0.0
+    assert scaler.tick() is None
+    assert scaler.tick() == "down"
+    # victim = highest id; fence BEFORE stop, unfence after
+    assert router.log == [("fence", 1), ("unfence", 1)]
+    assert ctrl.log == [("stop", 1)]
+    assert [r.state for r in ctrl.replicas] == ["running", "scaled_down"]
+    # the retired id no longer counts as live: the floor blocks
+    router._views.pop(1)
+    assert scaler.tick() is None
+    assert scaler.tick() is None
+    assert scaler.counters["blocked_min"] >= 1
+    assert scaler.counters["scale_downs"] == 1
+
+
+def test_autoscaler_n1_fence_floor_outranks_low_watermark():
+    """Even above min_replicas, the router's own N-1 routable floor
+    refuses the fence and the scale-down backs off cleanly."""
+    scaler, ctrl, router, sig = _mk_scaler(n=2, min_replicas=1)
+    router._fenced.add(0)               # sibling already fenced (swap)
+    router.log = []
+    sig["v"] = 0.0
+    scaler.tick()
+    assert scaler.tick() is None
+    assert scaler.counters["blocked_floor"] == 1
+    assert ctrl.log == []               # nothing stopped
+    assert router.log == []             # fence refused, nothing leaked
+
+
+def test_autoscaler_scale_down_failure_unwinds_fence():
+    scaler, ctrl, router, sig = _mk_scaler(n=2)
+
+    def boom(rid, timeout=30.0):
+        raise RuntimeError("stop failed")
+
+    ctrl.stop_replica = boom
+    sig["v"] = 0.0
+    scaler.tick()
+    assert scaler.tick() is None
+    assert scaler.counters["errors"] == 1
+    # the half-retired replica is unfenced and keeps serving
+    assert router._fenced == set()
+    assert router.log == [("fence", 1), ("unfence", 1)]

@@ -26,7 +26,10 @@ from mxnet_tpu import predict
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.serving import (BucketBatcher, Draining, ModelPool,
                                QueueFull, ServeClient, ServingFrontend,
-                               parse_buckets, pad_to_bucket, pick_bucket)
+                               TenantQuotaExceeded, parse_buckets,
+                               parse_seq_buckets, parse_tenant_weights,
+                               pad_to_bucket, pick_bucket,
+                               pick_seq_bucket)
 
 pytestmark = pytest.mark.serve
 
@@ -277,7 +280,7 @@ def test_batcher_queue_bound_and_draining():
     try:
         futures = [batcher.submit({"data": np.zeros((1,), "f")})]
         deadline = time.monotonic() + 10
-        while batcher._queue and time.monotonic() < deadline:
+        while batcher._qtotal_locked() and time.monotonic() < deadline:
             time.sleep(0.005)   # let the dispatcher take req 1 in flight
         futures += [batcher.submit({"data": np.zeros((1,), "f")})
                     for _ in range(2)]  # 1 in flight + 2 queued
@@ -1578,3 +1581,225 @@ def test_swap_params_refuses_program_change():
     after = entry.forward(dict(x))
     for a, b in zip(before, after):
         assert np.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair tenant queueing (serving/batcher.py WFQ)
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_weights_spec_and_validation():
+    assert parse_tenant_weights("gold:4,free:1") == {"gold": 4.0,
+                                                     "free": 1.0}
+    assert parse_tenant_weights({"a": 2}) == {"a": 2.0}
+    assert parse_tenant_weights("") == {}
+    with pytest.raises(MXNetError):
+        parse_tenant_weights("gold:0")          # ban via quota, not weight
+    with pytest.raises(MXNetError):
+        parse_tenant_weights("gold")
+
+
+def test_wfq_flood_tenant_cannot_starve_an_equal():
+    """THE fairness bound: while one tenant floods, an equal-weight
+    tenant's requests are served at least every other dispatch slot —
+    its whole backlog clears within 2*k slots, never behind the flood."""
+    order = []
+    b, gate, first = _tagged_batcher(order)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)           # queue builds behind this one
+        for i in range(12):             # the flood: tags 100..111
+            futs.append(b.submit({"data": np.full((2,), 100.0 + i, "f")},
+                                 tenant="flood"))
+        for i in range(3):              # the victim: tags 1, 2, 3
+            futs.append(b.submit({"data": np.full((2,), 1.0 + i, "f")},
+                                 tenant="quiet"))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        served = order[1:]              # drop the gate-holder
+        quiet_pos = [i for i, tag in enumerate(served) if tag < 100.0]
+        # every quiet request inside the first 2*k slots (k=3), and
+        # FIFO within the tenant
+        assert quiet_pos, served
+        assert max(quiet_pos) <= 6, (quiet_pos, served)
+        assert [served[i] for i in quiet_pos] == [1.0, 2.0, 3.0]
+        # the flood still gets everything it queued, in its own order
+        assert [t for t in served if t >= 100.0] == \
+            [100.0 + i for i in range(12)]
+    finally:
+        b.close()
+
+
+def test_wfq_weights_bias_service_proportionally():
+    """gold:3 vs free:1 — over the first 8 slots gold takes ~3/4."""
+    order = []
+    b, gate, first = _tagged_batcher(
+        order, tenant_weights="gold:3,free:1")
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)
+        for i in range(8):
+            futs.append(b.submit({"data": np.full((2,), 100.0 + i, "f")},
+                                 tenant="gold"))
+            futs.append(b.submit({"data": np.full((2,), 200.0 + i, "f")},
+                                 tenant="free"))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        first8 = order[1:9]
+        gold = sum(1 for t in first8 if 100.0 <= t < 200.0)
+        assert gold >= 5, (gold, order)
+    finally:
+        b.close()
+
+
+def test_tenant_quota_sheds_only_the_flooder():
+    order = []
+    b, gate, first = _tagged_batcher(order, tenant_quota=3)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)
+        for i in range(3):              # exactly at quota: accepted
+            futs.append(b.submit({"data": np.full((2,), 100.0 + i, "f")},
+                                 tenant="flood"))
+        with pytest.raises(TenantQuotaExceeded):
+            b.submit({"data": np.full((2,), 199.0, "f")}, tenant="flood")
+        # the OTHER tenant is untouched by the flooder's quota
+        futs.append(b.submit({"data": np.full((2,), 1.0, "f")},
+                             tenant="quiet"))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        assert 199.0 not in order
+        assert 1.0 in order
+    finally:
+        b.close()
+
+
+def test_wfq_priority_still_wins_within_a_tenant():
+    order = []
+    b, gate, first = _tagged_batcher(order)
+    try:
+        futs = [b.submit({"data": np.full((2,), 0.0, "f")})]
+        assert first.wait(10)
+        futs.append(b.submit({"data": np.full((2,), 1.0, "f")},
+                             tenant="t", priority=0))
+        futs.append(b.submit({"data": np.full((2,), 2.0, "f")},
+                             tenant="t", priority=5))
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        assert order[1:] == [2.0, 1.0]
+    finally:
+        b.close()
+
+
+def test_frontend_tenant_header_reaches_batcher_and_stats():
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, buckets="1,2", max_wait_ms=1,
+                         tenant_quota=64)
+    x = np.random.RandomState(0).rand(32).astype("f")
+    st, out = fe.handle_predict("m", {"data": x}, tenant="gold")
+    assert st == 200, out
+    payload = fe.stats_payload()
+    # nothing queued anymore -> no tenants table; the latency ledger
+    # still attributes the served request to its tenant
+    assert payload.get("tenants", {}) == {}
+    assert "gold" in payload["tenant_latency_ms"]
+
+
+# ---------------------------------------------------------------------------
+# bucketed sequence serving (serving/sequence.py + /predict_seq)
+# ---------------------------------------------------------------------------
+
+def test_parse_seq_buckets_and_pick():
+    assert parse_seq_buckets("8,16,32") == (8, 16, 32)
+    assert pick_seq_bucket(5, (8, 16)) == 8
+    assert pick_seq_bucket(8, (8, 16)) == 8
+    assert pick_seq_bucket(9, (8, 16)) == 16
+    with pytest.raises(MXNetError):
+        pick_seq_bucket(17, (8, 16))            # never truncates
+    with pytest.raises(MXNetError):
+        pick_seq_bucket(0, (8, 16))
+    with pytest.raises(MXNetError):
+        parse_seq_buckets("8,-1")
+
+
+def _lstm_pool(vocab=50, hidden=8, layers=2):
+    """A tiny LSTM LM registered WITHOUT its init states in the params
+    — the Predictor zero-fills them at the back-inferred (layers, B, H)
+    shape per batch bucket, which is the training-side zero state."""
+    from mxnet_tpu.models import lstm_lm
+    sym, _, _ = lstm_lm.lstm_lm_sym(8, vocab, num_embed=8,
+                                    num_hidden=hidden, num_layers=layers)
+    ex = sym.simple_bind(mx.cpu(), data=(2, 8), softmax_label=(2, 8))
+    skip = ("data", "softmax_label", "lstm_init_h", "lstm_init_c")
+    for name in sorted(ex.arg_dict):
+        if name in skip:
+            continue
+        r = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+        ex.arg_dict[name][:] = \
+            (r.rand(*ex.arg_dict[name].shape).astype("f") - 0.5) * 0.4
+    args = {k: v.asnumpy() for k, v in ex.arg_dict.items()
+            if k not in skip}
+    pool = ModelPool()
+    pool.add("lm", sym, args)
+    return pool, vocab
+
+
+def test_predict_seq_bit_stable_across_bucket_boundaries():
+    """THE sequence-serving contract: the scan is causal, so the same
+    prefix answers BIT-IDENTICALLY whether the request padded into the
+    small bucket or rode a longer sequence into the next one — bucket
+    boundaries are invisible in the answers."""
+    pool, vocab = _lstm_pool()
+    fe = ServingFrontend(pool, buckets="1,2,4", max_wait_ms=1,
+                         seq_buckets="4,8,16")
+    toks = [3, 7, 11, 19, 2]
+    st, out = fe.handle_predict_seq("lm", toks)
+    assert st == 200, out
+    assert out["bucket"] == 8 and out["len"] == 5
+    o = np.asarray(out["outputs"][0])
+    assert o.shape == (5, vocab)
+    # per-step softmax rows: the time-major relay really un-interleaved
+    assert np.allclose(o.sum(axis=1), 1.0, atol=1e-5)
+
+    st2, out2 = fe.handle_predict_seq("lm", toks + [23, 29, 31, 5, 13])
+    assert st2 == 200 and out2["bucket"] == 16
+    o2 = np.asarray(out2["outputs"][0])
+    assert o2.shape == (10, vocab)
+    assert np.array_equal(o, o2[:5])            # bit-stable prefix
+
+    # same bucket, repeated: bitwise deterministic
+    st3, out3 = fe.handle_predict_seq("lm", toks)
+    assert np.array_equal(np.asarray(out3["outputs"][0]), o)
+
+    # longer than every bucket: honest 400, never a silent truncation
+    st4, out4 = fe.handle_predict_seq("lm", list(range(99)))
+    assert st4 == 400 and "exceeds" in out4["error"]
+
+
+def test_predict_seq_http_roundtrip_and_per_bucket_batchers():
+    pool, vocab = _lstm_pool()
+    fe = ServingFrontend(pool, buckets="1,2,4", max_wait_ms=1,
+                         seq_buckets="4,8")
+    fe.serve_in_background()
+    try:
+        cli = ServeClient("127.0.0.1", fe.port, timeout=30)
+        st, out = cli.predict_seq("lm", [1, 2, 3], tenant="gold")
+        assert st == 200, out
+        assert out["bucket"] == 4 and out["len"] == 3
+        assert np.asarray(out["outputs"][0]).shape == (3, vocab)
+        st2, out2 = cli.predict_seq("lm", list(range(1, 8)))
+        assert st2 == 200 and out2["bucket"] == 8
+        # each (model, length) pair batches on its own queue
+        payload = fe.stats_payload()
+        assert "lm@seq4" in payload["est_wait_ms"]
+        assert "lm@seq8" in payload["est_wait_ms"]
+        st3, out3 = cli.predict_seq("lm", list(range(99)))
+        assert st3 == 400
+        st4, _ = cli.predict_seq("nope", [1, 2])
+        assert st4 == 404
+        cli.close()
+    finally:
+        fe.drain_and_stop(timeout=10)

@@ -13,7 +13,18 @@ front end (docs/how_to/fleet.md).
         --input-shape mlp:data=784 --replicas 2 --port 8200 \\
         --warm-store /run/fleet-warm [--manifest fleet.json] \\
         [--device-sets cpu|tpu:0,1;2,3] [--buckets 1,2,4,8] \\
-        [--run-dir DIR] [--port-file F] [--max-restarts N]
+        [--run-dir DIR] [--port-file F] [--max-restarts N] \\
+        [--workers N] [--autoscale]
+
+``--workers N`` (default ``MXTPU_FLEET_WORKERS``) SHARDS the front
+end: N router worker processes accept on the SAME public port via
+SO_REUSEPORT, each routing off the shared fleet-view snapshot ONE
+controller-side prober publishes (fleet/view.py) — the single-router
+dispatch ceiling multiplies by N.  ``--autoscale`` closes the loop on
+the aggregated est_wait_ms signal (fleet/autoscale.py): scale-up via
+warm AOT bring-up, scale-down via fence -> drain -> stop.  The
+``router-worker`` subcommand is the worker binary (spawned by
+``serve``, not run by hand).
 
 Model/shape specs are the ``tools/serve.py`` formats; ``--manifest``
 loads the same fields from JSON (flags override).  ``serve`` builds a
@@ -127,11 +138,23 @@ def _cmd_serve(fleet, args):
     else:
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="mxfleet_run_")
+    workers_n = args.workers
+    if workers_n is None:
+        workers_n = man.router_workers
+    if workers_n is None:
+        from mxnet_tpu.base import get_env as _get_env
+        workers_n = int(_get_env(fleet.ENV_FLEET_WORKERS))
+    man.router_workers = int(workers_n)
     controller = fleet.ReplicaController(
         man, run_dir, warm_store=args.warm_store,
         max_restarts=args.max_restarts, log=_log)
+    # sharded mode: this router never serves HTTP — it is the
+    # controller-side PROBER (health loop, fence state, capacity
+    # floor) behind the view publisher; port 0 keeps the public port
+    # free for the reuseport worker shard
     router = fleet.FleetRouter(controller, man, host=args.host,
-                               port=args.port, slo_ms=args.slo_ms)
+                               port=args.port if workers_n <= 1 else 0,
+                               slo_ms=args.slo_ms)
     # a SIGTERM during the (possibly long) replica bring-up must drain
     # the already-spawned replicas to rc 0 and exit 0 — the full router
     # drain path only takes over once bring-up completed (its server
@@ -158,10 +181,13 @@ def _cmd_serve(fleet, args):
         _log("fleet: bring-up failed: %s" % e)
         controller.kill()
         return 1
-    router.install_signal_handlers()
     if early_drain.is_set():
         _log("fleet: drained during bring-up — exiting 0")
         return 0
+    if workers_n > 1:
+        return _serve_sharded(fleet, args, man, run_dir, controller,
+                              router, int(workers_n))
+    router.install_signal_handlers()
     router.start()          # binds + one synchronous probe pass
     if args.watch:
         # rolling hot swap: tail every checkpoint-DIRECTORY model and
@@ -178,6 +204,10 @@ def _cmd_serve(fleet, args):
         else:
             _log("fleet: --watch: no checkpoint-directory models in "
                  "the manifest — nothing to watch")
+    if args.autoscale:
+        fleet.Autoscaler(controller, router, log=_log).start()
+        _log("fleet: autoscaler on (replica bounds via "
+             "MXTPU_FLEET_MIN/MAX_REPLICAS)")
     _log("fleet: %d replica(s) ready; router on %s:%d (models: %s)"
          % (man.replicas, router.host, router.port, man.names()))
     if args.port_file:
@@ -197,6 +227,95 @@ def _cmd_serve(fleet, args):
     _log("fleet: drained — replica exit codes %s"
          % {k: rcs[k] for k in sorted(rcs)})
     return 0 if all(rc == 0 for rc in rcs.values()) else 1
+
+
+def _serve_sharded(fleet, args, man, run_dir, controller, prober,
+                   workers_n):
+    """The sharded front end: publish the fleet view off ``prober``
+    (which never serves HTTP), reserve the public port, spawn
+    ``workers_n`` reuseport router workers, optionally close the
+    autoscale loop, then park until SIGTERM and drain everything in
+    dependency order (workers first — they stop ANSWERING; replicas
+    last — they stop COMPUTING)."""
+    import signal as _signal
+    import threading as _threading
+    from mxnet_tpu.fleet.view import VIEW_BASENAME
+    view_path = os.path.join(run_dir, VIEW_BASENAME)
+    manifest_path = os.path.join(run_dir, "manifest.json")
+    man.save(manifest_path)
+    if args.watch:
+        watched = {name: spec["target"]
+                   for name, spec in man.models.items()
+                   if os.path.isdir(spec["target"])}
+        if watched:
+            fleet.RollingSwap(prober, watched, log=_log).start()
+            _log("fleet: watching %s for new epochs"
+                 % sorted(watched.values()))
+    publisher = fleet.FleetViewPublisher(prober, view_path,
+                                         log=_log).start()
+    autoscaler = None
+    if args.autoscale:
+        autoscaler = fleet.Autoscaler(controller, prober,
+                                      publisher=publisher,
+                                      log=_log).start()
+        _log("fleet: autoscaler on (replica bounds via "
+             "MXTPU_FLEET_MIN/MAX_REPLICAS)")
+    sock, port = fleet.reserve_port(args.host, args.port)
+    wset = fleet.RouterWorkerSet(
+        manifest_path, view_path, args.host, port, workers_n, run_dir,
+        slo_ms=args.slo_ms, log=_log)
+    stop = _threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+    for _sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(_sig, _on_signal)
+    failed = False
+    try:
+        wset.start()
+        wset.wait_ready(timeout=60.0)
+        _log("fleet: %d replica(s) ready; %d router worker(s) on "
+             "%s:%d (models: %s)" % (man.replicas, workers_n,
+                                     args.host, port, man.names()))
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("%s:%d" % (args.host, port))
+            os.replace(tmp, args.port_file)
+        stop.wait()
+    except Exception as e:  # noqa: BLE001 — bring-up failed: clean up
+        _log("fleet: sharded bring-up failed: %s" % e)
+        failed = True
+    if autoscaler is not None:
+        autoscaler.stop()
+    wrcs = wset.drain()
+    publisher.stop()
+    rrcs = controller.drain()
+    sock.close()
+    _log("fleet: drained — worker exit codes %s, replica exit codes %s"
+         % ({k: wrcs[k] for k in sorted(wrcs)},
+            {k: rrcs[k] for k in sorted(rrcs)}))
+    ok = all(rc == 0 for rc in wrcs.values()) and \
+        all(rc == 0 for rc in rrcs.values())
+    return 0 if (ok and not failed) else 1
+
+
+def _cmd_router_worker(fleet, args):
+    """One reuseport router worker (spawned by ``serve --workers N``):
+    route off the shared view snapshot, never probe, dump counters for
+    the sibling /stats merge, drain on SIGTERM."""
+    man = fleet.FleetManifest.from_file(args.manifest_file)
+    reader = fleet.FleetViewReader(args.view)
+    router = fleet.FleetRouter(
+        reader, man, host=args.host, port=args.port,
+        spill_queue=args.spill_queue, slo_ms=args.slo_ms,
+        request_timeout=args.request_timeout, reuse_port=True,
+        worker_id=args.worker_id, run_dir=args.run_dir)
+    router.install_signal_handlers()
+    _log("fleet: router worker %d on %s:%d (pid %d)"
+         % (args.worker_id, args.host, args.port, os.getpid()))
+    router.serve_forever()
+    return 0
 
 
 def main(argv=None):
@@ -236,15 +355,51 @@ def main(argv=None):
                               "the replicas one at a time "
                               "(MXTPU_SWAP_* knobs; docs/how_to/"
                               "fleet.md 'Rolling deployment')")
+    p_serve.add_argument("--workers", type=int, default=None,
+                         help="router worker processes sharing the "
+                              "public port via SO_REUSEPORT (default "
+                              "manifest router_workers, then "
+                              "MXTPU_FLEET_WORKERS; 1 = in-line "
+                              "single-process router)")
+    p_serve.add_argument("--autoscale", action="store_true",
+                         help="close the autoscale loop on the "
+                              "aggregated est_wait_ms signal "
+                              "(MXTPU_FLEET_SCALE_* / MIN/MAX_REPLICAS "
+                              "knobs; scale-down is fence -> drain -> "
+                              "stop)")
+
+    p_rw = sub.add_parser("router-worker",
+                          help="one reuseport router worker (spawned "
+                               "by `serve --workers N`, not run by "
+                               "hand)")
+    p_rw.add_argument("--manifest-file", required=True,
+                      help="the manifest JSON `serve` saved under the "
+                           "run dir")
+    p_rw.add_argument("--view", required=True,
+                      help="the shared fleet-view snapshot path")
+    p_rw.add_argument("--host", default="127.0.0.1")
+    p_rw.add_argument("--port", type=int, required=True,
+                      help="the reserved public port (every worker "
+                           "binds it with SO_REUSEPORT)")
+    p_rw.add_argument("--worker-id", type=int, required=True)
+    p_rw.add_argument("--run-dir", required=True,
+                      help="where this worker dumps its counters for "
+                           "the sibling /stats merge")
+    p_rw.add_argument("--slo-ms", type=float, default=0.0)
+    p_rw.add_argument("--request-timeout", type=float, default=60.0)
+    p_rw.add_argument("--spill-queue", type=int, default=None)
 
     args = parser.parse_args(argv)
     if not args.cmd:
-        parser.error("need a subcommand: serve or warmup")
+        parser.error("need a subcommand: serve, warmup or "
+                     "router-worker")
     fleet = _bootstrap()
     from mxnet_tpu.base import MXNetError
     try:
         if args.cmd == "warmup":
             return _cmd_warmup(fleet, args)
+        if args.cmd == "router-worker":
+            return _cmd_router_worker(fleet, args)
         return _cmd_serve(fleet, args)
     except MXNetError as e:
         _log("fleet: error: %s" % e)

@@ -85,6 +85,18 @@ def main(argv=None):
                         help="override MXTPU_SERVE_MAX_WAIT_MS")
     parser.add_argument("--max-queue", type=int, default=None,
                         help="override MXTPU_SERVE_MAX_QUEUE")
+    parser.add_argument("--seq-buckets", default=None,
+                        help="sequence-LENGTH buckets for "
+                             "/predict_seq, e.g. '8,16,32' (default: "
+                             "MXTPU_SERVE_SEQ_BUCKETS)")
+    parser.add_argument("--tenant-weights", default=None,
+                        help="weighted-fair tenant shares, e.g. "
+                             "'gold:4,free:1' (default: "
+                             "MXTPU_SERVE_TENANT_WEIGHTS)")
+    parser.add_argument("--tenant-quota", type=int, default=None,
+                        help="per-tenant queued-request quota; beyond "
+                             "it a tenant is shed 429 (default: "
+                             "MXTPU_SERVE_TENANT_QUOTA; 0 disables)")
     parser.add_argument("--slo-ms", type=float, default=None,
                         help="override MXTPU_SERVE_SLO_MS")
     parser.add_argument("--dtype", default=None,
@@ -128,7 +140,10 @@ def main(argv=None):
     frontend = ServingFrontend(
         pool, host=args.host, port=args.port, buckets=args.buckets,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
-        slo_ms=args.slo_ms, watchdog=watchdog)
+        slo_ms=args.slo_ms, watchdog=watchdog,
+        tenant_weights=args.tenant_weights,
+        tenant_quota=args.tenant_quota,
+        seq_buckets=args.seq_buckets)
 
     # handlers + bind BEFORE the (possibly minutes-long) warmup: a
     # SIGTERM during warmup must drain to exit 0, not die rc 143 on the
