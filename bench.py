@@ -1074,6 +1074,105 @@ def _checkpoint_bench(saves=5, steps_between=3, batch=64, hidden=1024):
     return out
 
 
+def _ckpt_sharded_bench(saves=3, steps_between=2, batch=32, hidden=1024):
+    """``bench.py ckpt`` — sharded-native vs gathered checkpoints on a
+    real zero3 trainer (docs/how_to/fault_tolerance.md "Sharded-native
+    checkpoints").  The gathered path pulls every shard into one full
+    host copy before the write; the sharded path
+    (``save_checkpoint_sharded`` / ``MXTPU_CKPT_SHARDED=1``) writes one
+    verified blob per dp shard with peak host residency of a single
+    blob.  Gate keys: ``ckpt_save_ms`` (sharded save wall time, lower
+    is better) and ``ckpt_peak_host_frac`` (peak single-blob bytes /
+    total blob bytes — the whole point of the feature; it rises back
+    toward 1.0 if a host-side gather sneaks into the save path).
+    ``ckpt_sharded_parity`` asserts the sharded directory restores
+    bit-identically to the gathered one — a smaller host copy that
+    loses bits is not a feature.  8-virtual-device CPU mesh."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, local_mesh
+    from mxnet_tpu.resilience import CheckpointManager
+
+    world = len(jax.devices())
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(0)
+    X = rs.randn(batch, 512).astype("f")
+    y = rs.randint(0, 8, batch).astype("f")
+
+    t = SPMDTrainer(net, "sgd",
+                    {"learning_rate": 0.05, "momentum": 0.9,
+                     "rescale_grad": 1.0 / batch},
+                    mesh=local_mesh("dp"), grad_sync="zero3")
+    t.bind([("data", (batch, 512))], [("softmax_label", (batch,))])
+    mx.random.seed(7)
+    t.init_params(mx.initializer.Xavier())
+
+    dir_g = tempfile.mkdtemp(prefix="bench_ckpt_gathered_")
+    dir_s = tempfile.mkdtemp(prefix="bench_ckpt_sharded_")
+    out = {"ckpt_world": world}
+    try:
+        man_g = CheckpointManager(dir_g, keep_last=None)
+        man_s = CheckpointManager(dir_s, keep_last=None)
+        gathered, sharded = [], []
+        for i in range(1, saves + 1):
+            for _ in range(steps_between):
+                t.step(X, y)
+            t.flush_step_guard()
+            # identical trainer state goes to BOTH directories each
+            # epoch, so the parity check below compares like with like
+            tic = time.perf_counter()
+            t.save_checkpoint(man_g, i, blocking=True)
+            gathered.append(time.perf_counter() - tic)
+            tic = time.perf_counter()
+            t.save_checkpoint_sharded(man_s, i)
+            sharded.append(time.perf_counter() - tic)
+        gathered.sort()
+        sharded.sort()
+        out["ckpt_gathered_save_ms"] = round(
+            gathered[len(gathered) // 2] * 1e3, 2)
+        out["ckpt_save_ms"] = round(sharded[len(sharded) // 2] * 1e3, 2)
+        stats = man_s.last_save_stats or {}
+        if stats.get("total_blob_bytes"):
+            out["ckpt_peak_host_bytes"] = stats["peak_blob_bytes"]
+            out["ckpt_total_blob_bytes"] = stats["total_blob_bytes"]
+            out["ckpt_peak_host_frac"] = round(
+                stats["peak_blob_bytes"] / stats["total_blob_bytes"], 4)
+        # verified assembly from per-shard blobs, timed where a resuming
+        # trainer pays it
+        tic = time.perf_counter()
+        _, ps, _, ss, _ = man_s.restore()
+        out["ckpt_restore_ms"] = round((time.perf_counter() - tic) * 1e3,
+                                       2)
+        _, pg, _, sg, _ = man_g.restore()
+        # content equality, not pickle-byte equality: the two save paths
+        # serialize the same state in different dict orders
+        import pickle
+        oa, ob = pickle.loads(ss), pickle.loads(sg)
+        opt_ok = (oa["num_update"] == ob["num_update"] and
+                  set(oa["states"]) == set(ob["states"]) and all(
+                      len(oa["states"][k]) == len(ob["states"][k]) and
+                      all(np.array_equal(x, z) for x, z in
+                          zip(oa["states"][k], ob["states"][k]))
+                      for k in oa["states"]))
+        out["ckpt_sharded_parity"] = bool(
+            opt_ok and set(ps) == set(pg) and all(
+                np.array_equal(ps[k].asnumpy(), pg[k].asnumpy())
+                for k in ps))
+        t.close()
+    finally:
+        shutil.rmtree(dir_g, ignore_errors=True)
+        shutil.rmtree(dir_s, ignore_errors=True)
+    return out
+
+
 def _roofline_bench(preset=None, trials=None):
     """``bench.py roofline`` — per-op proof for the fused kernels
     (mxnet_tpu/kernels/, docs/how_to/kernels.md).
@@ -2901,13 +3000,13 @@ def _run_mode(mode):
     if mode in ("data_net", "data-net"):
         mode = "data-net"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
-                "resume", "checkpoint", "analyze", "serve", "fleet",
-                "overdrive", "hotswap", "data-service", "data-net",
-                "roofline", "zero3", "plan"):
+                "resume", "checkpoint", "ckpt", "analyze", "serve",
+                "fleet", "overdrive", "hotswap", "data-service",
+                "data-net", "roofline", "zero3", "plan"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
         # every nd.array would cross the tunneled device link
-        if mode in ("analyze", "zero3", "plan"):
+        if mode in ("analyze", "zero3", "plan", "ckpt"):
             # these lint/shard the dp=8 fused step on a virtual mesh
             flags = os.environ.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
@@ -2950,6 +3049,8 @@ def _run_mode(mode):
         out.update(_resume_bench())
     elif mode == "checkpoint":
         out.update(_checkpoint_bench())
+    elif mode == "ckpt":
+        out.update(_ckpt_sharded_bench())
     elif mode == "fed":
         out["fed"] = round(_fed_bench(batch, steps, warmup, trials), 2)
         out["fed_roofline"] = _roofline(out["fed"],
@@ -3003,7 +3104,7 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "data-net", "data_net",
     "fed-cpu", "pipeline", "compile-probe", "resume", "checkpoint",
-    "analyze", "serve", "fleet", "overdrive", "hotswap", "region",
+    "ckpt", "analyze", "serve", "fleet", "overdrive", "hotswap", "region",
     "roofline", "zero3",
     "plan", "fed", "compute",
     "compute-large", "inception-bn", "resnet-152", "lstm",
@@ -3079,7 +3180,8 @@ def _collect(mode, timeout=480, extra_env=None):
 GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "inception_bn_img_s", "resnet152_img_s", "lstm_tok_s",
              "pipeline_decode_img_s", "fed_cpu", "pipeline_speedup",
-             "ckpt_stall_ratio", "serve_*_qps", "serve_batch_speedup",
+             "ckpt_stall_ratio", "ckpt_save_ms", "ckpt_peak_host_frac",
+             "serve_*_qps", "serve_batch_speedup",
              "data_service_img_s", "data_service_scaling_x",
              "data_net_img_s", "data_net_scaling_x",
              "pipeline_decode_scaling_x", "roofline_*_speedup",
@@ -3100,7 +3202,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
 #: regression
 LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms", "plan_decide_ms",
                                   "plan_step_ms", "region_freshness_ms",
-                                  "overdrive_tenant_p99_ms"))
+                                  "overdrive_tenant_p99_ms",
+                                  "ckpt_save_ms", "ckpt_peak_host_frac"))
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -3341,6 +3444,8 @@ def main():
         parts.update(_collect("resume",
                               extra_env={"MXTPU_COMPILE_CACHE": None}))
         parts.update(_collect("checkpoint"))
+        # sharded-native vs gathered checkpoints on the dp=8 zero3 mesh
+        parts.update(_collect("ckpt"))
         parts.update(_collect("serve"))
         parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
@@ -3428,6 +3533,10 @@ def main():
               "ckpt_stall_ratio", "ckpt_parity",
               "ckpt_restore_verified_s", "ckpt_verify_s",
               "ckpt_fsck_s", "ckpt_fsck_rc",
+              "ckpt_world", "ckpt_save_ms", "ckpt_gathered_save_ms",
+              "ckpt_restore_ms", "ckpt_peak_host_frac",
+              "ckpt_peak_host_bytes", "ckpt_total_blob_bytes",
+              "ckpt_sharded_parity",
               "mxlint_wall_s", "mxlint_rc", "mxlint_budget_ok",
               "analyze_mlp_collectives", "analyze_zero_collectives",
               "analyze_findings"):

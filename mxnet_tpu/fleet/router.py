@@ -394,13 +394,22 @@ class FleetRouter(object):
         with self._lock:
             for rid in self._order:
                 view = self._views[rid]
+                sup = ctrl.get(rid, {})
                 out[str(rid)] = {
                     "id": rid,
                     "addr": list(view.addr) if view.addr else None,
                     "healthy": rid in healthy,
                     "stats": view.stats,
                     "forward_errors": view.errors,
-                    "state": ctrl.get(rid, {}).get("state")}
+                    "state": sup.get("state"),
+                    # supervision fields travel with the view: in the
+                    # sharded front end the controller lives in the
+                    # parent, but any worker must still answer the full
+                    # /stats table (pid drives kill-replica drills,
+                    # restarts drives respawn crediting)
+                    "pid": sup.get("pid"),
+                    "restarts": sup.get("restarts"),
+                    "last_rc": sup.get("last_rc")}
         return out
 
     # -- routing policy ----------------------------------------------------
@@ -578,6 +587,17 @@ class FleetRouter(object):
         replicas = {}
         ctrl = {r["id"]: r for r in self._controller.snapshot()} \
             if self._controller is not None else {}
+        if not ctrl and self._view is not None:
+            # sharded front end: no controller in this process — the
+            # supervision fields (state/pid/restarts/last_rc) arrive
+            # through the published view instead, so a router worker's
+            # /stats table matches the controller-side one
+            for rid, ent in self._view.replicas().items():
+                sup = {k: ent[k]
+                       for k in ("state", "pid", "restarts", "last_rc")
+                       if ent.get(k) is not None}
+                if sup:
+                    ctrl[rid] = sup
         now = time.monotonic()
         with self._lock:
             for rid in self._order:

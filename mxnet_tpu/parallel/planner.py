@@ -297,6 +297,22 @@ class ShardingPlan(object):
                           "mesh=%s)" % (trainer.grad_sync,
                                         mesh_axes or "none")],
         }
+        if trainer._zero and trainer.param_shardings:
+            # explicit rules survive zero/zero3: the step does NOT widen
+            # these params to replicated (the silent-widening fix) —
+            # record each kept spec so plan_explain shows the decision
+            from .trainer import _spec_for
+            for name in trainer.param_names:
+                spec = _spec_for(name, trainer.arg_shapes[name],
+                                 trainer.param_shardings)
+                if tuple(spec):
+                    doc["decisions"].append(
+                        "%s: explicit shard spec %s kept under "
+                        "grad_sync=%r (not widened to replicated)"
+                        % (name,
+                           [None if e is None else str(e)
+                            for e in tuple(spec)],
+                           trainer.grad_sync))
         p = cls(doc)
         doc["bytes"] = p._byte_model()
         return p

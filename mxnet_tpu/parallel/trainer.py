@@ -557,6 +557,18 @@ class SPMDTrainer(object):
 
         zero = self._zero
         rep = self._sharding(P()) if zero else None
+        # explicitly rule-sharded params (tp etc.) KEEP their spec in
+        # the "gathered" view: constraining them to replicated would
+        # silently negate the rule's HBM win — only the dp-sharded
+        # (zero-derived) params widen to replicated for the step, and
+        # the decision is recorded in plan.decisions
+        gathered_spec = {}
+        if zero:
+            for name in self.param_names:
+                spec = _spec_for(name, self.arg_shapes[name],
+                                 self.param_shardings)
+                gathered_spec[name] = self._sharding(spec) \
+                    if tuple(spec) else rep
 
         def cast(p):
             if compute_dtype is None:
@@ -581,7 +593,8 @@ class SPMDTrainer(object):
                 for k, v in cast(params).items():
                     v = jax.lax.with_sharding_constraint(
                         v, self._sharding(self._param_spec(k, v.shape)))
-                    full[k] = jax.lax.with_sharding_constraint(v, rep)
+                    full[k] = jax.lax.with_sharding_constraint(
+                        v, gathered_spec[k])
             else:
                 full = params
 
@@ -617,7 +630,8 @@ class SPMDTrainer(object):
                 for k, v in cast(params).items():
                     v = jax.lax.with_sharding_constraint(
                         v, self._sharding(self._param_spec(k, v.shape)))
-                    full[k] = jax.lax.with_sharding_constraint(v, rep)
+                    full[k] = jax.lax.with_sharding_constraint(
+                        v, gathered_spec[k])
                 params = full
             elif compute_dtype is not None:
                 params = cast(params)
@@ -1375,7 +1389,39 @@ class SPMDTrainer(object):
         parameter collective gathers feed host snapshots directly (one
         bounded copy, no full-model device re-upload), and ``restore``
         re-shards through ``set_params``'s normal placement — sharded
-        and replicated runs restore each other's checkpoints freely."""
+        and replicated runs restore each other's checkpoints freely.
+
+        Under ``MXTPU_CKPT_SHARDED=1`` a zero/zero3 trainer instead
+        writes SHARDED-NATIVE checkpoints (one verified blob per dp
+        shard, no host gather at all — see
+        :meth:`save_checkpoint_sharded`); such saves are blocking by
+        design."""
+        from ..base import get_env
+        from ..resilience import ENV_CKPT_SHARDED, checkpoint_async
+        if str(get_env(ENV_CKPT_SHARDED, "0")).strip().lower() in \
+                ("1", "true", "yes", "on") and self._zero and \
+                hasattr(manager, "save_sharded"):
+            if self._multiproc:
+                if not getattr(self, "_sharded_multiproc_warned", False):
+                    self._sharded_multiproc_warned = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "MXTPU_CKPT_SHARDED=1: multi-process sharded-"
+                        "native saves need a publish barrier between "
+                        "peer blob writes and rank 0's manifest — "
+                        "falling back to gather-on-save")
+            else:
+                if (blocking is False or
+                        (blocking is None and checkpoint_async())) and \
+                        not getattr(self, "_sharded_async_warned", False):
+                    self._sharded_async_warned = True
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "MXTPU_CKPT_SHARDED=1: sharded-native saves are "
+                        "blocking by design (the per-shard payloads "
+                        "read live device buffers the async writer "
+                        "must never race a donating step for)")
+                return self.save_checkpoint_sharded(manager, step)
         arg_params, aux_params = self.snapshot_params()
         states = self.get_states()
         plan_doc = self.sharding_plan.to_doc() \
@@ -1383,6 +1429,84 @@ class SPMDTrainer(object):
         return manager.save(step, self.symbol, arg_params, aux_params,
                             optimizer_states=states, blocking=blocking,
                             plan=plan_doc)
+
+    def _sharded_ckpt_dims(self):
+        """param -> dp-shard dim for the sharded-native checkpoint
+        layout: the single dim ``_param_spec`` shards over the dp axis,
+        or None for params that stay replicated / carry explicit
+        non-dp rules (those travel whole, in shard 0's blob)."""
+        dims = {}
+        for name in self.param_names:
+            spec = tuple(self._param_spec(
+                name, self.arg_shapes[name]))
+            ds = [i for i, e in enumerate(spec) if e == self.data_axis]
+            dims[name] = ds[0] if len(ds) == 1 and all(
+                e in (None, self.data_axis) for e in spec) else None
+        return dims
+
+    def _shard_slice(self, v, dim, k, world):
+        """Host copy of shard ``k``'s slice of device array ``v`` along
+        ``dim`` — read straight from the addressable shard that already
+        holds it (zero device compute, O(P/world) host bytes); falls
+        back to slicing the assembled array only when the on-device
+        layout does not match the declared shard (e.g. a replicated
+        value)."""
+        per = v.shape[dim] // world
+        start = k * per
+        for s in v.addressable_shards:
+            idx = s.index[dim]
+            if (idx.start or 0) == start and \
+                    (idx.stop is None or idx.stop == start + per):
+                return np.array(np.asarray(s.data), copy=True)
+        sl = [slice(None)] * v.ndim
+        sl[dim] = slice(start, start + per)
+        return np.array(np.asarray(self._gather(v))[tuple(sl)],
+                        copy=True)
+
+    def save_checkpoint_sharded(self, manager, step):
+        """Sharded-native checkpoint: every dp shard of the master
+        params + optimizer state is serialized as its OWN verified blob
+        straight from the device shards — NO full-model host gather, so
+        peak host bytes are one shard's O(P/world) instead of O(P).
+        Params without a dp shard dim (indivisible, or explicit non-dp
+        rules) and the aux states ride whole in shard 0's blob.
+
+        ``restore`` reads such checkpoints through the normal path:
+        the manager verifies + assembles full host arrays and
+        ``set_params`` re-shards them onto THIS trainer's mesh — so
+        elastic resume works at any world size, matching the blob
+        count or not."""
+        import pickle
+        self.flush_step_guard()
+        world = self.mesh.shape[self.data_axis]
+        dims = self._sharded_ckpt_dims()
+        plan_doc = self.sharding_plan.to_doc() \
+            if self.sharding_plan is not None else None
+
+        def payload(k):
+            out = {"epoch": int(step), "shard": int(k),
+                   "world": int(world), "dims": dims,
+                   "num_update": self._num_update,
+                   "args": {}, "opt": {}}
+            for name, v in self.params.items():
+                d = dims[name]
+                if d is not None:
+                    out["args"][name] = self._shard_slice(v, d, k, world)
+                    out["opt"][name] = tuple(
+                        self._shard_slice(x, d, k, world)
+                        for x in self.opt_state[name])
+                elif k == 0:
+                    out["args"][name] = np.asarray(self._gather(v))
+                    out["opt"][name] = tuple(
+                        np.asarray(self._gather(x))
+                        for x in self.opt_state[name])
+            if k == 0:
+                out["aux"] = {n: np.asarray(self._gather(v))
+                              for n, v in self.aux.items()}
+            return pickle.dumps(out, protocol=4)
+
+        return manager.save_sharded(step, self.symbol, payload,
+                                    world=world, plan=plan_doc)
 
     def restore(self, manager, epoch=None):
         """Resume params + optimizer state (+ step counter, inside the

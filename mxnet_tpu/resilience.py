@@ -129,6 +129,12 @@ ENV_CKPT_CHECKSUM = register_env(
     doc="Checksum recorded per checkpoint file in the manifest and "
         "verified on restore: sha256 (default, C-speed), crc32 (zlib), "
         "crc32c (pure-python, TFRecord-style), off")
+ENV_CKPT_SHARDED = register_env(
+    "MXTPU_CKPT_SHARDED", default=0,
+    doc="1 = SPMDTrainer.save_checkpoint writes sharded-native "
+        "checkpoints under grad_sync='zero'/'zero3': every dp shard "
+        "lands as its own verified blob (params.s{K}-of-{W}), no "
+        "host-side gather — peak host bytes O(P/world) instead of O(P)")
 
 #: process exit code of a watchdog abort (hung step): the supervisor
 #: relaunches with resume.  Distinct from signal codes (128+N) and from
@@ -331,8 +337,10 @@ def region_faults_env(env, arm=()):
 #: actions a region supervisor knows how to drive (tools/region.py):
 #: ``kill`` = SIGKILL the role's process (its supervisor respawns it),
 #: ``resize`` = SIGKILL + respawn the trainer at a different world size,
-#: ``arm`` = arm a :data:`faults` point inside the running role
-SCHEDULE_ACTIONS = ("kill", "resize", "arm")
+#: ``arm`` = arm a :data:`faults` point inside the running role,
+#: ``rot`` = damage ONE sharded-checkpoint blob post-publish (arg
+#: ``shard#k`` — sugar for arming ``rot_shard:1@k`` inside the role)
+SCHEDULE_ACTIONS = ("kill", "resize", "arm", "rot")
 
 
 class FaultEvent(object):
@@ -353,6 +361,8 @@ class FaultEvent(object):
         base = "%s:%s" % (self.action, self.target)
         if self.action == "arm" and self.arg:
             return base + ":" + self.arg.partition(":")[0]
+        if self.action == "rot" and self.arg:
+            return base + ":" + self.arg
         return base
 
     def __repr__(self):
@@ -371,6 +381,7 @@ def parse_fault_schedule(text):
         <at_s> kill <role>            # SIGKILL; the supervisor respawns
         <at_s> resize <role> <n>      # SIGKILL + respawn at world size n
         <at_s> arm <role> <point:times[@after]>   # arm a fault point
+        <at_s> rot <role> shard#<k>   # rot sharded-ckpt blob k post-publish
 
     ``at_s`` is seconds after the storm window opens.  A ``#`` at the
     start of a line or after whitespace starts a comment (role names
@@ -417,6 +428,12 @@ def parse_fault_schedule(text):
                     raise MXNetError(
                         "fault schedule entry %r: arm needs "
                         "'point:times[@after]'" % entry)
+            elif action == "rot":
+                if arg is None or not re.fullmatch(r"shard#\d+", arg):
+                    raise MXNetError(
+                        "fault schedule entry %r: rot needs 'shard#<k>' "
+                        "(which sharded-checkpoint blob to damage)"
+                        % entry)
             elif arg is not None:
                 raise MXNetError("fault schedule entry %r: kill takes "
                                  "no argument" % entry)
@@ -1187,6 +1204,7 @@ class CheckpointManager(object):
         dir/prefix-0007.states        epoch 7 optimizer state (optional)
         dir/prefix-0007.shard002      key-partition shard 2 (replication)
         dir/prefix-0007.shard002.rep1 shard 2's ring-offset-1 peer replica
+        dir/prefix-0007.params.s002-of-004  sharded-native blob 2 of 4
         dir/prefix-0007.pruning       retention tombstone (transient)
         dir/manifest.json             {"checkpoints": [...], "prefix": ...}
 
@@ -1222,9 +1240,26 @@ class CheckpointManager(object):
     — see SPMDTrainer.get_params's collective note); other ranks write
     only their replica shards (nothing at all when replication is off)
     and return the same epoch.
+
+    SHARDED-NATIVE (:meth:`save_sharded`, ``MXTPU_CKPT_SHARDED=1``
+    through ``SPMDTrainer.save_checkpoint``): under zero/zero3 every
+    dp shard of the master params + optimizer state lands as its OWN
+    blob (``prefix-0007.params.s002-of-004``) with a per-shard size +
+    digest in a format-2 manifest entry — no host-side gather; peak
+    host bytes are one shard's, O(P/world).  ``restore()`` verifies the
+    complete shard set BEFORE deserializing a byte and assembles the
+    full arrays on the host, so the restoring trainer's ``set_params``
+    re-shards them onto WHATEVER mesh it binds (elastic resume at any
+    world, matching the blob count or not); a missing/rotted/truncated
+    blob fails the epoch atomically and the walk-back lands on the last
+    COMPLETE verified epoch, never a mixed-epoch assembly.
     """
 
     MANIFEST = "manifest.json"
+
+    #: manifest-entry format of a sharded-native checkpoint (legacy
+    #: gathered entries carry no "format" key and imply format 1)
+    SHARDED_FORMAT = 2
 
     #: bound on draining an in-flight async write before a blocking save
     #: (or the preemption path) proceeds anyway — wedged storage must
@@ -1236,6 +1271,10 @@ class CheckpointManager(object):
         self.prefix = prefix
         self.keep_last = None if keep_last is None else max(1, int(keep_last))
         self._writer = None
+        #: {"peak_blob_bytes", "total_blob_bytes", ...} of the most
+        #: recent save_sharded on this manager (bench.py ckpt mode reads
+        #: it for ckpt_peak_host_frac), or None
+        self.last_save_stats = None
         # every rank may write (replica shards), so every rank needs the
         # directory — on per-host disks each rank creates its own
         os.makedirs(self.directory, exist_ok=True)
@@ -1260,6 +1299,15 @@ class CheckpointManager(object):
         name = "%s-%04d.shard%03d" % (self.prefix, epoch, part)
         return name if offset == 0 else "%s.rep%d" % (name, offset)
 
+    def shard_blob_name(self, epoch, shard, world):
+        """Basename of sharded-native blob ``shard`` (of ``world``) for
+        ``epoch`` — the ``params.s{K}-of-{W}`` layout."""
+        return "%s-%04d.params.s%03d-of-%03d" % (
+            self.prefix, int(epoch), int(shard), int(world))
+
+    def shard_blob_path(self, epoch, shard, world):
+        return self._path(self.shard_blob_name(epoch, shard, world))
+
     def _tombstone_path(self, epoch):
         return self._path("%s-%04d.pruning" % (self.prefix, int(epoch)))
 
@@ -1273,15 +1321,31 @@ class CheckpointManager(object):
         the per-file checksums are unrecoverable this way.  Epochs with a
         ``.pruning`` tombstone are IGNORED: retention had already
         committed to deleting them (the pruned manifest was written
-        first), so a crash mid-prune must not resurrect them here."""
+        first), so a crash mid-prune must not resurrect them here.
+
+        Sharded-native blobs (``params.s{K}-of-{W}``) are recognized
+        too: a COMPLETE shard set (all W blobs) rebuilds a format-2
+        entry — with no per-file digests, so the epoch is restorable
+        but NOT promotable (``verify_promotion`` rejects unverifiable
+        bytes); an incomplete set is skipped with a warning."""
         import re as _re
         pat = _re.compile(_re.escape(self.prefix) + r"-(\d{4,})\.params$")
+        bpat = _re.compile(_re.escape(self.prefix) +
+                           r"-(\d{4,})\.params\.s(\d{3})-of-(\d{3})$")
         entries = []
+        blob_sets = {}  # (epoch, world) -> {shard: basename}
         try:
             names = os.listdir(self.directory)
         except OSError:
             names = []
+        seen_epochs = set()
         for name in sorted(names):
+            bm = bpat.match(name)
+            if bm:
+                blob_sets.setdefault(
+                    (int(bm.group(1)), int(bm.group(3))),
+                    {})[int(bm.group(2))] = name
+                continue
             m = pat.match(name)
             if not m:
                 continue
@@ -1295,6 +1359,31 @@ class CheckpointManager(object):
             entries.append({"epoch": epoch, "params": name,
                             "states": states if os.path.exists(
                                 self._path(states)) else None})
+            seen_epochs.add(epoch)
+        for (epoch, world), shards in sorted(blob_sets.items()):
+            if epoch in seen_epochs:
+                continue  # a gathered params file already covers it
+            if os.path.exists(self._tombstone_path(epoch)):
+                _LOG.warning(
+                    "CheckpointManager: directory scan ignoring sharded "
+                    "epoch %d — a retention tombstone marks it "
+                    "half-deleted", epoch)
+                continue
+            missing = [k for k in range(world) if k not in shards]
+            if missing:
+                _LOG.warning(
+                    "CheckpointManager: directory scan skipping sharded "
+                    "epoch %d — shard set incomplete (missing %s of %d)",
+                    epoch, missing, world)
+                continue
+            entries.append({
+                "epoch": epoch, "params": None, "states": None,
+                "format": self.SHARDED_FORMAT,
+                "shard_set": {"world": world,
+                              "files": [{"shard": k, "file": shards[k]}
+                                        for k in range(world)]}})
+            seen_epochs.add(epoch)
+        entries.sort(key=lambda e: int(e["epoch"]))
         return {"prefix": self.prefix, "checkpoints": entries}
 
     def _read_manifest(self):
@@ -1329,13 +1418,14 @@ class CheckpointManager(object):
 
     def checkpoints(self):
         """Epochs recorded in the manifest whose params file exists (or
-        that carry shard records — a missing primary can still be rebuilt
-        from peer replicas), ascending."""
+        that carry shard records — replication OR a sharded-native
+        shard set — so a missing primary can still be rebuilt),
+        ascending."""
         out = []
         for entry in self._read_manifest().get("checkpoints", []):
             epoch = int(entry["epoch"])
             if os.path.exists(self.params_path(epoch)) or \
-                    entry.get("shards"):
+                    entry.get("shards") or entry.get("shard_set"):
                 out.append(epoch)
         return sorted(out)
 
@@ -1461,6 +1551,152 @@ class CheckpointManager(object):
                 self._writer = CheckpointWriter(
                     name="mxtpu-ckpt-writer[%s]" % self.prefix)
             self._writer.submit(job, "epoch %d" % epoch)
+        return epoch
+
+    def save_sharded(self, epoch, symbol=None, shard_payloads=None,
+                     world=None, step_state=None, plan=None, rank=None):
+        """Sharded-native save: write one verified blob PER SHARD, no
+        host-side gather; returns the epoch.
+
+        ``shard_payloads(k)`` -> the serialized bytes of shard ``k``
+        (or None when this rank does not hold it).  It is called one
+        shard at a time and each blob is released before the next is
+        built, so peak host bytes stay O(P/world) — the property
+        ``bench.py ckpt`` gates as ``ckpt_peak_host_frac``
+        (:attr:`last_save_stats` records the peaks).
+
+        The manifest entry is format 2: ``shard_set`` lists every
+        blob's shard index, size and digest (the same records also land
+        in ``files`` so the generic verification paths cover them), and
+        ``params``/``states`` are None — parameters AND optimizer
+        state live inside the blobs.  ``restore()`` verifies shard-set
+        completeness + every digest BEFORE deserializing and assembles
+        the full arrays; any damaged blob fails the whole epoch (walk
+        back, never a mixed-epoch assembly).
+
+        Sharded saves are BLOCKING by design: the payload callable
+        reads live device buffers lazily, which the background writer
+        must never race against a training step that donates them.
+
+        Multi-process: every rank writes the blobs it holds; rank != 0
+        returns without publishing.  Publishing rank 0 digests blobs
+        from the (shared) filesystem, so callers must barrier between
+        the peer writes and rank 0's ``save_sharded`` — single-process
+        multi-device runs (one rank holds every shard) need none."""
+        epoch = int(epoch)
+        world = int(world or 0)
+        rank = _rank() if rank is None else int(rank)
+        if world < 1 or shard_payloads is None:
+            raise MXNetError(
+                "save_sharded needs world >= 1 and a shard_payloads "
+                "callable (got world=%r)" % world)
+        sym_json = symbol if isinstance(symbol, str) or symbol is None \
+            else symbol.tojson()
+        if self._writer is not None:
+            # same manifest read-modify-write hazard as a blocking
+            # save(): drain any in-flight async write first (bounded)
+            try:
+                self._writer.wait(timeout=self.DRAIN_TIMEOUT)
+            except MXNetError as e:
+                _LOG.warning(
+                    "CheckpointManager: draining the async writer before "
+                    "a sharded save: %s — proceeding", e)
+        algo = _checksum_algo()
+        try:
+            os.remove(self._tombstone_path(epoch))
+        except OSError:
+            pass
+        peak = total = 0
+        for k in range(world):
+            blob = shard_payloads(k)
+            if blob is None:
+                continue  # a peer rank holds (and writes) this shard
+            # the SIGKILL-mid-shard-write window: earlier blobs are on
+            # disk, the manifest is not — the chaos drill wedges here
+            # (arm_hang) and kills the trainer with a partial shard set
+            faults.maybe_trip(
+                "shard_write",
+                "injected failure before writing shard %d/%d of epoch "
+                "%d" % (k, world, epoch))
+            atomic_write(self.shard_blob_path(epoch, k, world), blob,
+                         fault_point="shard_write")
+            peak = max(peak, len(blob))
+            total += len(blob)
+            del blob  # one shard resident at a time: peak host O(P/w)
+        self.last_save_stats = {"epoch": epoch, "world": world,
+                                "peak_blob_bytes": peak,
+                                "total_blob_bytes": total}
+        if rank != 0:
+            return epoch
+        files = {}
+        shard_files = []
+        for k in range(world):
+            path = self.shard_blob_path(epoch, k, world)
+            name = os.path.basename(path)
+            if not os.path.exists(path):
+                raise MXNetError(
+                    "save_sharded: shard %d/%d of epoch %d is not on "
+                    "disk — every shard must be written (and peer "
+                    "writes barriered) before rank 0 publishes"
+                    % (k, world, epoch))
+            rec = self._file_record(path, algo)
+            files[name] = rec
+            shard_files.append({"shard": k, "file": name,
+                                "size": rec["size"],
+                                "digest": rec["digest"]})
+        if sym_json is not None:
+            atomic_write(self.symbol_path(), sym_json)
+            sym_name = os.path.basename(self.symbol_path())
+            files[sym_name] = self._file_record(self.symbol_path(), algo)
+        # the classic SIGKILL-mid-save window: all blobs on disk, the
+        # manifest not — same point name as the gathered pipeline so
+        # existing drills/docs cover both
+        faults.maybe_trip("ckpt_write",
+                          "injected checkpoint-writer failure before "
+                          "publishing epoch %d" % epoch)
+        entry = {"epoch": epoch,
+                 "format": self.SHARDED_FORMAT,
+                 "params": None,
+                 "states": None,
+                 "time": time.time(),
+                 "checksum": algo,
+                 "files": files,
+                 "shard_set": {"world": world, "files": shard_files}}
+        if step_state is not None:
+            entry["step_state"] = dict(step_state)
+        if plan is not None:
+            entry["plan"] = dict(plan)
+        self._update_manifest(entry)
+        # the generic promote-drill points stay meaningful under the
+        # sharded layout: "the params artifact" of a format-2 entry is
+        # its blob set, so rot/truncate_checkpoint damage blob 0
+        if faults.consume("rot_checkpoint"):
+            _damage_file(self.shard_blob_path(epoch, 0, world),
+                         truncate=False)
+        if faults.consume("truncate_checkpoint"):
+            _damage_file(self.shard_blob_path(epoch, 0, world),
+                         truncate=True)
+        # promote-path chaos points, one consume PER SHARD in index
+        # order — arm(point, times=1, after=k) targets exactly blob k.
+        # Damage lands AFTER the manifest vouches for the bytes: the
+        # verification layer, not the filesystem, must catch it.
+        for k in range(world):
+            path = self.shard_blob_path(epoch, k, world)
+            if faults.consume("rot_shard"):
+                _damage_file(path, truncate=False)
+            if faults.consume("truncate_shard"):
+                _damage_file(path, truncate=True)
+            if faults.consume("drop_shard"):
+                try:
+                    os.remove(path)
+                    _LOG.warning(
+                        "fault injection: deleted shard blob %r after "
+                        "its manifest entry was published", path)
+                except OSError:  # pragma: no cover — injection only
+                    pass
+        _LOG.info("CheckpointManager: saved epoch %d as %d sharded "
+                  "blob(s) (peak host %d bytes of %d total)",
+                  epoch, world, peak, total)
         return epoch
 
     def wait(self, timeout=None):
@@ -1623,6 +1859,8 @@ class CheckpointManager(object):
         for part in shards.get("parts", []):
             paths.append(self._path(part["file"]))
             paths.extend(self._path(f) for f in part.get("replicas", []))
+        for rec in (entry.get("shard_set") or {}).get("files", []):
+            paths.append(self._path(rec["file"]))
         for path in paths:
             try:
                 os.remove(path)
@@ -1659,10 +1897,12 @@ class CheckpointManager(object):
                       "epoch %d", epoch)
             entry = self.entry(epoch) or {"epoch": epoch}
             self._delete_entry_files(entry)
-            # shard files an old manifest no longer names
-            stem = "%s-%04d.shard" % (self.prefix, epoch)
+            # shard files an old manifest no longer names (replication
+            # shards and sharded-native blobs alike)
+            stems = ("%s-%04d.shard" % (self.prefix, epoch),
+                     "%s-%04d.params.s" % (self.prefix, epoch))
             for other in names:
-                if other.startswith(stem):
+                if other.startswith(stems):
                     try:
                         os.remove(self._path(other))
                     except OSError:
@@ -1866,6 +2106,96 @@ class CheckpointManager(object):
             if chunks else None
         return arg_params, aux_params, states
 
+    def _restore_sharded(self, epoch, entry):
+        """Assemble a format-2 (sharded-native) checkpoint: verify the
+        COMPLETE shard set (every blob present, every recorded digest
+        intact) BEFORE a byte deserializes, then concatenate each
+        parameter's per-shard slices along its recorded dim.  Any
+        problem raises — ``restore()``'s walk-back then lands on the
+        last complete verified epoch.  Blobs additionally self-identify
+        (epoch/shard/world inside the payload), so even a scan-rebuilt
+        entry with no digests can never assemble a mixed-epoch
+        Frankenstein."""
+        import pickle
+        import numpy as np
+        from . import ndarray as nd
+        ss = entry["shard_set"]
+        world = int(ss.get("world", 0))
+        recs = {}
+        for rec in ss.get("files", []):
+            recs[int(rec.get("shard", -1))] = rec
+        missing = [k for k in range(world) if k not in recs]
+        if world < 1 or missing:
+            raise MXNetError(
+                "epoch %d shard set is incomplete (world=%d, missing "
+                "shard record(s) %s)" % (epoch, world, missing or "all"))
+        names = [recs[k]["file"] for k in range(world)]
+        for name in names:
+            if not os.path.exists(self._path(name)):
+                raise MXNetError("checkpoint shard %r is missing" % name)
+        # digest/size verification for every blob with a record (a
+        # scan-rebuilt entry has none — existence checked above, and
+        # the payload identity check below still refuses mixed epochs)
+        self._verify_files(entry, names)
+        dims, aux, parts_a, parts_o = {}, {}, {}, {}
+        num_update = None
+        for k in range(world):
+            with open(self._path(recs[k]["file"]), "rb") as f:
+                try:
+                    payload = pickle.loads(f.read())
+                except Exception as e:  # noqa: BLE001 — any rot flavor
+                    raise MXNetError(
+                        "checkpoint shard %r is unreadable (%s: %s)"
+                        % (recs[k]["file"], type(e).__name__, e))
+            if not isinstance(payload, dict) or \
+                    int(payload.get("epoch", -1)) != int(epoch) or \
+                    int(payload.get("world", -1)) != world or \
+                    int(payload.get("shard", -1)) != k:
+                raise MXNetError(
+                    "shard blob %r does not belong to epoch %d shard "
+                    "%d-of-%d (payload says epoch=%s shard=%s-of-%s) — "
+                    "refusing a mixed-epoch assembly"
+                    % (recs[k]["file"], epoch, k, world,
+                       payload.get("epoch"), payload.get("shard"),
+                       payload.get("world")))
+            dims.update(payload.get("dims") or {})
+            for n, v in (payload.get("args") or {}).items():
+                parts_a.setdefault(n, {})[k] = v
+            for n, s in (payload.get("opt") or {}).items():
+                parts_o.setdefault(n, {})[k] = tuple(s)
+            if k == 0:
+                aux = dict(payload.get("aux") or {})
+                num_update = payload.get("num_update")
+
+        def _assemble(name, by_shard):
+            d = dims.get(name)
+            if d is None:
+                return np.asarray(by_shard[0])
+            absent = sorted(set(range(world)) - set(by_shard))
+            if absent:
+                raise MXNetError(
+                    "parameter %r of epoch %d is missing shard "
+                    "slice(s) %s" % (name, epoch, absent))
+            return np.concatenate(
+                [np.asarray(by_shard[k]) for k in range(world)], axis=d)
+
+        arg_params = {n: nd.array(_assemble(n, by), dtype=np.asarray(
+            by[min(by)]).dtype) for n, by in parts_a.items()}
+        aux_params = {n: nd.array(np.asarray(v),
+                                  dtype=np.asarray(v).dtype)
+                      for n, v in aux.items()}
+        states = None
+        if parts_o or num_update is not None:
+            opt = {}
+            for n, by in parts_o.items():
+                nslots = len(by[min(by)])
+                opt[n] = tuple(
+                    _assemble(n, {k: s[i] for k, s in by.items()})
+                    for i in range(nslots))
+            states = pickle.dumps(
+                {"num_update": int(num_update or 0), "states": opt})
+        return arg_params, aux_params, states
+
     def _symbol_entry(self):
         """The newest manifest entry carrying the shared symbol file's
         integrity record — the only entry that describes the bytes now
@@ -1894,6 +2224,10 @@ class CheckpointManager(object):
         symbol = None
         if os.path.exists(self.symbol_path()):
             symbol = sym_mod.load(self.symbol_path())
+        if entry.get("shard_set"):
+            arg_params, aux_params, states = \
+                self._restore_sharded(epoch, entry)
+            return symbol, arg_params, aux_params, states, epoch
         params_file = self.params_path(epoch)
         use_shards = False
         try:
@@ -1975,7 +2309,13 @@ def verify_promotion(directory, epoch=None, prefix="checkpoint"):
     with no integrity records (pre-integrity-layer, or a manifest
     rebuilt by the corrupt-manifest directory scan) is REJECTED:
     unverifiable bytes must not ride a promote path, even though
-    ``restore()`` would tolerantly load them."""
+    ``restore()`` would tolerantly load them.
+
+    Sharded-native (format-2) entries verify their SHARD SET instead
+    of a params file: every shard index 0..world-1 must carry a record,
+    and every blob must match its size + digest — a half-written
+    publish or a single rotted shard rejects the whole epoch before
+    anything deserializes."""
     directory = os.fspath(directory)
     if not os.path.isdir(directory):
         return None, ["not a checkpoint directory: %r" % directory]
@@ -1990,9 +2330,23 @@ def verify_promotion(directory, epoch=None, prefix="checkpoint"):
         return epoch, ["epoch %d is not in the manifest" % epoch]
     problems = []
     files = entry.get("files") or {}
-    names = [os.path.basename(man.params_path(epoch))]
-    if entry.get("states"):
-        names.append(os.path.basename(man.states_path(epoch)))
+    shard_set = entry.get("shard_set")
+    if shard_set:
+        world = int(shard_set.get("world", 0))
+        recs = {}
+        for rec in shard_set.get("files", []):
+            recs[int(rec.get("shard", -1))] = rec
+        missing = [k for k in range(world) if k not in recs]
+        if world < 1 or missing:
+            problems.append(
+                "epoch %d shard set is incomplete (world=%d, missing "
+                "shard record(s) %s) — not promotable"
+                % (epoch, world, missing or "all"))
+        names = [recs[k]["file"] for k in sorted(recs)]
+    else:
+        names = [os.path.basename(man.params_path(epoch))]
+        if entry.get("states"):
+            names.append(os.path.basename(man.states_path(epoch)))
     for name in names:
         if name not in files:
             problems.append("%s: no integrity record in the manifest "

@@ -232,6 +232,14 @@ class CheckpointWatcher(object):
         """The new epoch's RAW param bytes (digest-verified upstream),
         split into (arg_params, aux_params)."""
         from .. import ndarray as nd
+        entry = self._man.entry(epoch) or {}
+        if entry.get("shard_set"):
+            # sharded-native publish: assemble from the per-shard blobs
+            # (shard-set completeness + every digest re-verified before
+            # a byte deserializes — same walk-back-grade guarantees)
+            args, auxs, _states = self._man._restore_sharded(epoch,
+                                                             entry)
+            return args, auxs
         raw = nd.load(self._man.params_path(epoch))
         args = {k[4:]: v for k, v in raw.items() if k.startswith("arg:")}
         auxs = {k[4:]: v for k, v in raw.items() if k.startswith("aux:")}

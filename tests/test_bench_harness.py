@@ -800,3 +800,57 @@ def test_region_mode_is_known_and_in_the_pipeline_set():
     with open(os.path.join(REPO, "bench.py")) as f:
         src = f.read()
     assert '_collect("region"' in src
+
+
+# ---------------------------------------------------------------------------
+# ckpt mode (ISSUE 18: sharded-native checkpoints)
+# ---------------------------------------------------------------------------
+
+def test_gate_keys_cover_sharded_ckpt_metrics(tmp_path):
+    """The sharded-checkpoint contract is gate-guarded through two
+    LOWER-is-better keys: the sharded save's step-loop cost
+    (ckpt_save_ms) and the peak-host fraction (ckpt_peak_host_frac —
+    the whole point of the feature; it rises back toward 1.0 if a
+    host-side gather sneaks into the save path).  A RISE past
+    tolerance blocks, an improvement passes, a vanished key blocks."""
+    for key in ("ckpt_save_ms", "ckpt_peak_host_frac"):
+        assert key in bench.GATE_KEYS
+        assert key in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, ckpt_save_ms=40.0, ckpt_peak_host_frac=0.125)
+    # peak host residency creeping back toward the full gather BLOCKS
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, ckpt_peak_host_frac=1.0)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert not rep["pass"]
+    reg = rep["regressions"][0]
+    assert reg["key"] == "ckpt_peak_host_frac" and "rise" in reg
+    # a slower sharded save BLOCKS
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, ckpt_save_ms=80.0)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "ckpt_save_ms"
+    # an IMPROVEMENT (smaller peak, faster save) must pass — the raw
+    # higher-is-better rule would have flagged exactly this
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, ckpt_save_ms=20.0,
+                                 ckpt_peak_host_frac=0.0625)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert rep["pass"], rep
+    # a vanished key blocks too (the mode silently dying must not
+    # look like "nothing regressed")
+    for gone_key in ("ckpt_save_ms", "ckpt_peak_host_frac"):
+        gone = {k: v for k, v in base.items() if k != gone_key}
+        rep = bench.gate(_write(tmp_path / "g.json", gone),
+                         against=_write(tmp_path / "go.json", base))
+        assert not rep["pass"]
+        assert rep["regressions"][0]["key"] == gone_key
+
+
+def test_ckpt_mode_is_known_and_in_the_pipeline_set():
+    assert "ckpt" in bench.KNOWN_MODES
+    # source-level pin, like hotswap/fleet/region: a mode that silently
+    # leaves the pipeline set stops minting its gate keys
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '_collect("ckpt")' in src

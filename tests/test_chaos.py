@@ -723,6 +723,153 @@ def test_chaos_sigkill_mid_async_save_resumes_previous_epoch(tmp_path):
         assert np.array_equal(full[name], cut[name]), name
 
 
+SHARDED_DRILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import SPMDTrainer, build_mesh
+from mxnet_tpu.resilience import CheckpointManager, faults
+
+def make_blobs(n, d, c, seed=4):
+    rs = np.random.RandomState(seed)
+    centers = rs.randn(c, d) * 3
+    X = np.concatenate([centers[i] + rs.randn(n // c, d)
+                        for i in range(c)]).astype("f")
+    y = np.concatenate([np.full(n // c, i) for i in range(c)]).astype("f")
+    perm = rs.permutation(len(X))
+    return X[perm], y[perm]
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+world = int(os.environ["CHAOS_WORLD"])
+trainer = SPMDTrainer(sym, "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9},
+                      mesh=build_mesh({"dp": world},
+                                      jax.devices()[:world]),
+                      grad_sync="zero3")
+trainer.bind([("data", (64, 10))], [("softmax_label", (64,))])
+mx.random.seed(21)
+trainer.init_params(mx.initializer.Xavier())
+mgr = CheckpointManager(os.environ["CHAOS_DIR"])
+
+start = 0
+resuming = os.environ.get("MXTPU_RESUME") == "1"
+if resuming:
+    start = trainer.restore(mgr)
+    if os.environ.get("CHAOS_RESTORE_OUT"):
+        # the restored-state probe: what the walk-back + elastic
+        # assembly actually put on THIS world's mesh, dumped before a
+        # single new step can touch it
+        arg, _ = trainer.get_params()
+        mx.nd.save(os.environ["CHAOS_RESTORE_OUT"], dict(arg))
+
+X, y = make_blobs(192, 10, 3)  # 192 = 3 full 64-batches, no ragged tail
+for epoch in range(start, 3):
+    for i in range(0, 192, 64):
+        trainer.step(X[i:i + 64], y[i:i + 64])
+    if os.environ.get("CHAOS_SHARD_HANG") and not resuming \\
+            and epoch == 1:
+        # wedge the epoch-2 sharded save BETWEEN blob writes: shards
+        # 0 and 1 land on disk, the hang holds before shard 2, the
+        # manifest is never published — then the parent SIGKILLs us.
+        # each blob passes the point TWICE (pre-write trip + the
+        # atomic publish check), so blobs 0+1 burn 4 'after' hits
+        faults.arm_hang("shard_write", seconds=3600, after=4)
+    trainer.save_checkpoint(mgr, epoch + 1)
+    if os.environ.get("CHAOS_E1_OUT") and epoch == 0:
+        arg, _ = trainer.get_params()
+        mx.nd.save(os.environ["CHAOS_E1_OUT"], dict(arg))
+trainer.close()
+"""
+
+
+@pytest.mark.chaos
+def test_chaos_sigkill_mid_shard_write_elastic_resume(tmp_path):
+    """THE sharded drill: a world=4 zero3 trainer is SIGKILLed inside
+    a sharded-native save with 2 of 4 shard blobs on disk and the
+    manifest unpublished.  The torn shard set must never be
+    restorable — the directory walks back to epoch 1 — and the resume
+    is ELASTIC: relaunches at world=2 AND world=8 both restore the
+    epoch-1 state bit-identical to the world=4 run's, then train on
+    to completion publishing their own shard sets."""
+    import shutil
+    script = tmp_path / "train.py"
+    script.write_text(SHARDED_DRILL_SCRIPT % {"repo": REPO})
+
+    def env_for(name, world, **extra):
+        env = _drill_env(tmp_path, name)
+        env["MXTPU_CKPT_SHARDED"] = "1"
+        env["CHAOS_WORLD"] = str(world)
+        env.update({k: str(v) for k, v in extra.items()})
+        return env
+
+    # the cut run: it publishes epoch 1 cleanly (dumping its params as
+    # the bit-parity reference), then gets wedged between blob 1 and
+    # blob 2 of epoch 2's save and SIGKILLed — no cleanup, no atexit
+    e1 = tmp_path / "e1.params"
+    cut_dir = tmp_path / "cut"
+    proc = subprocess.Popen(
+        [sys.executable, str(script)],
+        env=env_for("cut", 4, CHAOS_SHARD_HANG=1, CHAOS_E1_OUT=e1),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        sentinel = cut_dir / "checkpoint-0002.params.s001-of-004"
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not sentinel.exists():
+            assert proc.poll() is None, "drill process died early"
+            time.sleep(0.05)
+        assert sentinel.exists(), "epoch-2 sharded save never started"
+        time.sleep(0.5)  # let the writer reach the armed hang
+        proc.kill()
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # mid-shard-write forensics: a PARTIAL shard set (blobs 0 and 1,
+    # no blob 2) with the manifest still ending at epoch 1 — the torn
+    # epoch is invisible to restore
+    man = CheckpointManager(str(cut_dir))
+    assert man.latest() == 1
+    assert man.latest_entry()["shard_set"]["world"] == 4
+    assert (cut_dir / "checkpoint-0002.params.s000-of-004").exists()
+    assert not (cut_dir / "checkpoint-0002.params.s002-of-004").exists()
+
+    # elastic resume from the torn directory at world=2 AND world=8
+    # (8 needs its own copy: the first resume re-publishes 2 and 3)
+    cut8 = tmp_path / "cut8"
+    shutil.copytree(cut_dir, cut8)
+    restored = {}
+    for world, name in ((2, "cut"), (8, "cut8")):
+        probe = tmp_path / ("restored-w%d.params" % world)
+        env = env_for(name, world, CHAOS_RESTORE_OUT=probe)
+        env["MXTPU_RESUME"] = "1"
+        res = subprocess.run([sys.executable, str(script)], env=env,
+                             capture_output=True, text=True,
+                             timeout=300)
+        assert res.returncode == 0, (world, res.stderr[-2000:])
+        restored[world] = _load_params(probe)
+        m = CheckpointManager(str(tmp_path / name))
+        assert m.latest() == 3
+        assert m.latest_entry()["shard_set"]["world"] == world
+
+    # both restores are bit-identical to the world=4 epoch-1 state:
+    # shard-count-mismatched assembly changed NOTHING
+    want = _load_params(e1)
+    for world in (2, 8):
+        assert set(restored[world]) == set(want)
+        for k in want:
+            assert np.array_equal(restored[world][k], want[k]), \
+                (world, k)
+
+
 # ---------------------------------------------------------------------------
 # serving drills: SIGTERM drain + wedged-forward watchdog relaunch
 # (docs/how_to/serving.md — the daemon side of the survival story)

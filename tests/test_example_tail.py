@@ -11,6 +11,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 EX = os.path.join(HERE, "..", "example")
@@ -72,6 +73,9 @@ def test_kaggle_ndsb1_pipeline():
     assert os.path.exists(sub + ".gz")
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_kaggle_ndsb2_crps_beats_baseline():
     mod = _load("kaggle-ndsb2", "train.py", "ex_ndsb2")
     score, baseline = mod.main(["--num-epochs", "6"])

@@ -7,6 +7,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -62,6 +63,9 @@ def test_numpy_softmax_example_trains():
     assert acc > 0.9, acc
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_memcost_example_measures():
     """Mirror/remat mode measurably shrinks compiled temp memory on TPU
     (reference example/memcost: larger batches via MXNET_BACKWARD_DO_MIRROR);

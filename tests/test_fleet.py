@@ -1080,6 +1080,51 @@ def test_router_workers_share_reuseport_and_merge_stats(tmp_path,
         sock.close()
 
 
+class _SupervisedFakes(object):
+    """Controller duck over fake replicas: enough surface
+    (``ports``/``replicas``/``snapshot``) for a prober-side
+    FleetRouter, with distinct supervision fields per replica."""
+
+    def __init__(self, fakes):
+        self.replicas = list(range(len(fakes)))
+        self._ports = {i: f.port for i, f in enumerate(fakes)}
+
+    def ports(self):
+        return dict(self._ports)
+
+    def snapshot(self):
+        return [{"id": i, "state": "serving", "port": p,
+                 "pid": 40000 + i, "restarts": i, "last_rc": None}
+                for i, p in sorted(self._ports.items())]
+
+
+def test_worker_stats_carry_supervision_fields_through_view(tmp_path,
+                                                            two_fakes):
+    """Sharded front end: the controller lives in the prober's
+    process, but kill-replica drills and respawn crediting read
+    pid/restarts off whatever worker answers /stats — so those fields
+    must ride the published view to every worker."""
+    man = _mk_manifest(two_fakes)
+    prober = FleetRouter(_SupervisedFakes(two_fakes), man, port=0,
+                         heartbeat_s=0.15, evict_s=0.6, spill_queue=4)
+    prober.probe()
+    path = str(tmp_path / "fleet-view.json")
+    FleetViewPublisher(prober, path).publish_once()
+
+    worker = FleetRouter(FleetViewReader(path, refresh_s=0.0), man,
+                         port=0, evict_s=0.4, spill_queue=4)
+    reps = worker.stats_payload()["replicas"]
+    for rid in (0, 1):
+        assert reps[rid]["pid"] == 40000 + rid
+        assert reps[rid]["restarts"] == rid
+        assert reps[rid]["state"] == "serving"
+    # the controller-side table says the same thing (one source of
+    # truth, two serving paths)
+    ctrl_reps = prober.stats_payload()["replicas"]
+    for rid in (0, 1):
+        assert ctrl_reps[rid]["pid"] == reps[rid]["pid"]
+
+
 # ---------------------------------------------------------------------------
 # autoscaler policy (fleet/autoscale.py) — synthetic signal, duck fleet
 # ---------------------------------------------------------------------------
@@ -1236,3 +1281,91 @@ def test_autoscaler_scale_down_failure_unwinds_fence():
     # the half-retired replica is unfenced and keeps serving
     assert router._fenced == set()
     assert router.log == [("fence", 1), ("unfence", 1)]
+
+
+def _publish_sharded_epoch(directory, epoch, world=2, damage=None):
+    """A format-2 (sharded-native) manifest entry with REAL per-blob
+    digests, no jax: params=None, every blob recorded in both `files`
+    and `shard_set`.  `damage=(k, "rot"|"drop")` hurts blob k AFTER
+    the digests are recorded — rot under the digest, or delete."""
+    from mxnet_tpu.resilience import atomic_write, checksum_file
+    os.makedirs(directory, exist_ok=True)
+    files, records = {}, []
+    for k in range(world):
+        name = "checkpoint-%04d.params.s%03d-of-%03d" % (epoch, k,
+                                                         world)
+        path = os.path.join(directory, name)
+        atomic_write(path, b"epoch-%d-shard-%d-bytes" % (epoch, k))
+        size, digest = checksum_file(path)
+        files[name] = {"size": size, "digest": digest}
+        records.append({"shard": k, "file": name, "size": size,
+                        "digest": digest})
+    if damage is not None:
+        k, how = damage
+        path = os.path.join(
+            directory, "checkpoint-%04d.params.s%03d-of-%03d"
+            % (epoch, k, world))
+        if how == "drop":
+            os.remove(path)
+        else:
+            blob = bytearray(open(path, "rb").read())
+            blob[len(blob) // 2] ^= 0xFF
+            open(path, "wb").write(bytes(blob))
+    mpath = os.path.join(directory, "manifest.json")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {"prefix": "checkpoint", "checkpoints": []}
+    entries = [e for e in manifest["checkpoints"]
+               if e["epoch"] != epoch]
+    entries.append({"epoch": epoch, "format": 2, "params": None,
+                    "states": None, "checksum": "sha256",
+                    "time": time.time(), "files": files,
+                    "shard_set": {"world": world, "files": records}})
+    manifest["checkpoints"] = sorted(entries,
+                                     key=lambda e: e["epoch"])
+    atomic_write(mpath, json.dumps(manifest))
+
+
+def test_rolling_swap_sharded_publish_rolls_and_gates(two_fakes,
+                                                      tmp_path):
+    """The fleet tier of the shard-loss matrix: a clean sharded-native
+    publish rolls fence -> swap -> rejoin like any other epoch, a
+    shard-damaged one (rot under digest OR missing blob) never starts
+    a rollout — counted once per publish, fleet stays put."""
+    from mxnet_tpu.fleet import RollingSwap
+    ckpt = str(tmp_path / "ckpts")
+    _publish_sharded_epoch(ckpt, 1)
+    for f in two_fakes:
+        f.epochs["a"] = 1
+    router = _mk_router(two_fakes)
+    router.probe()
+    roll = RollingSwap(router, {"a": ckpt}, poll_s=0.05,
+                       log=lambda m: None)
+    assert roll.check_once() == {"a": "current"}
+
+    # clean sharded epoch 2: full rollout, one /swap per replica
+    _publish_sharded_epoch(ckpt, 2)
+    assert roll.check_once() == {"a": "complete"}
+    for f in two_fakes:
+        assert f.epochs["a"] == 2
+        swaps = [p for p, _ in f.received if p.startswith("/swap/")]
+        assert swaps == ["/swap/a"]
+    assert router.fenced() == []
+
+    # epoch 3 loses blob 1 entirely: incomplete shard set, no rollout
+    _publish_sharded_epoch(ckpt, 3, damage=(1, "drop"))
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.counters["rejected"] == 1      # counted once
+
+    # epoch 4 bit-rots blob 0 under its recorded digest: rejected too,
+    # and the NEW publish mark is counted separately
+    _publish_sharded_epoch(ckpt, 4, damage=(0, "rot"))
+    assert roll.check_once() == {"a": "rejected"}
+    assert roll.counters["rejected"] == 2
+    for f in two_fakes:
+        assert f.epochs["a"] == 2
+        swaps = [p for p, _ in f.received if p.startswith("/swap/")]
+        assert swaps == ["/swap/a"]            # still just epoch 2's

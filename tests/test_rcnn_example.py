@@ -6,6 +6,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -21,6 +22,9 @@ def _load(name, path):
     return mod
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_rcnn_trains():
     sys.path.insert(0, RCNN)
     try:

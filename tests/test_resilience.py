@@ -1297,3 +1297,274 @@ def test_fsck_promote_gate_and_watch_share_the_verifier(tmp_path,
                     "--poll", "0.05")
     assert res.returncode == 1
     assert "epoch 2 REJECTED" in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# sharded-native checkpoints: per-shard blobs, shard-level verification,
+# elastic-ready assembly (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def _sharded_payloads(epoch, world, base=1.0, rows=2):
+    """Synthetic shard payloads in the trainer's blob contract: shard k
+    carries its slice of "w" (dim 0) + one momentum slot; shard 0 also
+    carries the replicated "bias", aux state and the update counter."""
+    import pickle
+
+    def payload(k):
+        w = np.full((rows, 3), base + k, "f")
+        out = {"epoch": int(epoch), "shard": k, "world": int(world),
+               "args": {"w": w}, "opt": {"w": (w * 0.5,)},
+               "dims": {"w": 0}}
+        if k == 0:
+            out["args"]["bias"] = np.full((3,), base, "f")
+            out["dims"]["bias"] = None
+            out["aux"] = {"mov": np.full((2,), base, "f")}
+            out["num_update"] = int(epoch) * 10
+        return pickle.dumps(out, protocol=4)
+    return payload
+
+
+def _expected_w(world, base=1.0, rows=2):
+    return np.concatenate(
+        [np.full((rows, 3), base + k, "f") for k in range(world)], axis=0)
+
+
+def test_save_sharded_roundtrip_and_format2_manifest(tmp_path):
+    """The tentpole roundtrip: one verified blob per shard, a format-2
+    manifest entry whose shard_set records every blob's index/size/
+    digest (and whose files map covers them for the generic
+    verifiers), and restore() assembling the full arrays — params
+    along the recorded dim, replicated/aux/num_update from blob 0."""
+    import pickle
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    world = 4
+    man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                     world=world)
+    entry = man.entry(1)
+    assert entry["format"] == CheckpointManager.SHARDED_FORMAT
+    assert entry["params"] is None and entry["states"] is None
+    ss = entry["shard_set"]
+    assert ss["world"] == world
+    assert [r["shard"] for r in ss["files"]] == list(range(world))
+    for rec in ss["files"]:
+        assert rec["file"].startswith("checkpoint-0001.params.s")
+        assert os.path.exists(str(tmp_path / rec["file"]))
+        # the same record rides the generic files map (size + digest),
+        # so every existing verifier covers blobs with no new code
+        assert entry["files"][rec["file"]]["digest"] == rec["digest"]
+    assert man.checkpoints() == [1]
+    assert verify_promotion(str(tmp_path)) == (1, [])
+    # peak host residency is ONE blob, not the gather
+    st = man.last_save_stats
+    assert st["peak_blob_bytes"] < st["total_blob_bytes"]
+
+    symbol, args, auxs, states, epoch = man.restore()
+    assert epoch == 1 and symbol is not None
+    assert np.array_equal(args["w"].asnumpy(), _expected_w(world))
+    assert np.array_equal(args["bias"].asnumpy(), np.full((3,), 1.0, "f"))
+    assert np.array_equal(auxs["mov"].asnumpy(), np.full((2,), 1.0, "f"))
+    st = pickle.loads(states)
+    assert st["num_update"] == 10
+    assert np.array_equal(st["states"]["w"][0], _expected_w(world) * 0.5)
+
+
+@pytest.mark.parametrize("point", ["rot_shard", "truncate_shard",
+                                   "drop_shard"])
+def test_shard_loss_matrix_every_single_shard(tmp_path, clean_faults,
+                                              point):
+    """The shard-loss matrix: EACH single shard rotted / truncated /
+    deleted (arm(point, times=1, after=k) damages exactly blob k after
+    its manifest publish) is caught by verify_promotion before any
+    deserialization, and restore() walks back to the last COMPLETE
+    verified epoch — never a partial or mixed assembly."""
+    from mxnet_tpu.resilience import verify_promotion
+    world = 3
+    for k in range(world):
+        d = tmp_path / ("%s_%d" % (point, k))
+        man = CheckpointManager(str(d))
+        man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                         world=world)
+        clean_faults.arm(point, times=1, after=k)
+        man.save_sharded(2, None, _sharded_payloads(2, world, base=5.0),
+                         world=world)
+        # the manifest vouches for epoch 2 (damage landed post-publish)
+        assert man.latest() == 2
+        blob_k = d / man.shard_blob_name(2, k, world)
+        if point == "drop_shard":
+            assert not blob_k.exists()
+        else:
+            assert blob_k.exists()
+        epoch, problems = verify_promotion(str(d))
+        assert epoch == 2 and problems, (point, k)
+        # walk-back to the intact epoch, bit-exact
+        _, args, _, _, epoch = man.restore()
+        assert epoch == 1, (point, k)
+        assert np.array_equal(args["w"].asnumpy(), _expected_w(world))
+
+
+def test_sharded_scan_rebuild_restorable_not_promotable(tmp_path):
+    """Corrupt-manifest recovery recognizes shard blob filenames: a
+    COMPLETE shard set is reassembled (restorable), an incomplete one
+    is skipped, and — PR 13 semantics — a rebuilt entry has no digests
+    so the promote gate refuses it."""
+    from mxnet_tpu.resilience import atomic_write, verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    world = 2
+    man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                     world=world)
+    # a second epoch missing one blob: the scan must NOT resurrect it
+    pay = _sharded_payloads(3, world, base=9.0)
+    atomic_write(str(tmp_path / man.shard_blob_name(3, 0, world)),
+                 pay(0))
+    (tmp_path / "manifest.json").write_text("{ torn")
+    man2 = CheckpointManager(str(tmp_path))
+    assert man2.checkpoints() == [1]
+    _, args, _, _, epoch = man2.restore()
+    assert epoch == 1
+    assert np.array_equal(args["w"].asnumpy(), _expected_w(world))
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 1 and problems
+    assert "no integrity record" in problems[0]
+
+
+def test_sharded_mixed_epoch_refusal_without_digests(tmp_path):
+    """Blobs self-identify (epoch/shard/world in the payload), so even
+    a digest-less scan-rebuilt entry can never assemble a Frankenstein
+    state from two epochs' blobs — the mixed epoch fails and restore
+    walks back to a coherent one."""
+    import shutil as _sh
+    man = CheckpointManager(str(tmp_path))
+    world = 2
+    man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                     world=world)
+    man.save_sharded(2, None, _sharded_payloads(2, world, base=5.0),
+                     world=world)
+    # lose the manifest -> rebuilt entries carry no digests ...
+    (tmp_path / "manifest.json").write_text("{ torn")
+    # ... then splice epoch 1's blob into epoch 2's shard set
+    _sh.copyfile(str(tmp_path / man.shard_blob_name(1, 1, world)),
+                 str(tmp_path / man.shard_blob_name(2, 1, world)))
+    man2 = CheckpointManager(str(tmp_path))
+    assert man2.checkpoints() == [1, 2]
+    _, args, _, _, epoch = man2.restore()
+    assert epoch == 1   # epoch 2 refused as a mixed assembly
+    assert np.array_equal(args["w"].asnumpy(), _expected_w(world))
+
+
+def test_verify_promotion_shard_set_completeness(tmp_path):
+    """An entry whose shard_set lost a record (manifest damage that
+    keeps valid JSON) is reported as incomplete — not promotable, no
+    deserialization attempted."""
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    world = 3
+    man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                     world=world)
+    mpath = tmp_path / "manifest.json"
+    doc = json.loads(mpath.read_text())
+    entry = doc["checkpoints"][-1]
+    dropped = entry["shard_set"]["files"].pop(1)
+    entry["files"].pop(dropped["file"])
+    mpath.write_text(json.dumps(doc))
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 1 and problems
+    assert "incomplete" in problems[0]
+
+
+def test_sharded_and_gathered_epochs_coexist(tmp_path, clean_faults):
+    """Backward compat both ways in ONE directory: a legacy gathered
+    epoch and a sharded epoch restore and promote side by side, and a
+    damaged sharded epoch walks back onto the gathered one."""
+    from mxnet_tpu.resilience import verify_promotion
+    man = CheckpointManager(str(tmp_path))
+    world = 2
+    man.save(1, mlp_sym(), {"w": mx.nd.array(_expected_w(world))}, {},
+             optimizer_states=b"opt")
+    man.save_sharded(2, None, _sharded_payloads(2, world, base=5.0),
+                     world=world)
+    assert man.checkpoints() == [1, 2]
+    assert verify_promotion(str(tmp_path)) == (2, [])
+    _, args, _, _, epoch = man.restore()
+    assert epoch == 2
+    assert np.array_equal(args["w"].asnumpy(),
+                          _expected_w(world, base=5.0))
+    # damage one shard blob -> promote refuses, restore lands on the
+    # legacy gathered epoch
+    blob = tmp_path / man.shard_blob_name(2, 0, world)
+    raw = bytearray(blob.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    blob.write_bytes(bytes(raw))
+    epoch, problems = verify_promotion(str(tmp_path))
+    assert epoch == 2 and problems
+    _, args, _, states, epoch = man.restore()
+    assert epoch == 1 and states == b"opt"
+    assert np.array_equal(args["w"].asnumpy(), _expected_w(world))
+
+
+def test_sharded_prune_deletes_blobs_and_tombstones(tmp_path):
+    """Retention covers the sharded layout: pruning a format-2 epoch
+    removes every blob (manifest-listed AND stray same-epoch blobs via
+    the tombstone sweep)."""
+    man = CheckpointManager(str(tmp_path), keep_last=1)
+    world = 2
+    for epoch in (1, 2):
+        man.save_sharded(epoch, mlp_sym(),
+                         _sharded_payloads(epoch, world), world=world)
+    assert man.checkpoints() == [2]
+    assert not (tmp_path / man.shard_blob_name(1, 0, world)).exists()
+    assert not (tmp_path / man.shard_blob_name(1, 1, world)).exists()
+    assert (tmp_path / man.shard_blob_name(2, 0, world)).exists()
+
+
+def test_parse_fault_schedule_rot_grammar():
+    """STORM grammar: '<at_s> rot <role> shard#<k>' parses to a counted
+    rot event; malformed args fail loudly (a silently skipped event
+    would pass its drill without testing anything)."""
+    from mxnet_tpu.resilience import parse_fault_schedule
+    evs = parse_fault_schedule("9 rot trainer shard#1\n")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert (ev.at_s, ev.action, ev.target, ev.arg) == \
+        (9.0, "rot", "trainer", "shard#1")
+    assert ev.label == "rot:trainer:shard#1"
+    for bad in ("9 rot trainer", "9 rot trainer shard1",
+                "9 rot trainer shard#", "9 rot trainer shard#1 extra"):
+        with pytest.raises(MXNetError):
+            parse_fault_schedule(bad)
+
+
+def test_fsck_sharded_clean_damaged_and_incomplete(tmp_path):
+    """tools/ckpt_fsck.py speaks the sharded layout: a clean shard set
+    passes, a rotted blob fails the audit AND the promote gate, and an
+    entry whose shard_set lost a record is reported incomplete."""
+    import json as _json
+    man = CheckpointManager(str(tmp_path))
+    world = 3
+    man.save_sharded(1, mlp_sym(), _sharded_payloads(1, world),
+                     world=world)
+    res = _run_fsck(tmp_path, "-q")
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = _run_fsck(tmp_path, "--promote-gate")
+    assert res.returncode == 0
+    assert _json.loads(res.stdout)["promotable"]
+
+    blob = tmp_path / man.shard_blob_name(1, 1, world)
+    raw = bytearray(blob.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    blob.write_bytes(bytes(raw))
+    res = _run_fsck(tmp_path, "-q")
+    assert res.returncode == 1
+    res = _run_fsck(tmp_path, "--promote-gate")
+    assert res.returncode == 1
+    assert not _json.loads(res.stdout)["promotable"]
+
+    mpath = tmp_path / "manifest.json"
+    doc = _json.loads(mpath.read_text())
+    entry = doc["checkpoints"][-1]
+    dropped = entry["shard_set"]["files"].pop(0)
+    entry["files"].pop(dropped["file"])
+    mpath.write_text(_json.dumps(doc))
+    res = _run_fsck(tmp_path)
+    assert res.returncode == 1
+    assert "incomplete" in res.stdout

@@ -13,6 +13,8 @@ import sys
 
 import numpy as np
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 RL = os.path.join(HERE, "..", "example", "reinforcement-learning")
 
@@ -40,6 +42,9 @@ def test_replay_memory_successors():
     assert s.shape == (16, 3) and term.dtype == np.float32
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_dqn_learns_catch():
     """The GREEDY policy improves decisively with training (the
     reference separates training from dqn_run_test.py greedy eval the
@@ -55,6 +60,9 @@ def test_dqn_learns_catch():
     assert after > -0.35, "greedy mean episode reward %.3f" % after
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_dqn_double_q_mode():
     demo = _load("dqn", "dqn_demo.py", "dqn_demo2")
     rewards, _ = demo.main(["--updates", "120", "--print-every", "0",

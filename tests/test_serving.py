@@ -1803,3 +1803,69 @@ def test_predict_seq_http_roundtrip_and_per_bucket_batchers():
         cli.close()
     finally:
         fe.drain_and_stop(timeout=10)
+
+
+def _sharded_publish(man, sym, epoch, args, auxs, world=2):
+    """Publish ``epoch`` sharded-native (format 2): fc1_weight split
+    along dim 0 across ``world`` blobs, everything else (+ aux) riding
+    blob 0 — the serving side must assemble before it can promote."""
+    import pickle
+    np_args = {k: v.asnumpy() for k, v in args.items()}
+    w = np_args.pop("fc1_weight")
+    per = w.shape[0] // world
+
+    def payload(k):
+        out = {"epoch": int(epoch), "shard": k, "world": world,
+               "args": {"fc1_weight": w[k * per:(k + 1) * per]},
+               "opt": {}, "dims": {"fc1_weight": 0}}
+        if k == 0:
+            out["args"].update(np_args)
+            out["aux"] = {n: v.asnumpy() for n, v in auxs.items()}
+            out["num_update"] = int(epoch)
+        return pickle.dumps(out, protocol=4)
+
+    man.save_sharded(epoch, sym, payload, world=world)
+
+
+def test_watcher_promotes_sharded_publish_bit_exact(tmp_path):
+    """A sharded-native publish (ISSUE 18) rides the same watcher
+    pipeline: verified (shard-set completeness + per-blob digests)
+    before a byte deserializes, assembled from the blobs, and the
+    swapped weights are bitwise equal to a fresh load of the epoch."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    assert watcher.check_once()["action"] == "current"
+    args2, auxs2 = init_params(sym, (1, 32), seed=202)
+    _sharded_publish(man, sym, 2, args2, auxs2)
+    out = watcher.check_once()
+    assert out["ok"] and out["action"] == "promoted", out
+    assert entry.loaded_epoch == 2
+    x = {"data": np.random.RandomState(5).rand(4, 32).astype("f")}
+    swapped = entry.forward(dict(x))
+    fresh = ModelPool().load_dir("m2", man.directory,
+                                 sample_shapes={"data": (32,)})
+    assert fresh.loaded_epoch == 2
+    for a, b in zip(swapped, fresh.forward(dict(x))):
+        assert np.array_equal(a, b), "swap != fresh load of the epoch"
+
+
+def test_watcher_rejects_damaged_shard_exactly_once(tmp_path):
+    """One damaged blob of a sharded publish = ONE rejection counted
+    (per publish mark, not per poll), the served epoch unchanged — the
+    shard-loss matrix's serving-tier row."""
+    man, sym, save, pool, entry, watcher = _watched_pool(tmp_path)
+    args2, auxs2 = init_params(sym, (1, 32), seed=202)
+    _sharded_publish(man, sym, 2, args2, auxs2)
+    assert watcher.check_once()["action"] == "promoted"
+    args3, auxs3 = init_params(sym, (1, 32), seed=303)
+    _sharded_publish(man, sym, 3, args3, auxs3)
+    blob = os.path.join(man.directory, man.shard_blob_name(3, 1, 2))
+    raw = bytearray(open(blob, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(blob, "wb").write(bytes(raw))
+    out = watcher.check_once()
+    assert not out["ok"] and out["action"] == "rejected"
+    assert watcher.counters["rejected"] == 1
+    out = watcher.check_once()
+    assert out["action"] == "rejected" and out.get("already_counted")
+    assert watcher.counters["rejected"] == 1
+    assert entry.loaded_epoch == 2

@@ -166,6 +166,9 @@ def test_truncated_iter_pad_zeros_tail(speech_mod):
     assert last.effective_sample_count == int((live > 0).sum())
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_training_learns_bucketing(speech_mod, tmp_path, monkeypatch):
     """Two epochs of the bucketing recipe on a small corpus: dev CE must
     beat uniform-random by a clear margin (temporal context is learnable

@@ -5,9 +5,14 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_stochastic_depth_trains():
     path = os.path.join(REPO, "example", "stochastic-depth",
                         "sd_module.py")

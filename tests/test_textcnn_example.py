@@ -4,9 +4,14 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# minutes-scale convergence run: tier-1 (-m 'not slow') must fit
+# its wall budget, so this runs in the full suite only
+@pytest.mark.slow
 def test_text_cnn_learns_keywords():
     path = os.path.join(REPO, "example", "cnn_text_classification",
                         "text_cnn.py")

@@ -26,6 +26,12 @@ data loss).
 Entries written before the integrity layer (no ``files`` records) are
 checked for existence only and reported under ``unverified``.
 
+Sharded-native (format-2) entries are audited as a SHARD SET: every
+blob index 0..world-1 must carry a record and verify — an incomplete
+set or any damaged blob fails the epoch (one torn shard means the whole
+epoch must not promote); scan-rebuilt entries whose blobs carry no
+digests land under ``unverified`` (restorable, never promotable).
+
 PRE-RESUME PLAN GATE: ``--devices N`` [``--hbm BYTES``] additionally
 checks each entry's recorded sharding plan (``parallel/planner.py``,
 persisted by ``SPMDTrainer.save_checkpoint``) against that inventory —
@@ -168,6 +174,30 @@ def _check_entry(directory, entry):
             problems.append("%s: missing (no checksum record)" % name)
         else:
             unverified.append(name)
+    shard_set = entry.get("shard_set") or {}
+    if shard_set:
+        # sharded-native (format-2) entry: the whole epoch lives in the
+        # shard blobs — every index 0..world-1 must carry a record
+        # (digests verified below via ``files``), and a record-less
+        # blob (scan-rebuilt manifest) is existence-checked only
+        world = int(shard_set.get("world", 0))
+        recs = {}
+        for rec in shard_set.get("files", []):
+            recs[int(rec.get("shard", -1))] = rec
+        missing = [k for k in range(world) if k not in recs]
+        if world < 1 or missing:
+            problems.append(
+                "shard set incomplete: world=%d, missing shard "
+                "record(s) %s" % (world, missing or "all"))
+        for k in sorted(recs):
+            name = recs[k]["file"]
+            if name in files:
+                continue  # verified below with its record
+            if not os.path.exists(os.path.join(directory, name)):
+                problems.append("%s: missing (no checksum record)"
+                                % name)
+            else:
+                unverified.append(name)
     primary_ok = True
     for name in sorted(files):
         if not _check_file(directory, name, files[name], algo, problems):
@@ -223,7 +253,8 @@ def audit(directory, prefix="checkpoint", devices=None, hbm=None):
         return report
     if not os.path.exists(manifest_path):
         has_params = any(
-            n.startswith(prefix + "-") and n.endswith(".params")
+            n.startswith(prefix + "-") and
+            (n.endswith(".params") or ".params.s" in n)
             for n in os.listdir(directory))
         if has_params:
             report["ok"] = False
