@@ -2642,8 +2642,12 @@ def _analyze_bench():
     per-step collective count + bytes from the mxlint graph audit for
     the standard MLP (dp 'allreduce' — expect all-reduce only) and the
     same model under grad_sync='zero' (expect all-gather +
-    reduce-scatter by design), plus mxlint wall time over the package
-    against its < 10 s budget.  All host/CPU work."""
+    reduce-scatter by design), plus mxlint wall time over the full
+    default scope (package + tools + bench, ALL levels including the
+    whole-repo race/contract passes) against its < 5 s budget —
+    ``lint_wall_ms`` is gate-guarded LOWER-is-better so a quadratic
+    blow-up in a new repo-wide pass cannot land silently.  All host/CPU
+    work."""
     import subprocess as _sp
     import time as _time
 
@@ -2654,9 +2658,10 @@ def _analyze_bench():
                                                 "mxlint.py"), "-q"],
                   capture_output=True, text=True, timeout=120)
     out["mxlint_wall_s"] = round(_time.monotonic() - t0, 2)
+    out["lint_wall_ms"] = round(out["mxlint_wall_s"] * 1000.0, 1)
     out["mxlint_rc"] = res.returncode
     out["mxlint_budget_ok"] = bool(
-        res.returncode == 0 and out["mxlint_wall_s"] < 10.0)
+        res.returncode == 0 and out["mxlint_wall_s"] < 5.0)
 
     from mxnet_tpu.analysis import fixtures
 
@@ -3194,7 +3199,7 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "hotswap_drop_free", "hotswap_swap_ms",
              "region_drop_free", "region_goodput_chaos_frac",
              "region_freshness_ms",
-             "plan_decide_ms", "plan_step_ms")
+             "plan_decide_ms", "plan_step_ms", "lint_wall_ms")
 
 #: GATE_KEYS members where LOWER is better (latencies): the gate flags
 #: a RISE past tolerance instead of a drop — gating a latency with the
@@ -3203,7 +3208,8 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
 LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms", "plan_decide_ms",
                                   "plan_step_ms", "region_freshness_ms",
                                   "overdrive_tenant_p99_ms",
-                                  "ckpt_save_ms", "ckpt_peak_host_frac"))
+                                  "ckpt_save_ms", "ckpt_peak_host_frac",
+                                  "lint_wall_ms"))
 
 #: structurally-unmeasurable keys: each maps to a NOTE key whose
 #: presence (``flat_by_construction*`` on 1-core hosts — the decode
@@ -3537,7 +3543,8 @@ def main():
               "ckpt_restore_ms", "ckpt_peak_host_frac",
               "ckpt_peak_host_bytes", "ckpt_total_blob_bytes",
               "ckpt_sharded_parity",
-              "mxlint_wall_s", "mxlint_rc", "mxlint_budget_ok",
+              "mxlint_wall_s", "lint_wall_ms", "mxlint_rc",
+              "mxlint_budget_ok",
               "analyze_mlp_collectives", "analyze_zero_collectives",
               "analyze_findings"):
         if k in parts:

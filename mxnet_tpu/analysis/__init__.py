@@ -11,6 +11,11 @@ Two levels (the NNVM-graph-pass analog for this codebase):
 - :mod:`~mxnet_tpu.analysis.ast_lint` — AST rules over the source tree
   (traced-host calls in jitted fns, lock-order cycles, bare excepts,
   env-registry discipline).  ``tools/mxlint.py`` is the CLI.
+- level 3, cross-module: :mod:`~mxnet_tpu.analysis.race_lint` (shared
+  mutations across thread roots without a held lock, check-then-act)
+  and :mod:`~mxnet_tpu.analysis.contract_lint` (drift between the
+  producers and consumers of every declared cross-process JSON
+  surface).  Same CLI, same suppression syntax.
 
 See docs/how_to/static_analysis.md for the rule catalog and suppression
 syntax (``# mxlint: disable=<rule>``).
@@ -20,11 +25,14 @@ from __future__ import annotations
 from ..base import register_env
 from .report import Finding, Report, REPORT_VERSION
 from . import ast_lint
+from . import contract_lint
 from . import fixtures
 from . import graph_lint
+from . import race_lint
 
-__all__ = ["Finding", "Report", "REPORT_VERSION", "ast_lint", "fixtures",
-           "graph_lint", "ENV_ANALYZE", "ENV_ANALYZE_REPORT"]
+__all__ = ["Finding", "Report", "REPORT_VERSION", "ast_lint",
+           "contract_lint", "fixtures", "graph_lint", "race_lint",
+           "ENV_ANALYZE", "ENV_ANALYZE_REPORT"]
 
 ENV_ANALYZE = register_env(
     "MXTPU_ANALYZE",

@@ -37,8 +37,8 @@ import re
 from .report import Finding, Report
 
 __all__ = ["lint_paths", "collect_env_reads", "collect_registered",
-           "collect_fault_points", "iter_py_files", "RULES",
-           "ENV_PREFIXES"]
+           "collect_fault_points", "iter_py_files", "load_modules",
+           "RULES", "ENV_PREFIXES"]
 
 ENV_PREFIXES = ("MXTPU_", "MXNET_")
 
@@ -518,22 +518,52 @@ def _lint_bare_except(mod, report):
 # entry points
 # ---------------------------------------------------------------------------
 
-def _load_modules(paths):
+def _load_modules(paths, cache=None, overrides=None):
+    """Parse every .py file under ``paths`` into :class:`_Module`\\ s.
+
+    ``cache`` (``{abspath: _Module}``) is shared across the lint passes
+    so the CLI parses each file exactly once per run.  ``overrides``
+    maps paths to replacement SOURCE TEXT — the contract-lint regression
+    fixtures use it to lint a file "as if" an old bug were still there
+    without touching the tree.
+    """
     modules, broken = [], []
+    overrides = {os.path.abspath(p): src
+                 for p, src in (overrides or {}).items()}
     for path in iter_py_files(paths):
+        full = os.path.abspath(path)
+        if cache is not None and path in cache and full not in overrides:
+            modules.append(cache[path])
+            continue
         try:
+            if full in overrides:
+                modules.append(_Module(path, overrides[full]))
+                continue
             with open(path, "r", encoding="utf-8") as f:
-                modules.append(_Module(path, f.read()))
+                mod = _Module(path, f.read())
+            modules.append(mod)
+            if cache is not None:
+                cache[path] = mod
         except (OSError, SyntaxError) as e:
             broken.append((path, e))
     return modules, broken
 
 
-def collect_registered(paths):
+#: public names for the machinery the whole-repo passes (race_lint,
+#: contract_lint) build on — one parser, one suppression syntax, one
+#: lock model across every level
+load_modules = _load_modules
+Module = _Module
+LockScan = _LockScan
+collect_constants = _collect_constants
+resolve_const_string = _resolve_env_name
+
+
+def collect_registered(paths, cache=None):
     """Env names declared by ``register_env`` calls under ``paths`` —
     the purely static registry (what the CLI uses instead of importing
     the package)."""
-    modules, _ = _load_modules(paths)
+    modules, _ = _load_modules(paths, cache=cache)
     return _collect_constants(modules)[1]
 
 
@@ -577,7 +607,7 @@ def _param_string_defaults(node, name):
     return out
 
 
-def collect_fault_points(paths, arms=False):
+def collect_fault_points(paths, arms=False, cache=None):
     """``point -> [(file, line, via)]`` for every statically resolvable
     fault-injection site under ``paths`` — the mechanical registry that
     ``tools/mxlint.py --list-faults`` prints and the docs-sync test
@@ -592,7 +622,7 @@ def collect_fault_points(paths, arms=False):
     ``faults.arm``/``arm_hang`` call points — the test/tool side, used
     to catch typo'd armings that would silently never fire.
     """
-    modules, _ = _load_modules(paths)
+    modules, _ = _load_modules(paths, cache=cache)
     consts, _ = _collect_constants(modules)
     methods = _FAULT_ARMS if arms else _FAULT_READS
     out = {}
@@ -624,17 +654,19 @@ def collect_fault_points(paths, arms=False):
     return out
 
 
-def lint_paths(paths, env_registry=None, select=None):
+def lint_paths(paths, env_registry=None, select=None, cache=None):
     """Run every AST rule over ``paths`` (files or directories).
 
     ``env_registry``: extra registered env names to union with the
     ``register_env`` calls found statically in the scanned tree (pass
     ``set(mxnet_tpu.base.ENV_REGISTRY)`` when linting files outside the
     package, e.g. tools/).  ``select``: restrict to a subset of RULES.
+    ``cache``: shared ``{path: _Module}`` parse cache (see
+    :func:`load_modules`).
     """
     rules = set(RULES if select is None else select)
     report = Report(tool="mxlint.ast")
-    modules, broken = _load_modules(paths)
+    modules, broken = _load_modules(paths, cache=cache)
     report.files_scanned = len(modules)
     for path, err in broken:
         report.add("parse-error", "cannot parse: %s" % (err,), file=path)

@@ -120,6 +120,10 @@ class Autoscaler(object):
         self.drain_wait_s = float(drain_wait_s)
         self.signal_fn = signal_fn
         self._log = log or (lambda msg: None)
+        # guards counters and the hysteresis streaks: tick() runs on
+        # the autoscale thread, but tests and operators call it (and
+        # stats()) from the main thread too
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         self._high_streak = 0
@@ -168,36 +172,40 @@ class Autoscaler(object):
         """One synchronous policy evaluation (the loop body; also the
         test surface).  Returns the action taken: ``"up"``, ``"down"``
         or ``None``."""
-        self.counters["ticks"] += 1
         sig = self.signal_fn() if self.signal_fn is not None \
             else self._pressure_ms()
         self._last_signal = sig
-        if sig >= self.high_ms:
-            self._high_streak += 1
-            self._low_streak = 0
-        elif sig <= self.low_ms:
-            self._low_streak += 1
-            self._high_streak = 0
-        else:
-            # the hysteresis band: no pressure either way
-            self._high_streak = 0
-            self._low_streak = 0
-        want_up = self._high_streak >= self.up_after
-        want_down = self._low_streak >= self.down_after
+        with self._lock:
+            self.counters["ticks"] += 1
+            if sig >= self.high_ms:
+                self._high_streak += 1
+                self._low_streak = 0
+            elif sig <= self.low_ms:
+                self._low_streak += 1
+                self._high_streak = 0
+            else:
+                # the hysteresis band: no pressure either way
+                self._high_streak = 0
+                self._low_streak = 0
+            want_up = self._high_streak >= self.up_after
+            want_down = self._low_streak >= self.down_after
         if not (want_up or want_down):
             return None
         now = time.monotonic()
         if self._last_action_at is not None and \
                 now - self._last_action_at < self.cooldown_s:
-            self.counters["blocked_cooldown"] += 1
+            with self._lock:
+                self.counters["blocked_cooldown"] += 1
             return None
         if want_up:
             if len(self._live()) >= self.max_replicas:
-                self.counters["blocked_max"] += 1
+                with self._lock:
+                    self.counters["blocked_max"] += 1
                 return None
             return self._scale_up(sig)
         if len(self._live()) <= self.min_replicas:
-            self.counters["blocked_min"] += 1
+            with self._lock:
+                self.counters["blocked_min"] += 1
             return None
         return self._scale_down(sig)
 
@@ -205,12 +213,14 @@ class Autoscaler(object):
         try:
             rep = self.controller.add_replica()
         except MXNetError as e:     # draining — the fleet is going away
-            self.counters["errors"] += 1
+            with self._lock:
+                self.counters["errors"] += 1
             self._log("autoscale: scale-up refused (%s)" % (e,))
             return None
-        self.counters["scale_ups"] += 1
+        with self._lock:
+            self.counters["scale_ups"] += 1
+            self._high_streak = 0
         self._last_action_at = time.monotonic()
-        self._high_streak = 0
         self._log("autoscale: UP -> replica %d (signal %.1fms >= "
                   "%.1fms)" % (rep.id, sig, self.high_ms))
         return "up"
@@ -227,7 +237,8 @@ class Autoscaler(object):
         except MXNetError:
             # fencing would leave no routable replica — the N-1 floor
             # outranks the low watermark, always
-            self.counters["blocked_floor"] += 1
+            with self._lock:
+                self.counters["blocked_floor"] += 1
             return None
         try:
             self._publish()         # workers stop routing to rid
@@ -236,7 +247,8 @@ class Autoscaler(object):
             self._wait_drained(rid)
             self.controller.stop_replica(rid)
         except Exception as e:  # noqa: BLE001 — unwind, keep serving
-            self.counters["errors"] += 1
+            with self._lock:
+                self.counters["errors"] += 1
             self._log("autoscale: scale-down of %d failed (%s: %s) — "
                       "unfenced" % (rid, type(e).__name__, e))
             self.router.unfence(rid)
@@ -244,9 +256,10 @@ class Autoscaler(object):
             return None
         self.router.unfence(rid)    # the id is gone; don't leak a fence
         self._publish()
-        self.counters["scale_downs"] += 1
+        with self._lock:
+            self.counters["scale_downs"] += 1
+            self._low_streak = 0
         self._last_action_at = time.monotonic()
-        self._low_streak = 0
         self._log("autoscale: DOWN -> replica %d retired (signal "
                   "%.1fms <= %.1fms)" % (rid, sig, self.low_ms))
         return "down"
@@ -282,7 +295,8 @@ class Autoscaler(object):
             try:
                 self.tick()
             except Exception:  # noqa: BLE001 — the loop must survive
-                self.counters["errors"] += 1
+                with self._lock:
+                    self.counters["errors"] += 1
 
     def stop(self):
         self._stop.set()
@@ -291,11 +305,12 @@ class Autoscaler(object):
         return self
 
     def stats(self):
-        out = dict(self.counters)
+        with self._lock:
+            out = dict(self.counters)
+            out.update({"high_streak": self._high_streak,
+                        "low_streak": self._low_streak})
         out.update({"live": len(self._live()),
                     "min": self.min_replicas, "max": self.max_replicas,
                     "high_ms": self.high_ms, "low_ms": self.low_ms,
-                    "last_signal_ms": self._last_signal,
-                    "high_streak": self._high_streak,
-                    "low_streak": self._low_streak})
+                    "last_signal_ms": self._last_signal})
         return out

@@ -131,7 +131,8 @@ class RollingSwap(object):
     def check_once(self):
         """One poll over every watched model; returns the outcomes
         (``{model: action}``)."""
-        self.counters["polls"] += 1
+        with self._lock:
+            self.counters["polls"] += 1
         out = {}
         for model, directory in self.models.items():
             out[model] = self._check_model(model, directory)

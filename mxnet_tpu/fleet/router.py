@@ -296,7 +296,8 @@ class FleetRouter(object):
         addrs = self._addresses()
         misses = []
         for rid, view in list(self._views.items()):
-            view.probes += 1
+            with self._lock:
+                view.probes += 1
             addr = addrs.get(rid)
             if addr is None:
                 continue            # no port file yet (spawning)
@@ -307,7 +308,8 @@ class FleetRouter(object):
                 time.sleep(random.uniform(
                     0.0, min(self.PROBE_RETRY_JITTER_S,
                              self.heartbeat_s / 4.0)))
-                view.probe_retries += 1
+                with self._lock:
+                    view.probe_retries += 1
                 self._probe_one(view, addr)
 
             threads = [threading.Thread(target=_retry, args=m,

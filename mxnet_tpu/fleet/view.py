@@ -138,6 +138,11 @@ class FleetViewPublisher(object):
         self.generation = 0
         self.publishes = 0
         self.publish_errors = 0
+        # serializes publish_once: the publish loop owns the cadence,
+        # but the autoscaler (and tests) call publish_once directly to
+        # push a fence out early — two interleaved passes would race
+        # the generation bump and could write snapshots out of order
+        self._lock = threading.Lock()
         self._log = log or (lambda msg: None)
         self._stop = threading.Event()
         self._thread = None
@@ -148,19 +153,20 @@ class FleetViewPublisher(object):
         from ..resilience import atomic_write
         if probe:
             self.router.probe()
-        self.generation += 1
-        doc = {"generation": self.generation,
-               "published_at": time.time(),
-               "heartbeat_s": self.router.heartbeat_s,
-               "evict_s": self.router.evict_s,
-               "replicas": self.router.view_export(),
-               "fenced": list(self.router.fenced()),
-               "models": self.router.manifest.names()}
-        if self.router.deploy is not None:
-            doc["rollout"] = self.router.deploy.stats()
-        atomic_write(self.path, json.dumps(doc).encode("utf-8"),
-                     fault_point="view_publish")
-        self.publishes += 1
+        with self._lock:
+            self.generation += 1
+            doc = {"generation": self.generation,
+                   "published_at": time.time(),
+                   "heartbeat_s": self.router.heartbeat_s,
+                   "evict_s": self.router.evict_s,
+                   "replicas": self.router.view_export(),
+                   "fenced": list(self.router.fenced()),
+                   "models": self.router.manifest.names()}
+            if self.router.deploy is not None:
+                doc["rollout"] = self.router.deploy.stats()
+            atomic_write(self.path, json.dumps(doc).encode("utf-8"),
+                         fault_point="view_publish")
+            self.publishes += 1
         return doc
 
     def _loop(self):
@@ -168,7 +174,8 @@ class FleetViewPublisher(object):
             try:
                 self.publish_once()
             except Exception as e:  # noqa: BLE001 — the loop must survive
-                self.publish_errors += 1
+                with self._lock:
+                    self.publish_errors += 1
                 self._log("fleet view: publish failed (%s: %s)"
                           % (type(e).__name__, e))
 

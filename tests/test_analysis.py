@@ -1027,3 +1027,173 @@ def test_env_analyze_strict_accepts_real_zero3(monkeypatch):
         t.step(*batch())
     finally:
         t.close()
+
+
+# ---------------------------------------------------------------------------
+# Level 3 — cross-module lint (race + wire-contract), fixtures + the
+# repo-wide zero-findings gate + the PR 18 regression
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.analysis import contract_lint, race_lint
+from mxnet_tpu.analysis import fixtures as l3fx
+
+
+def _default_scope():
+    """The CLI's zero-carve-out default: package + tools + bench."""
+    return [PKG, os.path.join(REPO, "tools"),
+            os.path.join(REPO, "bench.py")]
+
+
+def test_repo_race_lint_zero_findings():
+    rep = race_lint.lint_paths(_default_scope())
+    assert rep.ok, rep.format_text()
+
+
+def test_repo_contract_lint_zero_findings():
+    rep = contract_lint.lint_paths(_default_scope())
+    assert rep.ok, rep.format_text()
+
+
+def _race_snippet(tmp_path, source):
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    return race_lint.lint_paths([str(p)])
+
+
+def test_race_unguarded_mutation_flagged(tmp_path):
+    rep = _race_snippet(tmp_path, l3fx.RACE_UNGUARDED_SRC)
+    assert rules_of(rep) == ["repo-shared-mutation"]
+    # both sides of the race are findings: the thread's and the main
+    # path's
+    assert len(rep.findings) == 2, rep.format_text()
+
+
+def test_race_guarded_mutation_clean(tmp_path):
+    rep = _race_snippet(tmp_path, l3fx.RACE_GUARDED_SRC)
+    assert rep.ok, rep.format_text()
+
+
+def test_race_check_then_act_flagged(tmp_path):
+    rep = _race_snippet(tmp_path, l3fx.RACE_CHECK_THEN_ACT_SRC)
+    assert rules_of(rep) == ["repo-check-then-act"], rep.format_text()
+
+
+def test_race_suppression_honored(tmp_path):
+    rep = _race_snippet(tmp_path, l3fx.RACE_SUPPRESSED_SRC)
+    assert rep.ok, rep.format_text()
+
+
+def test_contract_drift_fixture_both_directions(tmp_path):
+    p = tmp_path / "wire.py"
+    p.write_text(l3fx.CONTRACT_DRIFT_SRC)
+    surface = l3fx.contract_fixture_surface(contract_lint, "wire.py")
+    mods, broken = ast_lint.load_modules([str(p)])
+    assert not broken
+    rep = contract_lint.lint_modules(mods, surfaces=[surface])
+    assert rules_of(rep) == ["wire-contract-drift"]
+    assert sorted(f.severity for f in rep.findings) == \
+        ["error", "warning"], rep.format_text()
+    # consumer-read-never-produced (the PR 18 shape) is the ERROR ...
+    assert any(f.severity == "error" and "'c'" in f.message
+               for f in rep.findings), rep.format_text()
+    # ... dead wire weight is the warning
+    assert any(f.severity == "warning" and "'b'" in f.message
+               for f in rep.findings), rep.format_text()
+
+
+def test_contract_aligned_fixture_clean(tmp_path):
+    p = tmp_path / "wire.py"
+    p.write_text(l3fx.CONTRACT_CLEAN_SRC)
+    surface = l3fx.contract_fixture_surface(contract_lint, "wire.py")
+    mods, _broken = ast_lint.load_modules([str(p)])
+    rep = contract_lint.lint_modules(mods, surfaces=[surface])
+    assert rep.ok, rep.format_text()
+
+
+def test_pr18_view_export_regression():
+    """THE acceptance criterion: reverting PR 18's view_export
+    supervision-fields fix turns wire-contract-drift red (one
+    consumer-read-never-produced error per dropped key), while the
+    shipped tree stays green."""
+    scope = _default_scope()
+    clean = contract_lint.lint_paths(scope)
+    assert clean.ok, clean.format_text()
+    rep = contract_lint.lint_paths(
+        scope, overrides=l3fx.pr18_broken_router_source())
+    errors = [f for f in rep.findings
+              if f.rule == "wire-contract-drift"]
+    assert len(errors) == len(l3fx.PR18_SUPERVISION_KEYS), \
+        rep.format_text()
+    assert all(f.severity == "error" for f in errors)
+    assert all(f.file.endswith("router.py") for f in errors)
+    for key in l3fx.PR18_SUPERVISION_KEYS:
+        assert any("'%s'" % key in f.message for f in errors), key
+
+
+def test_level3_suppressions_carry_justification():
+    """Every inline suppression of a level-3 rule must sit next to a
+    real justification comment — a bare directive is a carve-out, not
+    an explanation (the escape hatch the tree-wide gate allows)."""
+    directive = re.compile(r"mxlint:\s*disable=(repo|wire)-")
+    bad = []
+    for path in _scope_py_files():
+        lines = open(path).read().splitlines()
+        for i, line in enumerate(lines):
+            if not directive.search(line):
+                continue
+            context = lines[max(0, i - 6):i] + \
+                [line.split("# mxlint:")[0]]
+            justified = any(
+                "#" in c and "mxlint:" not in c and
+                len(c.split("#", 1)[1].split()) >= 3
+                for c in context)
+            if not justified:
+                bad.append("%s:%d" % (os.path.relpath(path, REPO),
+                                      i + 1))
+    assert not bad, "unjustified level-3 suppressions: %s" % bad
+
+
+def _scope_py_files():
+    for root_dir in _default_scope():
+        if os.path.isfile(root_dir):
+            yield root_dir
+            continue
+        for dirpath, _dirs, files in os.walk(root_dir):
+            for name in files:
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def test_level3_rules_documented():
+    doc = open(os.path.join(REPO, "docs", "how_to",
+                            "static_analysis.md")).read()
+    for rule in tuple(race_lint.RULES) + tuple(contract_lint.RULES):
+        assert "`%s`" % rule in doc, \
+            "rule %s missing from static_analysis.md" % rule
+
+
+def test_mxlint_cli_changed_falls_back_on_bad_ref(tmp_path):
+    """--changed with an unresolvable ref (the not-a-git-checkout
+    shape) falls back to the FULL tree rather than linting nothing."""
+    out = tmp_path / "report.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+         "--changed", "no-such-ref-xyz", "--json", str(out), "-q"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(out.read_text())
+    assert payload["files_scanned"] > 50
+
+
+def test_mxlint_cli_changed_scopes_to_diff(tmp_path):
+    """--changed HEAD lints at most the dirty files (usually far fewer
+    than the tree; exit code still reflects findings in them)."""
+    out = tmp_path / "report.json"
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+         "--changed", "--json", str(out), "-q"],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode in (0, 1), res.stdout + res.stderr
+    payload = json.loads(out.read_text())
+    full = len(list(_scope_py_files()))
+    assert payload["files_scanned"] <= full

@@ -854,3 +854,23 @@ def test_ckpt_mode_is_known_and_in_the_pipeline_set():
     with open(os.path.join(REPO, "bench.py")) as f:
         src = f.read()
     assert '_collect("ckpt")' in src
+
+
+def test_gate_keys_cover_lint_wall(tmp_path):
+    """Satellite: the analyzer's own full-tree wall time is
+    gate-guarded as a LOWER-is-better latency — a quadratic blow-up in
+    a whole-repo lint pass blocks, a speed-up passes."""
+    assert "lint_wall_ms" in bench.GATE_KEYS
+    assert "lint_wall_ms" in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, lint_wall_ms=4000.0)
+    # 50% faster lint PASSES
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, lint_wall_ms=2000.0)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert rep["pass"], rep
+    # 50% slower lint BLOCKS
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, lint_wall_ms=6000.0)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "lint_wall_ms"

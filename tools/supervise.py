@@ -175,7 +175,8 @@ class Supervisor(object):
                 self._log("supervise[%s]: %s — giving up (rc %d)"
                           % (self.role, why, rc))
                 return
-            self.restarts += 1
+            with self._lock:
+                self.restarts += 1
             self._log("supervise[%s]: %s — relaunch %d/%d with %s=1"
                       % (self.role, why, self.restarts,
                          self.max_restarts, RESUME_ENV))
