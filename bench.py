@@ -2048,8 +2048,11 @@ def _region_bench(timeout=420):
     - ``region_drop_free`` — 1.0 iff ZERO client requests were dropped
       or errored across the drill (the storm-grade contract).
     - ``region_goodput_chaos_frac`` — fraction of client requests that
-      succeeded on the FIRST attempt (a fail-once 502 the client had to
-      retry counts against goodput even though nothing was dropped).
+      succeeded on the FIRST client attempt.  With exactly-once
+      routing the router absorbs dead replicas by keyed resend, so a
+      client-side retry (a 502 that leaked through) counts against
+      goodput AND should be zero — the storm report carries
+      ``client_retries`` as its own top-level number.
     - ``region_freshness_ms`` — end-to-end publish->served freshness:
       wall-clock from the trainer's manifest publish to the watcher's
       committed swap, fleet-wide worst case (lower is better).
@@ -2138,12 +2141,14 @@ def _fleet_warm_run(specs, buckets, cache_dir, timeout=600):
 
 
 def _fleet_up(specs, buckets, store, run_dir, replicas, extra_env=None,
-              timeout=600, workers=None, autoscale=False):
+              timeout=600, workers=None, autoscale=False,
+              replica_env=None):
     """Boot a fleet (router + ``replicas`` daemons) on an ephemeral
     port; returns ``(proc, port)`` once the port file appears.
     ``workers`` > 1 shards the front end into reuseport router workers;
     ``autoscale`` closes the replica-count loop (both: the overdrive
-    mode)."""
+    mode); ``replica_env`` is a list of ``RID:NAME=VALUE`` overrides
+    for single replicas (the tail mode arms ONE gray replica with it)."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2157,6 +2162,8 @@ def _fleet_up(specs, buckets, store, run_dir, replicas, extra_env=None,
         cmd += ["--workers", str(workers)]
     if autoscale:
         cmd += ["--autoscale"]
+    for spec in (replica_env or ()):
+        cmd += ["--replica-env", spec]
     for name, (prefix, epoch, sample) in specs.items():
         cmd += ["--model", "%s=%s:%d" % (name, prefix, epoch),
                 "--input-shape",
@@ -2326,6 +2333,119 @@ def _fleet_bench(seconds=2.5):
         if proc is not None and proc.poll() is None:
             proc.kill()
             proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _tail_bench(requests=60):
+    """The ``bench.py tail`` mode (docs/how_to/fleet.md): hedged tail
+    latency against a GRAY replica, measured, not assumed.
+
+    Two 3-replica fleets, replica 0 armed with the ``slow_replica``
+    fault (every request it serves stalls ~250 ms — a sick host whose
+    probes stay fast).  A single sequential client routes to the
+    least-loaded replica with the lowest-rid tie-break, so on an idle
+    fleet EVERY request primary-routes to the gray replica — the worst
+    case hedging exists for:
+
+    - ``tail_unhedged_p99_ms`` — hedging off: the client eats the
+      stall (the fail-once baseline this PR retires).
+    - ``tail_p99_ms`` — hedging on (``MXTPU_FLEET_HEDGE_PCT=95``,
+      floor 25 ms): the backup to the next-least-loaded replica
+      answers first; the stalled primary is the race's counted loser
+      (``hedge_wasted``).  GATE key, lower is better.
+    - ``tail_drop_free`` — 1.0 iff ZERO non-200s across both windows
+      and both fleets drained to rc 0: hedging must never trade
+      correctness for latency.
+    """
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    from mxnet_tpu.serving import ServeClient
+
+    buckets = "1,2,4,8"
+    tmp = tempfile.mkdtemp(prefix="bench_tail_")
+    out = {}
+    try:
+        specs = _save_serving_models(tmp)
+        specs = {"mlp": specs["mlp"]}       # cheap model: the stall,
+        store = os.path.join(tmp, "warm_store")  # not compute, is the tail
+        os.makedirs(store)
+        from mxnet_tpu.fleet import build_warm_store
+        build_warm_store(_fleet_manifest(specs, buckets), store)
+        rs = np.random.RandomState(11)
+        x = rs.rand(*specs["mlp"][2]).astype("f")
+
+        def window(run_dir, hedge):
+            env = {
+                "MXTPU_FLEET_HEARTBEAT_S": "0.25",
+                "MXTPU_SERVE_MAX_WAIT_MS": "1",
+                "MXTPU_FLEET_HEDGE_PCT": "95" if hedge else "0",
+                "MXTPU_FLEET_HEDGE_MIN_MS": "25",
+            }
+            # arm far more stalls than the window sends: replica 0
+            # stays gray for the WHOLE window, never exhausts mid-run
+            fproc, port = _fleet_up(
+                specs, buckets, store, run_dir, 3, extra_env=env,
+                replica_env=["0:MXTPU_FAULTS=slow_replica:%d"
+                             % (requests * 10)])
+            try:
+                lats, errors = [], 0
+                cli = ServeClient("127.0.0.1", port, timeout=30)
+                try:
+                    # unmeasured warmup: first-touch costs (backup
+                    # replica's batcher spin-up, conn setup, hedge
+                    # thread machinery) would otherwise BE the p99 of
+                    # a sequential window
+                    for _ in range(3):
+                        cli.predict("mlp", x, npy=True)
+                    for _ in range(requests):
+                        tic = time.perf_counter()
+                        try:
+                            status, _ = cli.predict("mlp", x, npy=True)
+                        except Exception:  # noqa: BLE001 — dropped
+                            status = -1
+                        dt = (time.perf_counter() - tic) * 1e3
+                        if status == 200:
+                            lats.append(dt)
+                        else:
+                            errors += 1
+                    status, stats = cli.stats()
+                    counters = (stats["router"]["counters"]
+                                if status == 200 else {})
+                finally:
+                    cli.close()
+                fproc.send_signal(_signal.SIGTERM)
+                rc = fproc.wait(timeout=90)
+            finally:
+                if fproc.poll() is None:
+                    fproc.kill()
+                    fproc.wait(timeout=30)
+            return lats, errors, counters, rc
+
+        from mxnet_tpu.serving.frontend import _percentile
+        cold, errs_u, _, rc_u = window(os.path.join(tmp, "run_u"),
+                                       hedge=False)
+        hedged, errs_h, counters, rc_h = window(
+            os.path.join(tmp, "run_h"), hedge=True)
+        if cold:
+            out["tail_unhedged_p99_ms"] = round(
+                _percentile(sorted(cold), 99), 3)
+        if hedged:
+            out["tail_p99_ms"] = round(
+                _percentile(sorted(hedged), 99), 3)
+        if cold and hedged:
+            out["tail_hedge_won"] = bool(
+                out["tail_p99_ms"] < out["tail_unhedged_p99_ms"])
+        out["tail_hedges"] = counters.get("hedges", 0)
+        out["tail_hedge_wasted"] = counters.get("hedge_wasted", 0)
+        out["tail_errors"] = errs_u + errs_h
+        out["tail_drop_free"] = 1.0 if (
+            errs_u == 0 and errs_h == 0 and rc_u == 0 and rc_h == 0
+            and len(cold) == requests and len(hedged) == requests
+        ) else 0.0
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out
 
@@ -3006,7 +3126,7 @@ def _run_mode(mode):
         mode = "data-net"
     if mode in ("decode", "fed-cpu", "pipeline", "compile-probe",
                 "resume", "checkpoint", "ckpt", "analyze", "serve",
-                "fleet", "overdrive", "hotswap", "data-service",
+                "fleet", "tail", "overdrive", "hotswap", "data-service",
                 "data-net", "roofline", "zero3", "plan"):
         # host-side metrics: force the CPU backend BEFORE any jax client
         # exists — the axon plugin otherwise wins over JAX_PLATFORMS and
@@ -3032,6 +3152,8 @@ def _run_mode(mode):
         out.update(_serve_bench())
     elif mode == "fleet":
         out.update(_fleet_bench())
+    elif mode == "tail":
+        out.update(_tail_bench())
     elif mode == "overdrive":
         out.update(_overdrive_bench())
     elif mode == "region":
@@ -3109,7 +3231,8 @@ def _run_mode(mode):
 KNOWN_MODES = frozenset((
     "decode", "data-service", "data_service", "data-net", "data_net",
     "fed-cpu", "pipeline", "compile-probe", "resume", "checkpoint",
-    "ckpt", "analyze", "serve", "fleet", "overdrive", "hotswap", "region",
+    "ckpt", "analyze", "serve", "fleet", "tail", "overdrive", "hotswap",
+    "region",
     "roofline", "zero3",
     "plan", "fed", "compute",
     "compute-large", "inception-bn", "resnet-152", "lstm",
@@ -3194,6 +3317,7 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
              "inception_gap_frac",
              "zero3_steps_s", "zero3_param_shard_x", "zero3_wide_mem_x",
              "fleet_qps_x", "fleet_warm_start_x", "fleet_route_eff",
+             "tail_p99_ms", "tail_drop_free",
              "overdrive_qps", "overdrive_qps_x",
              "overdrive_tenant_p99_ms", "overdrive_drop_free",
              "hotswap_drop_free", "hotswap_swap_ms",
@@ -3206,6 +3330,7 @@ GATE_KEYS = ("value", "compute_img_s", "compute_large_img_s",
 #: higher-is-better rule would fail every improvement and bless every
 #: regression
 LOWER_IS_BETTER_KEYS = frozenset(("hotswap_swap_ms", "plan_decide_ms",
+                                  "tail_p99_ms",
                                   "plan_step_ms", "region_freshness_ms",
                                   "overdrive_tenant_p99_ms",
                                   "ckpt_save_ms", "ckpt_peak_host_frac",
@@ -3455,6 +3580,7 @@ def main():
         parts.update(_collect("serve"))
         parts.update(_collect("hotswap"))
         parts.update(_collect("fleet", timeout=600))
+        parts.update(_collect("tail", timeout=600))
         # the sharded front end: reuseport worker scaling, tenant
         # isolation under flood, the drop-free autoscale round trip
         parts.update(_collect("overdrive", timeout=600))

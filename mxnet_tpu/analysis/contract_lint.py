@@ -608,8 +608,11 @@ def repo_registry():
                        (T_SERVE, "*"), (T_FLEET, "*"),
                        ("bench.py", "*")],
             # batches.avg_ms is a human gauge next to the machine-read
-            # fill_ratio/count fields
-            unread_ok=("avg_ms",),
+            # fill_ratio/count fields; p99_recent travels on the
+            # router's OWN snapshot only because the one snapshot shape
+            # serves both tiers — its machine reader (the outlier
+            # detector) consumes it from replica /stats, not here
+            unread_ok=("avg_ms", "p99_recent"),
         ),
         Surface(
             "replica-stats",
@@ -624,6 +627,9 @@ def repo_registry():
                         "CheckpointWatcher.__init__")],
             consumers=[(R, "FleetRouter.stats_payload"),
                        (R, "FleetRouter._load"),
+                       (R, "FleetRouter._update_outliers"),
+                       (R, "FleetRouter.pressure_ms"),
+                       (R, "FleetRouter._flooder_tenant"),
                        ("mxnet_tpu/fleet/autoscale.py",
                         "Autoscaler._pressure_ms"),
                        ("mxnet_tpu/fleet/deploy.py",
@@ -656,11 +662,14 @@ def repo_registry():
                        ("bench.py", "*")],
             # the per-replica table and view block are the operator's
             # triage surface (why is this replica slow/evicted/dead);
-            # machine consumers key off healthy/epochs/restarts instead
+            # machine consumers key off healthy/epochs/restarts instead.
+            # the brownout block (slo_ms/pressure_ms next to the
+            # machine-read `active` bit) shows an operator how close
+            # the fleet is to shedding — and WHY it already is
             unread_ok=("age_s", "draining", "est_wait_ms",
                        "forward_errors", "heartbeat_age_s", "inflight",
                        "last_rc", "probe_retries", "read_errors",
-                       "replicas_total"),
+                       "replicas_total", "pressure_ms", "slo_ms"),
         ),
         Surface(
             "fleet-manifest",

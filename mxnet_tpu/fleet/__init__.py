@@ -18,7 +18,9 @@ daemons into one serving system:
   route-by-model to the home replica, SPILL to the least-loaded
   replica when the home's queue/SLO signal crosses the bar (the
   ``/stats`` surface PR 6 built is the routing input), heartbeat-age
-  eviction off ``/healthz``, fail-once-never-retry on a dead replica,
+  eviction off ``/healthz``, exactly-once keyed retry on a dead
+  replica (one resend to a different healthy replica, same request
+  id — replica dedup + bucket bit-stability make it safe),
   SIGTERM drain that fences new work then drains every replica, and
   fleet-level p50/p99/shed aggregation on ``/stats``.
 - :mod:`.warm` — the AOT warm store: pre-compile every (model, bucket)

@@ -140,18 +140,11 @@ class Autoscaler(object):
         """Mean over healthy replicas of each one's worst per-model
         ``est_wait_ms``.  Mean, not max: one replica's spike is the
         SPILL policy's problem (move the traffic); the autoscaler acts
-        when the fleet as a whole is behind."""
-        healthy = self.router.healthy()
-        if not healthy:
-            return 0.0
-        worst = []
-        with self.router._lock:
-            for rid in healthy:
-                view = self.router._views.get(rid)
-                est = ((view.stats or {}).get("est_wait_ms") or {}) \
-                    if view is not None else {}
-                worst.append(max(est.values()) if est else 0.0)
-        return sum(worst) / len(worst)
+        when the fleet as a whole is behind.  Delegates to
+        ``FleetRouter.pressure_ms`` — the SAME aggregation the brownout
+        admission gate sheds on, so adding capacity and shedding load
+        react to one number instead of fighting each other."""
+        return self.router.pressure_ms()
 
     def _live(self):
         """Replicas that count toward capacity bounds: everything the

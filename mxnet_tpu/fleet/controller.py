@@ -78,7 +78,7 @@ class ReplicaController(object):
     def __init__(self, manifest, run_dir, serve_py=None, python=None,
                  warm_store=None, max_restarts=3, backoff=0.5,
                  stable_s=30.0, cpu_affinity=None, extra_env=None,
-                 log=None):
+                 extra_env_by_rid=None, log=None):
         self.manifest = manifest
         self.run_dir = run_dir
         self.serve_py = serve_py or default_serve_py()
@@ -88,6 +88,11 @@ class ReplicaController(object):
         self.backoff = float(backoff)
         self.stable_s = float(stable_s)
         self.extra_env = dict(extra_env or {})
+        #: {rid: {NAME: VALUE}} — per-replica env on top of extra_env;
+        #: how a drill arms a fault (e.g. MXTPU_FAULTS=slow_replica:N)
+        #: on exactly ONE replica of the fleet
+        self.extra_env_by_rid = {int(k): dict(v) for k, v
+                                 in (extra_env_by_rid or {}).items()}
         self._log = log or (lambda msg: None)
         self._lock = threading.Lock()
         self._draining = False
@@ -114,6 +119,7 @@ class ReplicaController(object):
         env = dict(os.environ)
         env.update(replica_device_env(self.manifest.device_sets, rid))
         env.update(self.extra_env)
+        env.update(self.extra_env_by_rid.get(rid, {}))
         if self.warm_store:
             env["MXTPU_COMPILE_CACHE"] = self.warm_store
         return Replica(rid, argv, env, port_file, log_path,

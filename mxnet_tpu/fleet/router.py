@@ -20,15 +20,22 @@ the replicas own weights and batching.  Policy per request:
   from routing until it answers again (a respawned replica rejoins the
   moment its new port file appears and a probe succeeds).
 
-IDEMPOTENCY STANCE: a request in flight to a replica that dies fails
-ONCE, visibly, with HTTP 502 — the router NEVER resends it.  The body
-may have reached the dead replica's batcher and been dispatched; a
-blind resend would execute a non-idempotent predict twice (double
-stats, two bucket slots, and for any side-effectful consumer a real
-double-fire).  Retry is the CLIENT's decision, who knows whether its
-request is idempotent.  NEW traffic reroutes immediately (the dead
-replica stops being routable on eviction, and every forwarding error
-biases the next route away from it).
+EXACTLY-ONCE STANCE (supersedes the PR 11 fail-once rule): a predict
+in flight to a replica that dies is resent ONCE to a different healthy
+replica with the SAME idempotency key (``X-MXTPU-Request-Id``) — safe
+because (a) each replica's dedup cache collapses a duplicate onto the
+original execution, and (b) even on a dedup miss the batcher's
+bit-exactness contract makes re-execution of the same bytes
+bit-identical (serving/batcher.py).  A retried success carries
+``"retried": true``; only when NO other healthy replica exists (or the
+resend also dies) does the client see a 502.  Tail defense rides the
+same key: a request older than an adaptive latency percentile is
+HEDGED to the next-least-loaded replica (MXTPU_FLEET_HEDGE_PCT), first
+answer wins, and under brownout (aggregate est_wait past
+MXTPU_FLEET_BROWNOUT_MS) the router sheds low-priority/over-quota
+work with Retry-After 429s before queues build.  ``POST /swap`` keeps
+the never-retried stance — a swap is not keyed and genuinely not
+idempotent (fleet/deploy.py).
 
 Shutdown: SIGTERM fences new work (503 on the public port), waits for
 the router's in-flight forwards, then forwards the drain to every
@@ -41,6 +48,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import queue
 import signal
 import socket
 import threading
@@ -48,12 +56,14 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..base import MXNetError, get_env, register_env
+from ..resilience import faults
 from ..serving.frontend import Stats
-from .view import FleetViewReader, worker_stats_path
+from .view import FleetViewReader, OutlierDetector, worker_stats_path
 
 __all__ = ["FleetRouter", "NoHealthyReplica", "ReplicaDead",
            "ENV_FLEET_SPILL_QUEUE", "ENV_FLEET_HEARTBEAT_S",
-           "ENV_FLEET_EVICT_S"]
+           "ENV_FLEET_EVICT_S", "ENV_FLEET_HEDGE_PCT",
+           "ENV_FLEET_HEDGE_MIN_MS", "ENV_FLEET_BROWNOUT_MS"]
 
 ENV_FLEET_SPILL_QUEUE = register_env(
     "MXTPU_FLEET_SPILL_QUEUE", default=8,
@@ -70,6 +80,31 @@ ENV_FLEET_EVICT_S = register_env(
     doc="Heartbeat age beyond which a replica is evicted from routing "
         "(it rejoins on the next successful probe — e.g. after the "
         "controller respawned it warm from the AOT store)")
+ENV_FLEET_HEDGE_PCT = register_env(
+    "MXTPU_FLEET_HEDGE_PCT", default=0.0,
+    doc="Hedged requests: a forward older than this percentile of "
+        "recent router-observed latency gets a backup sent to the "
+        "next-least-loaded replica with the same idempotency key, "
+        "first answer wins (losers count `hedge_wasted`); 0 disables "
+        "hedging (it is also gated off with <2 routable replicas or "
+        "in brownout)")
+ENV_FLEET_HEDGE_MIN_MS = register_env(
+    "MXTPU_FLEET_HEDGE_MIN_MS", default=25.0,
+    doc="Floor on the adaptive hedge trigger: never hedge a request "
+        "younger than this many ms, whatever the latency percentile "
+        "says (bounds duplicate-execution cost at low latency)")
+ENV_FLEET_BROWNOUT_MS = register_env(
+    "MXTPU_FLEET_BROWNOUT_MS", default=0.0,
+    doc="Brownout admission control: when the fleet's aggregate "
+        "est_wait_ms (the autoscaler's pressure signal) exceeds this, "
+        "router workers shed priority<=0 and over-quota-tenant work "
+        "with Retry-After 429s BEFORE queues build; 0 disables")
+
+#: fault point: after a delivered forward, the router re-sends the
+#: SAME request (same body, same idempotency key) once more — the
+#: deterministic duplicate that proves the replica-side dedup cache
+#: collapses it instead of double-executing
+DUP_REQUEST_FAULT = "dup_request"
 
 
 class NoHealthyReplica(MXNetError):
@@ -78,7 +113,9 @@ class NoHealthyReplica(MXNetError):
 
 class ReplicaDead(MXNetError):
     """The forward to the chosen replica failed at the transport level
-    (HTTP 502; NEVER retried — see the idempotency stance above)."""
+    — the caller applies the exactly-once stance (one keyed resend to
+    a different healthy replica; HTTP 502 only when that is
+    impossible)."""
 
 
 class _ReplicaView(object):
@@ -129,6 +166,13 @@ class FleetRouter(object):
                              if evict_s is None else evict_s)
         self.slo_ms = float(slo_ms or 0.0)
         self.request_timeout = float(request_timeout)
+        self.hedge_pct = float(get_env(ENV_FLEET_HEDGE_PCT))
+        self.hedge_min_ms = float(get_env(ENV_FLEET_HEDGE_MIN_MS))
+        self.brownout_ms = float(get_env(ENV_FLEET_BROWNOUT_MS))
+        #: gray-failure ejection (controller/static mode only: a view
+        #: worker inherits ejection through the published healthy bit)
+        self.outliers = OutlierDetector(
+            hold_s=max(2.0 * self.heartbeat_s, 1.0))
         self.stats = Stats()
         self.draining = False
         self._controller = None
@@ -207,9 +251,9 @@ class FleetRouter(object):
         set).  A replica the snapshot calls healthy is routable NOW —
         even off a stale snapshot (publisher hiccup): routing to a
         last-known-healthy replica is safe, because a death since the
-        snapshot surfaces as the established fail-once 502, never a
-        resend.  Worker-local inflight/error counters survive the
-        sync."""
+        snapshot surfaces as a transport failure the exactly-once
+        stance absorbs (one keyed resend elsewhere).  Worker-local
+        inflight/error counters survive the sync."""
         doc = self._view.doc()
         now = time.monotonic()
         with self._lock:
@@ -278,9 +322,9 @@ class FleetRouter(object):
         A transport-level miss gets ONE retry after a jittered pause
         before the heartbeat-age clock is allowed to advance toward
         eviction: a single dropped packet on a loaded replica must not
-        start the eviction countdown.  The retry is for these
-        idempotent probe GETs ONLY — the fail-once stance on predict
-        forwards is unchanged (a forward is NEVER resent).  A replica
+        start the eviction countdown.  (Predict forwards have their own
+        keyed retry discipline in ``proxy_predict`` — these probe GETs
+        retry freely because they are idempotent by nature.)  A replica
         that reported ``draining`` is a deliberate eviction, not a
         miss: no retry.
 
@@ -322,7 +366,36 @@ class FleetRouter(object):
                 + self.PROBE_RETRY_JITTER_S
             for t in threads:
                 t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._update_outliers()
         return self.healthy()
+
+    def _update_outliers(self):
+        """Feed the gray-failure detector one pass (controller/static
+        mode; the probe loop's tail): recent-p99 per replica from its
+        own /stats, cumulative forward errors, and the pre-ejection
+        routable set so the detector can hold its max-eject/N-1
+        floor."""
+        det = self.outliers
+        if not det.enabled or self._view is not None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            routable = [rid for rid in self._order
+                        if rid not in self._fenced
+                        and self._views[rid].last_ok is not None
+                        and now - self._views[rid].last_ok <= self.evict_s
+                        and self._views[rid].addr is not None]
+            lat, errs = {}, {}
+            for rid in routable:
+                view = self._views[rid]
+                lm = ((view.stats or {}).get("latency_ms") or {})
+                sample = lm.get("p99_recent", lm.get("p99"))
+                if sample is not None:
+                    lat[rid] = float(sample)
+                errs[rid] = view.errors
+        for key, n in det.update(routable, lat, errs, now=now).items():
+            if n:
+                self.stats.inc(key, n)
 
     def _health_loop(self):
         while not self._stop_health.wait(self.heartbeat_s):
@@ -332,16 +405,22 @@ class FleetRouter(object):
                 pass
 
     def healthy(self):
-        """Routable replica ids: probed OK within the eviction window
-        and not fenced by a rolling swap (view mode: as the published
-        snapshot says — the sync stamps healthy replicas fresh, so a
-        stale snapshot keeps its last-known-healthy set routable)."""
+        """Routable replica ids: probed OK within the eviction window,
+        not fenced by a rolling swap, and not held out by gray-failure
+        ejection (view mode: as the published snapshot says — the sync
+        stamps healthy replicas fresh, so a stale snapshot keeps its
+        last-known-healthy set routable; the snapshot's healthy bit
+        already folds controller-side ejection)."""
         if self._view is not None:
             self._sync_view()
+            ejected = set()
+        else:
+            ejected = self.outliers.ejected()
         now = time.monotonic()
         with self._lock:
             return [rid for rid in self._order
                     if rid not in self._fenced
+                    and rid not in ejected
                     and self._views[rid].last_ok is not None
                     and now - self._views[rid].last_ok <= self.evict_s
                     and self._views[rid].addr is not None]
@@ -358,9 +437,11 @@ class FleetRouter(object):
                 "fence via the publisher-side router, the snapshot "
                 "carries it to every worker")
         now = time.monotonic()
+        ejected = self.outliers.ejected()
         with self._lock:
             others = [r for r in self._order
                       if r != rid and r not in self._fenced
+                      and r not in ejected
                       and self._views[r].last_ok is not None
                       and now - self._views[r].last_ok <= self.evict_s
                       and self._views[r].addr is not None]
@@ -390,6 +471,7 @@ class FleetRouter(object):
         ``healthy`` flag already folds in fencing — a worker needs one
         bit, not the derivation."""
         healthy = set(self.healthy())
+        eject = self.outliers.export()
         ctrl = {r["id"]: r for r in self._controller.snapshot()} \
             if self._controller is not None else {}
         out = {}
@@ -400,7 +482,12 @@ class FleetRouter(object):
                 out[str(rid)] = {
                     "id": rid,
                     "addr": list(view.addr) if view.addr else None,
+                    # the healthy bit folds fencing AND ejection — a
+                    # worker needs one bit; the eject detail rides
+                    # alongside for observability
                     "healthy": rid in healthy,
+                    "ejected": bool(
+                        (eject.get(rid) or {}).get("ejected")),
                     "stats": view.stats,
                     "forward_errors": view.errors,
                     "state": sup.get("state"),
@@ -446,9 +533,10 @@ class FleetRouter(object):
         if self._view is not None:
             age = self._view.age_s()
             if age is not None and age > self.evict_s:
-                # routing on a stale snapshot is SAFE (fail-once covers
-                # any death since) but worth counting: a climbing
-                # stale_view_routes means the publisher is gone
+                # routing on a stale snapshot is SAFE (the keyed
+                # resend covers any death since) but worth counting: a
+                # climbing stale_view_routes means the publisher is
+                # gone
                 self.stats.inc("stale_view_routes")
         home = self._order[self.manifest.home(model) % len(self._order)]
         with self._lock:
@@ -477,8 +565,9 @@ class FleetRouter(object):
     #: the replica handler's socket timeout closes ITS side after 10s
     #: (serving/frontend.py), and a request written onto such a socket
     #: fails at getresponse() — which this router must treat as a dead
-    #: replica (fail once, never resend).  Refreshing before the
-    #: replica's deadline keeps idle gaps from minting spurious 502s.
+    #: replica (one keyed resend elsewhere, then 502).  Refreshing
+    #: before the replica's deadline keeps idle gaps from minting
+    #: spurious retries.
     CONN_IDLE_S = 5.0
 
     def _connection(self, rid, addr, fresh=False):
@@ -503,8 +592,9 @@ class FleetRouter(object):
 
     def forward(self, rid, method, path, body=None, headers=None):
         """One proxied request -> ``(status, raw_body, content_type)``.
-        A transport failure raises :class:`ReplicaDead` — exactly once,
-        no resend (idempotency stance)."""
+        A transport failure raises :class:`ReplicaDead`; THIS method
+        never resends — the exactly-once retry decision (same key,
+        different replica, once) belongs to :meth:`proxy_predict`."""
         with self._lock:
             addr = self._views[rid].addr
         if addr is None:
@@ -536,17 +626,268 @@ class FleetRouter(object):
             with self._lock:
                 self._views[rid].errors += 1
             raise ReplicaDead(
-                "replica %d died mid-request (%s: %s); NOT retried — "
-                "resending a non-idempotent predict could execute it "
-                "twice" % (rid, type(e).__name__, e))
+                "replica %d died mid-request (%s: %s)"
+                % (rid, type(e).__name__, e))
+
+    # -- load pressure (shared with fleet/autoscale.py) --------------------
+    def pressure_ms(self):
+        """Aggregate fleet pressure: mean over healthy replicas of each
+        one's worst per-model ``est_wait_ms``.  ONE definition, two
+        consumers — the autoscaler's scale signal (fleet/autoscale.py)
+        and the brownout admission gate: capacity growth and load
+        shedding must watch the same number or they fight each
+        other."""
+        healthy = self.healthy()
+        if not healthy:
+            return 0.0
+        worst = []
+        with self._lock:
+            for rid in healthy:
+                view = self._views.get(rid)
+                est = ((view.stats or {}).get("est_wait_ms") or {}) \
+                    if view is not None else {}
+                worst.append(max(est.values()) if est else 0.0)
+        return sum(worst) / len(worst) if worst else 0.0
+
+    def _flooder_tenant(self):
+        """The tenant holding the largest summed queued depth across
+        the fleet, when that depth has reached the spill bound — the
+        over-quota tenant brownout sheds even at priority > 0."""
+        depths = {}
+        with self._lock:
+            for view in self._views.values():
+                per_model = (view.stats or {}).get("tenants") or {}
+                for depth_map in per_model.values():
+                    for tenant, d in (depth_map or {}).items():
+                        depths[tenant] = depths.get(tenant, 0) + int(d)
+        if not depths:
+            return None
+        tenant = max(depths, key=lambda t: depths[t])
+        return tenant if depths[tenant] >= self.spill_queue else None
+
+    def _brownout_sheds(self, headers):
+        """Whether THIS request goes first under brownout: everything
+        not explicitly prioritized (priority <= 0), plus the flooder
+        tenant's work regardless of priority."""
+        headers = headers or {}
+        try:
+            priority = int(headers.get("X-MXTPU-Priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
+        if priority <= 0:
+            return True
+        tenant = headers.get("X-MXTPU-Tenant")
+        return tenant is not None and tenant == self._flooder_tenant()
+
+    # -- exactly-once forwarding + tail defense ----------------------------
+    def _pick_other(self, exclude):
+        """Least-loaded healthy replica outside ``exclude`` — the
+        retry/hedge target; ``None`` means neither applies (the
+        single-routable-replica gate)."""
+        exclude = set(exclude)
+        cands = [r for r in self.healthy() if r not in exclude]
+        with self._lock:
+            cands = [r for r in cands if r in self._views]
+            if not cands:
+                return None
+            return min(cands,
+                       key=lambda r: (self._load(self._views[r]), r))
+
+    def _hedge_threshold_ms(self):
+        """Adaptive hedge trigger: the configured percentile of recent
+        router-observed latency, floored at ``hedge_min_ms``; ``None``
+        disables hedging."""
+        if self.hedge_pct <= 0:
+            return None
+        pct = self.stats.latency_percentile(self.hedge_pct)
+        return max(self.hedge_min_ms, float(pct)) \
+            if pct is not None else self.hedge_min_ms
+
+    def _mark_retried(self, data, ctype):
+        """Surface ``"retried": true`` in a JSON response body — the
+        client-visible receipt that the exactly-once layer resent the
+        request on its behalf."""
+        if "json" not in (ctype or ""):
+            return data
+        try:
+            payload = json.loads(data.decode("utf-8"))
+            payload["retried"] = True
+            return json.dumps(payload).encode("utf-8")
+        except Exception:  # noqa: BLE001 — any non-object body: as-is
+            return data
+
+    def _spawn_attempt(self, rid, path, body, headers, results, state):
+        """One forward attempt on a helper thread (the hedged path);
+        results land on ``results`` as ``(rid, (status, data, ctype)
+        or None, error or None)``.  An attempt finishing after the
+        request settled is the hedge race's loser: ``hedge_wasted``."""
+        def run():
+            with self._lock:
+                view = self._views.get(rid)
+                if view is not None:
+                    view.inflight += 1
+            try:
+                try:
+                    out = self.forward(rid, "POST", path, body=body,
+                                       headers=headers)
+                    err = None
+                except ReplicaDead as e:
+                    out, err = None, e
+            finally:
+                with self._lock:
+                    view = self._views.get(rid)
+                    if view is not None:
+                        view.inflight -= 1
+                # the pool is per-thread and this thread is about to
+                # die — close the sockets now instead of leaving them
+                # to the GC so attempt threads don't pile up FDs
+                for conn in getattr(self._local, "conns", {}).values():
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001 — teardown only
+                        pass
+                self._local.conns = {}
+            with state["lock"]:
+                late = state["done"]
+            if late:
+                self.stats.inc("hedge_wasted")
+            results.put((rid, out, err))
+        threading.Thread(target=run, name="mxfleet-attempt",
+                         daemon=True).start()
+
+    def _forward_exactly_once(self, rid, path, body, headers):
+        """Primary forward + at most ONE keyed resend to a different
+        healthy replica on transport failure (the request id in
+        ``headers`` makes the resend safe — replica dedup collapses a
+        duplicate, and bucket bit-stability makes even a dedup-miss
+        re-execution bit-identical).  Returns ``(status, data, ctype,
+        final_rid, resent)``; ``status None`` = total transport failure
+        with the error message in ``data``."""
+        with self._lock:
+            view = self._views.get(rid)
+            if view is not None:
+                view.inflight += 1
+        try:
+            try:
+                status, data, ctype = self.forward(
+                    rid, "POST", path, body=body, headers=headers)
+                return status, data, ctype, rid, False
+            except ReplicaDead as e:
+                first_err = e
+        finally:
+            with self._lock:
+                view = self._views.get(rid)
+                if view is not None:
+                    view.inflight -= 1
+        alt = self._pick_other({rid})
+        if alt is None:
+            return None, ("%s — no other healthy replica to resend to"
+                          % (first_err,)), None, rid, False
+        self.stats.inc("retries")
+        with self._lock:
+            view = self._views.get(alt)
+            if view is not None:
+                view.inflight += 1
+        try:
+            try:
+                status, data, ctype = self.forward(
+                    alt, "POST", path, body=body, headers=headers)
+                return status, data, ctype, alt, True
+            except ReplicaDead as e2:
+                return None, ("%s — after one keyed resend" % (e2,)), \
+                    None, alt, True
+        finally:
+            with self._lock:
+                view = self._views.get(alt)
+                if view is not None:
+                    view.inflight -= 1
+
+    def _forward_hedged(self, rid, path, body, headers, thr_ms):
+        """Tail-defense forward: the primary attempt runs on a helper
+        thread; past ``thr_ms`` with no answer, a backup goes to the
+        next-least-loaded replica with the SAME key (``hedges``) and
+        the first answer wins.  A transport failure while the other
+        attempt is still in flight lets that attempt double as the
+        retry; with nothing in flight the explicit one-resend rule
+        applies, same as the inline path."""
+        results = queue.Queue()
+        state = {"lock": threading.Lock(), "done": False}
+        launched = [rid]
+        self._spawn_attempt(rid, path, body, headers, results, state)
+        outstanding = 1
+        got = None
+        try:
+            try:
+                got = results.get(timeout=thr_ms / 1000.0)
+            except queue.Empty:
+                backup = self._pick_other(set(launched))
+                if backup is not None:
+                    self.stats.inc("hedges")
+                    launched.append(backup)
+                    self._spawn_attempt(backup, path, body, headers,
+                                        results, state)
+                    outstanding += 1
+            failed = 0
+            retried_once = False
+            last_err, last_rid = None, rid
+            while outstanding > 0:
+                if got is None:
+                    try:
+                        got = results.get(
+                            timeout=self.request_timeout + 5.0)
+                    except queue.Empty:
+                        break
+                arid, out, err = got
+                got = None
+                outstanding -= 1
+                if err is None:
+                    status, data, ctype = out
+                    return status, data, ctype, arid, failed > 0
+                failed += 1
+                last_err, last_rid = err, arid
+                if outstanding > 0:
+                    continue        # the hedge doubles as the retry
+                if not retried_once:
+                    alt = self._pick_other(set(launched))
+                    if alt is not None:
+                        retried_once = True
+                        self.stats.inc("retries")
+                        launched.append(alt)
+                        self._spawn_attempt(alt, path, body, headers,
+                                            results, state)
+                        outstanding += 1
+            msg = str(last_err) if last_err is not None else \
+                ("request timed out across %d attempt(s)"
+                 % len(launched))
+            return None, msg, None, last_rid, failed > 1 or retried_once
+        finally:
+            with state["lock"]:
+                state["done"] = True
 
     def proxy_predict(self, model, body, headers):
-        """The full per-request path: fence -> route -> forward ->
-        account.  Returns ``(status, raw_body, content_type)``."""
+        """The full per-request path: brownout gate -> route -> forward
+        (exactly-once retry + optional hedge) -> account.  Returns
+        ``(status, raw_body, content_type)``."""
         if self.draining:
             return 503, json.dumps(
                 {"error": "fleet is draining"}).encode("utf-8"), \
                 "application/json"
+        in_brownout = False
+        if self.brownout_ms > 0:
+            pressure = self.pressure_ms()
+            in_brownout = pressure > self.brownout_ms
+            if in_brownout and self._brownout_sheds(headers):
+                tenant = (headers or {}).get("X-MXTPU-Tenant")
+                self.stats.inc("brownout_shed")
+                self.stats.inc("brownout_shed:%s" % (tenant or "-",))
+                retry_after = max(0.5, pressure / 1000.0)
+                return 429, json.dumps(
+                    {"error": "brownout: fleet pressure %.1fms past "
+                     "%.1fms — shed before queueing" % (
+                         pressure, self.brownout_ms),
+                     "reason": "brownout", "tenant": tenant,
+                     "retry_after_s": round(retry_after, 3)}
+                ).encode("utf-8"), "application/json"
         try:
             rid, reason = self.route(model)
         except NoHealthyReplica as e:
@@ -556,25 +897,43 @@ class FleetRouter(object):
         except MXNetError as e:     # unknown model
             return 404, json.dumps(
                 {"error": str(e)}).encode("utf-8"), "application/json"
-        with self._lock:
-            self._views[rid].inflight += 1
+        path = "/predict/%s" % model
         tic = time.monotonic()
-        try:
-            status, data, ctype = self.forward(
-                rid, "POST", "/predict/%s" % model, body=body,
-                headers=headers)
-        except ReplicaDead as e:
+        # hedging is gated off in brownout (a fleet already shedding
+        # load must not mint duplicate work) — the retry stance is NOT:
+        # absorbing a dead replica is cheap exactly when it matters
+        thr_ms = None if in_brownout else self._hedge_threshold_ms()
+        if thr_ms is None:
+            status, data, ctype, final_rid, resent = \
+                self._forward_exactly_once(rid, path, body, headers)
+        else:
+            status, data, ctype, final_rid, resent = \
+                self._forward_hedged(rid, path, body, headers, thr_ms)
+        if status is None:
+            # replica_errors counts FINAL client-visible failures, so
+            # the 502 ledger (chaos drills) stays exact; per-attempt
+            # transport failures live in each view's forward_errors
             self.stats.inc("replica_errors")
             return 502, json.dumps(
-                {"error": str(e), "replica": rid,
-                 "retried": False}).encode("utf-8"), "application/json"
-        finally:
-            with self._lock:
-                self._views[rid].inflight -= 1
+                {"error": data, "replica": final_rid,
+                 "retried": resent}).encode("utf-8"), "application/json"
+        if resent:
+            self.stats.inc("retry_ok")
+            data = self._mark_retried(data, ctype)
         self.stats.inc("routed")
         if reason is not None:
             self.stats.inc(reason)      # "spilled" | "rerouted"
         self.stats.record_latency((time.monotonic() - tic) * 1000.0)
+        if faults.consume(DUP_REQUEST_FAULT):
+            # deterministic duplicate: deliver the SAME request (same
+            # body, same key) once more — the replica-side dedup cache
+            # must collapse it onto the original execution
+            self.stats.inc("dup_requests")
+            try:
+                self.forward(final_rid, "POST", path, body=body,
+                             headers=headers)
+            except ReplicaDead:
+                pass
         return status, data, ctype
 
     # -- observation -------------------------------------------------------
@@ -601,11 +960,17 @@ class FleetRouter(object):
                 if sup:
                     ctrl[rid] = sup
         now = time.monotonic()
+        if self._view is not None:
+            ejected = {rid for rid, ent in self._view.replicas().items()
+                       if ent.get("ejected")}
+        else:
+            ejected = self.outliers.ejected()
         with self._lock:
             for rid in self._order:
                 view = self._views[rid]
                 entry = {"healthy": rid in healthy,
                          "fenced": rid in self._fenced,
+                         "ejected": rid in ejected,
                          "port": view.addr[1] if view.addr else None,
                          "inflight": view.inflight,
                          "forward_errors": view.errors,
@@ -650,6 +1015,14 @@ class FleetRouter(object):
                              "freshness_ms":
                                  max(freshness) if freshness else None},
                    "draining": self.draining}
+        pressure = self.pressure_ms()
+        payload["brownout"] = {
+            "slo_ms": self.brownout_ms,
+            "pressure_ms": round(pressure, 3),
+            "active": self.brownout_ms > 0
+            and pressure > self.brownout_ms}
+        if self.outliers.enabled:
+            payload["ejection"] = self.outliers.export()
         # fleet p50/p99 = the router tier's end-to-end window (merged
         # across every worker in sharded mode — any worker can answer)
         payload["fleet"]["latency_ms"] = payload["router"]["latency_ms"]
@@ -832,8 +1205,8 @@ class _ReuseportHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that binds with SO_REUSEPORT: N router
     workers listen on the SAME public port and the kernel balances new
     connections across them (established keep-alive connections stay
-    with their worker — per-worker connection pools and the fail-once
-    502 stance are untouched)."""
+    with their worker — per-worker connection pools and the
+    exactly-once retry discipline are untouched)."""
 
     def server_bind(self):
         if not hasattr(socket, "SO_REUSEPORT"):
@@ -857,10 +1230,12 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def _reply_raw(self, status, body, ctype):
+    def _reply_raw(self, status, body, ctype, extra=None):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (extra or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -887,9 +1262,21 @@ class _Handler(BaseHTTPRequestHandler):
                        self.headers.get("Content-Type")
                        or "application/json"}
         for h in ("X-MXTPU-Priority", "X-MXTPU-Deadline-Ms",
-                  "X-MXTPU-Tenant"):
+                  "X-MXTPU-Tenant", "X-MXTPU-Request-Id"):
             if self.headers.get(h) is not None:
                 fwd_headers[h] = self.headers[h]
         status, data, ctype = self.rt.proxy_predict(model, body,
                                                     fwd_headers)
-        self._reply_raw(status, data, ctype)
+        extra = None
+        if status == 429:
+            # brownout shed: tell well-behaved clients when to come
+            # back instead of letting them hammer a saturated fleet
+            try:
+                secs = json.loads(data.decode("utf-8")) \
+                    .get("retry_after_s")
+            except Exception:  # noqa: BLE001
+                secs = None
+            if secs is not None:
+                extra = {"Retry-After":
+                         str(max(1, int(round(float(secs)))))}
+        self._reply_raw(status, data, ctype, extra=extra)

@@ -25,8 +25,8 @@ split:
   snapshot on a refresh period, keeps the last good document when a read
   races the publisher or the publisher is briefly gone (a worker on a
   stale generation keeps routing to the last-known-healthy set — SAFE,
-  because a replica that died since then surfaces as the established
-  fail-once 502, never a resend), and never moves BACKWARD in
+  because a replica that died since then is absorbed by the router's
+  keyed one-resend-elsewhere discipline), and never moves BACKWARD in
   generations.
 - :class:`RouterWorkerSet` — spawns + supervises the N
   ``tools/fleet.py router-worker`` processes (same exit-code discipline
@@ -59,9 +59,9 @@ import time
 from ..base import MXNetError, get_env, register_env
 
 __all__ = ["FleetViewPublisher", "FleetViewReader", "RouterWorkerSet",
-           "reserve_port", "worker_stats_path", "default_fleet_py",
-           "VIEW_BASENAME", "ENV_FLEET_WORKERS",
-           "ENV_FLEET_VIEW_REFRESH_S"]
+           "OutlierDetector", "reserve_port", "worker_stats_path",
+           "default_fleet_py", "VIEW_BASENAME", "ENV_FLEET_WORKERS",
+           "ENV_FLEET_VIEW_REFRESH_S", "ENV_FLEET_EJECT_X"]
 
 ENV_FLEET_WORKERS = register_env(
     "MXTPU_FLEET_WORKERS", default=1,
@@ -73,6 +73,12 @@ ENV_FLEET_VIEW_REFRESH_S = register_env(
     doc="Shared-fleet-view cadence: the controller-side prober "
         "publishes the routing snapshot and each router worker re-reads "
         "it (and dumps its own counters) this often")
+ENV_FLEET_EJECT_X = register_env(
+    "MXTPU_FLEET_EJECT_X", default=0.0,
+    doc="Gray-failure outlier ejection: temporarily eject a replica "
+        "whose recent-p99 latency EWMA exceeds this multiple of the "
+        "fleet median (or whose forward errors streak), folded into "
+        "the published healthy bit like fencing; 0 disables ejection")
 
 #: the snapshot file name under the fleet run dir
 VIEW_BASENAME = "fleet-view.json"
@@ -121,6 +127,165 @@ def default_fleet_py():
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     return os.path.join(root, "tools", "fleet.py")
+
+
+class OutlierDetector(object):
+    """Controller-side gray-failure detection (Envoy-style outlier
+    ejection, per "The Tail at Scale"): the ONE prober tracks each
+    replica's recent-p99 latency as an EWMA plus its forward-error
+    streak, and temporarily EJECTS a replica that has gone
+    slow-but-alive — p99 EWMA beyond ``MXTPU_FLEET_EJECT_X`` times the
+    fleet median, or ``error_streak`` consecutive probe passes with new
+    transport errors.  Ejection folds into the published view's healthy
+    bit exactly like fencing, so every router worker stops routing to
+    the outlier within one snapshot refresh.
+
+    Guard rails:
+
+    - **max-eject fraction / N-1 floor**: at most ``max_eject_frac`` of
+      the routable set may be ejected at once, and never the last
+      routable replica (``eject_blocked_floor`` counts refusals) — a
+      detector gone wrong must degrade to the old behavior, not take
+      the fleet down;
+    - **half-open re-probe**: after ``hold_s`` the replica rejoins
+      routing on probation (its EWMA is reset — fresh eyes); the next
+      pass with a latency sample either re-ejects it (still an outlier)
+      or reinstates it for good (``eject_rejoins``).
+
+    The latency signal is each replica's ``latency_ms.p99_recent`` from
+    its own ``/stats`` (a small-window tail percentile — see
+    ``Stats.RECENT_WINDOW``), NOT the probe round-trip: a gray-failing
+    replica answers its cheap ``/healthz`` promptly while its serving
+    path crawls."""
+
+    def __init__(self, eject_x=None, alpha=0.4, min_samples=3,
+                 max_eject_frac=0.5, hold_s=2.0, error_streak=3):
+        self.eject_x = float(get_env(ENV_FLEET_EJECT_X)
+                             if eject_x is None else eject_x)
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self.max_eject_frac = float(max_eject_frac)
+        self.hold_s = float(hold_s)
+        self.error_streak = int(error_streak)
+        self._lock = threading.Lock()
+        self._ewma = {}          # rid -> (ewma_ms, sample_count)
+        self._errors = {}        # rid -> last cumulative error count
+        self._streaks = {}       # rid -> consecutive error passes
+        self._ejected = {}       # rid -> eject deadline (monotonic)
+        self._half_open = set()  # rids on post-eject probation
+        self.counters = {"ejects": 0, "eject_rejoins": 0,
+                         "eject_blocked_floor": 0}
+
+    @property
+    def enabled(self):
+        return self.eject_x > 0.0
+
+    def ejected(self, now=None):
+        """Rids currently held out of routing (half-open rids are
+        routable — that IS the re-probe)."""
+        if not self.enabled:
+            return set()
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expired = [r for r, until in self._ejected.items()
+                       if now >= until]
+            for rid in expired:
+                del self._ejected[rid]
+                self._half_open.add(rid)
+                # probation judges fresh samples, not the slow spell
+                # that caused the eject
+                self._ewma.pop(rid, None)
+                self._streaks.pop(rid, None)
+            return set(self._ejected)
+
+    def _median(self, rids):
+        vals = sorted(self._ewma[r][0] for r in rids
+                      if r in self._ewma
+                      and self._ewma[r][1] >= self.min_samples)
+        if not vals:
+            return None
+        return vals[len(vals) // 2]
+
+    def update(self, routable, latency_ms, errors, now=None):
+        """One detector pass, fed by the prober: ``routable`` = rids
+        routable before ejection, ``latency_ms`` = {rid: recent p99},
+        ``errors`` = {rid: cumulative forward+probe error count}.
+        Returns the counter increments for this pass (the router folds
+        them into its /stats counters)."""
+        if not self.enabled:
+            return {}
+        now = time.monotonic() if now is None else now
+        held = self.ejected(now)        # also promotes expired -> half-open
+        events = {"ejects": 0, "eject_rejoins": 0,
+                  "eject_blocked_floor": 0}
+        with self._lock:
+            gone = [r for r in self._ewma if r not in routable
+                    and r not in held]
+            for rid in gone:            # evicted/scaled-down: forget it
+                self._ewma.pop(rid, None)
+                self._streaks.pop(rid, None)
+                self._errors.pop(rid, None)
+                self._half_open.discard(rid)
+            for rid in routable:
+                if rid in held:
+                    continue
+                sample = latency_ms.get(rid)
+                if sample is not None:
+                    ewma, n = self._ewma.get(rid, (float(sample), 0))
+                    ewma += self.alpha * (float(sample) - ewma)
+                    self._ewma[rid] = (ewma, n + 1)
+                errs = int(errors.get(rid, 0))
+                last = self._errors.get(rid)
+                self._errors[rid] = errs
+                if last is not None and errs > last:
+                    self._streaks[rid] = self._streaks.get(rid, 0) + 1
+                else:
+                    self._streaks[rid] = 0
+            active = [r for r in routable if r not in held]
+            median = self._median(active)
+            max_eject = min(int(self.max_eject_frac * len(active)),
+                            len(active) - 1)
+            for rid in active:
+                outlier = self._streaks.get(rid, 0) >= self.error_streak
+                ewma, n = self._ewma.get(rid, (0.0, 0))
+                if not outlier and median and n >= self.min_samples:
+                    outlier = ewma > self.eject_x * median
+                if rid in self._half_open:
+                    if n < 1:
+                        continue        # no fresh sample yet: stay open
+                    self._half_open.discard(rid)
+                    if not outlier:
+                        self.counters["eject_rejoins"] += 1
+                        events["eject_rejoins"] += 1
+                        continue        # reinstated; fall through ejects
+                if not outlier:
+                    continue
+                if len(self._ejected) + 1 > max_eject:
+                    self.counters["eject_blocked_floor"] += 1
+                    events["eject_blocked_floor"] += 1
+                    continue
+                self._ejected[rid] = now + self.hold_s
+                self.counters["ejects"] += 1
+                events["ejects"] += 1
+        return events
+
+    def export(self, now=None):
+        """Per-rid eject state for the published view / stats table."""
+        now = time.monotonic() if now is None else now
+        held = self.ejected(now)
+        with self._lock:
+            out = {}
+            for rid in set(self._ewma) | held | set(self._half_open):
+                ewma = self._ewma.get(rid)
+                out[rid] = {
+                    "ejected": rid in held,
+                    "eject_left_s":
+                        round(self._ejected[rid] - now, 3)
+                        if rid in self._ejected else None,
+                    "half_open": rid in self._half_open,
+                    "latency_ewma_ms":
+                        round(ewma[0], 3) if ewma else None}
+            return out
 
 
 class FleetViewPublisher(object):

@@ -13,6 +13,12 @@ states come from the params blob and the forward runs in inference mode
 TPU-native notes: the forward is ONE cached XLA program per input-shape
 signature — ``reshape`` (MXPredReshape analog) just rebinds, hitting the
 jit cache when shapes repeat.  Weights stay device-resident across calls.
+
+Determinism is load-bearing upstream: two replicas serving the same
+checkpoint run the same compiled program and return bit-identical
+outputs for the same input, which is what lets the fleet tier resend a
+keyed request to a DIFFERENT replica (exactly-once retry, hedging —
+fleet/router.py) without the client seeing which one answered.
 """
 from __future__ import annotations
 

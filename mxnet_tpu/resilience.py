@@ -278,6 +278,19 @@ class FaultInjector(object):
     #: ends the process, exactly like a wedged collective would
     HANG_SECONDS = 3600.0
 
+    def hang_seconds(self, point, default=None):
+        """The stall duration armed at ``point`` via :meth:`arm_hang`,
+        else ``default`` (else :data:`HANG_SECONDS`).  For fault sites
+        that sleep on their OWN terms after a ``consume`` — e.g. the
+        serving front end's ``slow_replica`` latency injection, which
+        must stay a bounded per-request delay even when armed through
+        the plain ``MXTPU_FAULTS`` env (which cannot carry a duration
+        the way ``arm_hang`` does)."""
+        secs = self._armed.get(point + "/secs")
+        if secs is not None:
+            return float(secs)
+        return self.HANG_SECONDS if default is None else float(default)
+
     def maybe_hang(self, point):
         """Stall the calling thread for the armed duration at ``point``
         (no-op when unarmed) — the deterministic stand-in for a hung

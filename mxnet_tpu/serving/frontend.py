@@ -22,17 +22,20 @@ import json
 import signal
 import threading
 import time
-from collections import deque
+import uuid
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..base import MXNetError, get_env, register_env
+from ..resilience import faults
 from .batcher import (BucketBatcher, DeadlineExpired, Draining, QueueFull,
                       TenantQuotaExceeded, parse_buckets)
 
 __all__ = ["ServingFrontend", "ServeClient", "Stats",
-           "ENV_SERVE_MAX_QUEUE", "ENV_SERVE_SLO_MS"]
+           "ENV_SERVE_MAX_QUEUE", "ENV_SERVE_SLO_MS",
+           "ENV_SERVE_DEDUP_CAP", "ENV_SERVE_DEDUP_TTL_S"]
 
 ENV_SERVE_MAX_QUEUE = register_env(
     "MXTPU_SERVE_MAX_QUEUE", default=256,
@@ -42,6 +45,24 @@ ENV_SERVE_SLO_MS = register_env(
     "MXTPU_SERVE_SLO_MS", default=0.0,
     doc="Latency SLO: shed (429, `shed_slo`) when the estimated queue "
         "wait exceeds this many ms; 0 disables the estimator")
+ENV_SERVE_DEDUP_CAP = register_env(
+    "MXTPU_SERVE_DEDUP_CAP", default=1024,
+    doc="Idempotency dedup cache: completed 200 responses kept per "
+        "daemon for request-id replay (exactly-once serving); the "
+        "oldest entry is evicted past the cap (`dedup_evicted_size`); "
+        "0 disables replay caching (in-flight dedup still applies)")
+ENV_SERVE_DEDUP_TTL_S = register_env(
+    "MXTPU_SERVE_DEDUP_TTL_S", default=30.0,
+    doc="Idempotency dedup cache entry lifetime: a cached response "
+        "older than this is dropped (`dedup_evicted_ttl`) — bounds how "
+        "long a request id stays replayable")
+
+#: fault point: armable per-request latency injection in the replica
+#: front end — the deterministic stand-in for a gray-failing (slow but
+#: alive) replica.  ``arm_hang`` sets the delay; plain ``MXTPU_FAULTS``
+#: env arming delays each armed hit by SLOW_REPLICA_DEFAULT_S.
+SLOW_REPLICA_FAULT = "slow_replica"
+SLOW_REPLICA_DEFAULT_S = 0.25
 
 
 def _percentile(sorted_vals, q):
@@ -99,18 +120,39 @@ class Stats(object):
             self._bucket_rows += int(bucket)
             self._batch_time += float(seconds)
 
+    #: samples feeding the RECENT percentile (``p99_recent``): small on
+    #: purpose, so a replica that recovers from a slow spell washes the
+    #: spell out of its reported tail within ~this many requests (the
+    #: gray-failure detector's re-admission signal — a 4096-sample p99
+    #: would pin an ejected replica slow for thousands of requests)
+    RECENT_WINDOW = 64
+
+    def latency_percentile(self, q, recent=256, min_count=16):
+        """Percentile of the last ``recent`` latency samples, or None
+        below ``min_count`` samples — the adaptive hedge trigger
+        (fleet/router.py) reads this instead of the full window so the
+        threshold tracks what latency looks like NOW."""
+        with self._lock:
+            tail = list(self._latencies)[-int(recent):]
+        if len(tail) < int(min_count):
+            return None
+        return _percentile(sorted(tail), q)
+
     def snapshot(self):
         with self._lock:
-            lat = sorted(self._latencies)
+            raw = list(self._latencies)
             counters = dict(self._counters)
             tenant_lat = {t: sorted(w)
                           for t, w in self._tenant_lat.items()}
             batches, rows = self._batches, self._rows
             bucket_rows, batch_time = self._bucket_rows, self._batch_time
+        lat = sorted(raw)
+        recent = sorted(raw[-self.RECENT_WINDOW:])
         out = {"counters": counters,
                "latency_ms": {"count": len(lat),
                               "p50": _percentile(lat, 50),
-                              "p99": _percentile(lat, 99)},
+                              "p99": _percentile(lat, 99),
+                              "p99_recent": _percentile(recent, 99)},
                "batches": {"count": batches, "rows": rows,
                            "fill_ratio": round(rows / bucket_rows, 4)
                            if bucket_rows else None,
@@ -154,10 +196,12 @@ class Stats(object):
             bucket_rows += int(b[2])
             batch_time += float(b[3])
         lat = sorted(window)
+        recent = sorted(window[-cls.RECENT_WINDOW:])
         return {"counters": counters,
                 "latency_ms": {"count": len(lat),
                                "p50": _percentile(lat, 50),
-                               "p99": _percentile(lat, 99)},
+                               "p99": _percentile(lat, 99),
+                               "p99_recent": _percentile(recent, 99)},
                 "batches": {"count": batches, "rows": rows,
                             "fill_ratio": round(rows / bucket_rows, 4)
                             if bucket_rows else None,
@@ -165,6 +209,107 @@ class Stats(object):
                                             * 1000.0, 3)
                             if batches else None},
                 "merged_from": len(exports)}
+
+
+class _Pending(object):
+    """One in-flight keyed request: duplicates park on ``event`` and
+    read the original's outcome instead of executing again."""
+
+    __slots__ = ("event", "status", "body")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.status = None
+        self.body = None
+
+
+class _DedupCache(object):
+    """The replica-side half of exactly-once serving: a bounded
+    idempotency cache keyed ``(model, tenant, request id)``.
+
+    - a duplicate of a COMPLETED request replays the cached response
+      bytes without re-entering the batcher (``dedup_hits``);
+    - a duplicate of an IN-FLIGHT request waits on the original's
+      completion and shares its one execution (``dedup_joined``);
+    - only 200s are cached (a shed/error answer must not mask a later
+      retry that would have succeeded), bounded by entry count
+      (``dedup_evicted_size``) and TTL (``dedup_evicted_ttl``).
+
+    Correctness does NOT rest on this cache: the batcher's bit-exactness
+    contract (serving/batcher.py) makes a cross-replica re-execution of
+    the same bytes bit-identical, so a dedup MISS on a retried request
+    is still the right answer — the cache removes the double execution,
+    not a wrong one."""
+
+    def __init__(self, cap=None, ttl_s=None, stats=None):
+        self.cap = int(get_env(ENV_SERVE_DEDUP_CAP)
+                       if cap is None else cap)
+        self.ttl_s = float(get_env(ENV_SERVE_DEDUP_TTL_S)
+                           if ttl_s is None else ttl_s)
+        self.stats = stats
+        self._lock = threading.Lock()
+        self._done = OrderedDict()      # key -> (expires_at, status, body)
+        self._inflight = {}             # key -> _Pending
+
+    def _inc(self, key):
+        if self.stats is not None:
+            self.stats.inc(key)
+
+    def _purge(self, now):
+        # lazy TTL sweep from the insertion-order front (uniform TTL:
+        # the front is the stalest); claim() re-checks per entry anyway
+        while self._done:
+            key = next(iter(self._done))
+            if self._done[key][0] > now:
+                break
+            del self._done[key]
+            self._inc("dedup_evicted_ttl")
+
+    def claim(self, key):
+        """``("replay", (status, body))`` for a completed duplicate,
+        ``("join", pending)`` for an in-flight duplicate, or
+        ``("run", pending)`` — the caller owns the execution and must
+        :meth:`complete` the pending slot."""
+        now = time.monotonic()
+        with self._lock:
+            self._purge(now)
+            ent = self._done.get(key)
+            if ent is not None:
+                if ent[0] <= now:
+                    del self._done[key]
+                    self._inc("dedup_evicted_ttl")
+                else:
+                    self._done.move_to_end(key)
+                    self._inc("dedup_hits")
+                    return "replay", (ent[1], ent[2])
+            p = self._inflight.get(key)
+            if p is not None:
+                self._inc("dedup_joined")
+                return "join", p
+            p = self._inflight[key] = _Pending()
+            return "run", p
+
+    def complete(self, key, pending, status, body):
+        """Publish the original's outcome: waiters wake with exactly
+        these bytes; a 200 additionally becomes replayable until
+        TTL/size eviction."""
+        with self._lock:
+            self._inflight.pop(key, None)
+            if status == 200 and self.cap > 0:
+                self._done[key] = (time.monotonic() + self.ttl_s,
+                                   status, body)
+                self._done.move_to_end(key)
+                while len(self._done) > self.cap:
+                    self._done.popitem(last=False)
+                    self._inc("dedup_evicted_size")
+        pending.status, pending.body = status, body
+        pending.event.set()
+
+    def export(self):
+        with self._lock:
+            return {"entries": len(self._done),
+                    "inflight": len(self._inflight),
+                    "cap": self.cap, "ttl_s": self.ttl_s}
 
 
 class ServingFrontend(object):
@@ -222,6 +367,8 @@ class ServingFrontend(object):
         self._given_watchdog_used = False
         self.request_timeout = float(request_timeout)
         self.stats = Stats()
+        #: the exactly-once layer: request-id dedup for /predict
+        self.dedup = _DedupCache(stats=self.stats)
         self.draining = False
         self._batchers = {}
         #: model -> CheckpointWatcher (serving/deploy.py): created by
@@ -337,7 +484,7 @@ class ServingFrontend(object):
         return True, 200, None
 
     def handle_predict(self, model, inputs, entry=None, priority=0,
-                       deadline_ms=None, tenant=None):
+                       deadline_ms=None, tenant=None, request_id=None):
         """Admission + batch + wait; returns ``(status, payload_dict)``.
         Usable without the HTTP layer (tests, in-process serving).
         ``entry`` skips the pool lookup when the caller (the HTTP
@@ -345,7 +492,59 @@ class ServingFrontend(object):
         ``deadline_ms`` and ``tenant`` pass through to
         :meth:`BucketBatcher.submit` (deadline expiry answers 429
         ``shed_deadline``; a tenant at its queued quota answers 429
-        ``shed_tenant``)."""
+        ``shed_tenant``).
+
+        ``request_id`` (the ``X-MXTPU-Request-Id`` header / body
+        ``request_id`` field) engages the exactly-once layer: a
+        duplicate of a completed request replays the cached response
+        bytes without touching admission or the batcher (the
+        ``accepted`` counter does not move), a duplicate of an
+        in-flight request waits for the original instead of executing
+        twice."""
+        # gray-failure stand-in: an armed `slow_replica` delays the
+        # whole request path (admission included), exactly like a
+        # replica whose host is sick — probes stay fast, serving slows
+        if faults.consume(SLOW_REPLICA_FAULT):
+            slept = faults.hang_seconds(SLOW_REPLICA_FAULT,
+                                        SLOW_REPLICA_DEFAULT_S)
+            time.sleep(slept)
+            # the injected stall must show up in the replica's
+            # REPORTED latency window (latency_ms.p99_recent) — the
+            # batcher only times queue+exec, and that window is what
+            # the controller's outlier detector watches
+            self.stats.record_latency(slept * 1000.0)
+        if not request_id:
+            return self._predict_core(model, inputs, entry, priority,
+                                      deadline_ms, tenant)
+        key = (model, tenant or "", str(request_id))
+        kind, val = self.dedup.claim(key)
+        if kind == "replay":
+            status, body = val
+            return status, json.loads(body.decode("utf-8"))
+        if kind == "join":
+            if not val.event.wait(timeout=self.request_timeout):
+                self.stats.inc("errors")
+                return 504, {"error": "duplicate of request %r timed "
+                             "out waiting for the original"
+                             % (request_id,), "model": model}
+            return val.status, json.loads(val.body.decode("utf-8"))
+        try:
+            status, payload = self._predict_core(
+                model, inputs, entry, priority, deadline_ms, tenant)
+        except BaseException:
+            # never strand duplicates parked on the pending slot; the
+            # synthesized 500 is NOT cached (only 200s replay), so a
+            # later client retry of this id re-executes cleanly
+            self.dedup.complete(key, val, 500, json.dumps(
+                {"error": "original execution of request %r failed"
+                 % (request_id,), "model": model}).encode("utf-8"))
+            raise
+        self.dedup.complete(key, val, status,
+                            json.dumps(payload).encode("utf-8"))
+        return status, payload
+
+    def _predict_core(self, model, inputs, entry, priority, deadline_ms,
+                      tenant):
         if entry is None:
             entry = self.pool.get(model)
         if entry.sample_shapes is not None:
@@ -484,6 +683,9 @@ class ServingFrontend(object):
                    for depths in [b.tenant_depths()] if depths}
         if tenants:
             payload["tenants"] = tenants
+        # the exactly-once surface: live dedup-cache occupancy (hit/
+        # eviction counters ride the shared counters block)
+        payload["dedup"] = self.dedup.export()
         payload["draining"] = self.draining
         payload["buckets"] = list(self.buckets)
         payload["epochs"] = self.epochs()
@@ -606,20 +808,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "unknown path %r" % self.path})
 
     def _qos(self, payload=None):
-        """(priority, deadline_ms, tenant) from the ``X-MXTPU-Priority``
-        / ``X-MXTPU-Deadline-Ms`` / ``X-MXTPU-Tenant`` headers,
-        overridden by same-named JSON body fields (``priority`` /
-        ``deadline_ms`` / ``tenant``) when present."""
+        """(priority, deadline_ms, tenant, request_id) from the
+        ``X-MXTPU-Priority`` / ``X-MXTPU-Deadline-Ms`` /
+        ``X-MXTPU-Tenant`` / ``X-MXTPU-Request-Id`` headers, overridden
+        by same-named JSON body fields (``priority`` / ``deadline_ms``
+        / ``tenant`` / ``request_id``) when present."""
         priority = self.headers.get("X-MXTPU-Priority")
         deadline = self.headers.get("X-MXTPU-Deadline-Ms")
         tenant = self.headers.get("X-MXTPU-Tenant")
+        request_id = self.headers.get("X-MXTPU-Request-Id")
         if payload is not None and isinstance(payload, dict):
             priority = payload.get("priority", priority)
             deadline = payload.get("deadline_ms", deadline)
             tenant = payload.get("tenant", tenant)
+            request_id = payload.get("request_id", request_id)
         return (int(priority) if priority is not None else 0,
                 float(deadline) if deadline is not None else None,
-                str(tenant) if tenant is not None else None)
+                str(tenant) if tenant is not None else None,
+                str(request_id) if request_id is not None else None)
 
     def _parse_inputs(self, entry):
         length = int(self.headers.get("Content-Length", 0))
@@ -671,7 +877,9 @@ class _Handler(BaseHTTPRequestHandler):
                 payload = json.loads(self.rfile.read(length)
                                      .decode("utf-8"))
                 tokens = payload["tokens"]
-                priority, deadline_ms, tenant = self._qos(payload)
+                # dedup is scoped to /predict — the request id (if any)
+                # is ignored on the sequence path
+                priority, deadline_ms, tenant, _ = self._qos(payload)
             except Exception as e:  # noqa: BLE001 — malformed body
                 self._reply(400, {"error": "bad request body: %s" % (e,)})
                 return
@@ -690,14 +898,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": str(e)})
             return
         try:
-            inputs, (priority, deadline_ms, tenant) = \
+            inputs, (priority, deadline_ms, tenant, request_id) = \
                 self._parse_inputs(entry)
         except Exception as e:  # noqa: BLE001 — malformed client body
             self._reply(400, {"error": "bad request body: %s" % (e,)})
             return
         status, payload = self.fe.handle_predict(
             model, inputs, entry=entry, priority=priority,
-            deadline_ms=deadline_ms, tenant=tenant)
+            deadline_ms=deadline_ms, tenant=tenant,
+            request_id=request_id)
         self._reply(status, payload)
 
 
@@ -706,15 +915,30 @@ class ServeClient(object):
     One instance per thread — ``http.client`` connections are not
     thread-safe."""
 
+    #: retire an idle keep-alive connection before the server side can:
+    #: the daemon handler's 10s socket timeout closes ITS end of an
+    #: idle connection, and the next request written onto that socket
+    #: surfaces as a spurious transport error — the same bug class the
+    #: router's pooled connections had (PR 11's CONN_IDLE_S fix),
+    #: load-bearing here now that client retries ride the exactly-once
+    #: path and must not be minted by the client's own stale socket
+    CONN_IDLE_S = 5.0
+
     def __init__(self, host, port, timeout=60.0):
         self.host, self.port, self.timeout = host, int(port), timeout
         self._conn = None
+        self._last_use = 0.0
 
     def _connection(self):
         import http.client
+        now = time.monotonic()
+        if self._conn is not None and \
+                now - self._last_use > self.CONN_IDLE_S:
+            self.close()
         if self._conn is None:
             self._conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout)
+        self._last_use = now
         return self._conn
 
     def close(self):
@@ -755,14 +979,22 @@ class ServeClient(object):
         return resp.status, payload
 
     def predict(self, model, inputs, npy=False, priority=None,
-                deadline_ms=None, tenant=None):
+                deadline_ms=None, tenant=None, request_id=None):
         """``inputs``: {name: per-sample array} (or a bare array for the
         single-input case).  ``priority``/``deadline_ms``/``tenant``
         ride as ``X-MXTPU-*`` headers (work on both body formats).
-        Returns ``(status, payload)``."""
+        Returns ``(status, payload)``.
+
+        Every predict is stamped with an idempotency key
+        (``X-MXTPU-Request-Id``, auto-generated unless ``request_id``
+        is given) — resending with the SAME id is exactly-once: the
+        daemon replays/shares the original execution instead of
+        running it twice."""
         if not isinstance(inputs, dict):
             inputs = {"data": inputs}
-        qos = {}
+        qos = {"X-MXTPU-Request-Id":
+               str(request_id) if request_id is not None
+               else uuid.uuid4().hex}
         if priority is not None:
             qos["X-MXTPU-Priority"] = str(int(priority))
         if deadline_ms is not None:

@@ -874,3 +874,55 @@ def test_gate_keys_cover_lint_wall(tmp_path):
                      against=_write(tmp_path / "o2.json", base))
     assert not rep["pass"]
     assert rep["regressions"][0]["key"] == "lint_wall_ms"
+
+
+# ---------------------------------------------------------------------------
+# tail mode (ISSUE 20 satellite: hedged tail latency, measured)
+# ---------------------------------------------------------------------------
+
+def test_gate_keys_cover_tail_metrics(tmp_path):
+    """The hedging claim is gate-guarded both ways: the hedged p99
+    against a gray replica is a LOWER-is-better latency (a RISE past
+    tolerance blocks, an improvement passes), and the drop-free flag
+    collapses the moment hedging trades correctness for latency.  A
+    vanished key blocks like everywhere else."""
+    for key in ("tail_p99_ms", "tail_drop_free"):
+        assert key in bench.GATE_KEYS
+    assert "tail_p99_ms" in bench.LOWER_IS_BETTER_KEYS
+    base = dict(BASE, tail_p99_ms=35.0, tail_drop_free=1.0)
+    # hedged tail BLOWING UP (back toward the unhedged stall) blocks
+    rep = bench.gate(_write(tmp_path / "n1.json",
+                            dict(base, tail_p99_ms=250.0)),
+                     against=_write(tmp_path / "o1.json", base))
+    assert not rep["pass"]
+    reg = rep["regressions"][0]
+    assert reg["key"] == "tail_p99_ms" and "rise" in reg
+    # a FASTER hedged tail passes — the higher-is-better rule would
+    # have flagged exactly this improvement
+    rep = bench.gate(_write(tmp_path / "n2.json",
+                            dict(base, tail_p99_ms=20.0)),
+                     against=_write(tmp_path / "o2.json", base))
+    assert rep["pass"], rep
+    # any non-200 under hedging chaos collapses the flag -> blocked
+    rep = bench.gate(_write(tmp_path / "n3.json",
+                            dict(base, tail_drop_free=0.0)),
+                     against=_write(tmp_path / "o3.json", base))
+    assert not rep["pass"]
+    assert rep["regressions"][0]["key"] == "tail_drop_free"
+    # a vanished key blocks too (the mode silently dying must not
+    # look like "nothing regressed")
+    for gone_key in ("tail_p99_ms", "tail_drop_free"):
+        gone = {k: v for k, v in base.items() if k != gone_key}
+        rep = bench.gate(_write(tmp_path / "g.json", gone),
+                         against=_write(tmp_path / "go.json", base))
+        assert not rep["pass"]
+        assert rep["regressions"][0]["key"] == gone_key
+
+
+def test_tail_mode_is_known_and_in_the_pipeline_set():
+    assert "tail" in bench.KNOWN_MODES
+    # source-level pin, like hotswap/fleet/ckpt: a mode that silently
+    # leaves the pipeline set stops minting its gate keys
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '_collect("tail"' in src

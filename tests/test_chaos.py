@@ -1177,7 +1177,16 @@ def test_data_service_drill_sigkill_worker_mid_epoch(tmp_path):
             victims = it._service.worker_pids()
             assert len(victims) == 2
             os.kill(victims[0], signal.SIGKILL)
-    st = it.stats()
+    # the respawn is the monitor's heartbeat-policy decision: on a
+    # loaded single-core host the short epoch can complete before the
+    # monitor's next poll — wait for the respawn, don't race it (the
+    # service keeps monitoring between epochs)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = it.stats()
+        if sum(w["respawns"] for w in st["workers"].values()) >= 1:
+            break
+        time.sleep(0.05)
     assert sum(w["respawns"] for w in st["workers"].values()) == 1, st
     it.reset()
     got_e2 = _ds_stream(it)
@@ -1312,14 +1321,15 @@ FLEET = os.path.join(REPO, "tools", "fleet.py")
 @pytest.mark.chaos
 def test_fleet_drill_sigkill_replica_evict_reroute_rejoin_drain(
         tmp_path):
-    """The ISSUE-11 drill, end to end on real daemons:
+    """The ISSUE-11 drill, upgraded to the ISSUE-20 exactly-once
+    contract, end to end on real daemons:
 
     1. a 2-replica fleet serves traffic (the warm store is built on
        the way up);
     2. SIGKILL the model's HOME replica mid-traffic — requests in
-       flight to it fail ONCE with 502/``retried: false`` (the
-       idempotency stance) and are visible to their clients, never
-       silently resent;
+       flight to it are resent ONCE to the survivor with the same
+       idempotency key: their clients see 200/``retried: true``,
+       NEVER a 502 (the old fail-once stance is gone);
     3. the router evicts the dead replica on heartbeat age and new
        traffic reroutes to the survivor (200s continue);
     4. the controller respawns the victim, which rejoins WARM — its
@@ -1433,22 +1443,29 @@ def test_fleet_drill_sigkill_replica_evict_reroute_rejoin_drain(
             t.join(timeout=30)
         cli.close()
 
-        # -- the idempotency ledger ----------------------------------
-        # every request got exactly one answer; the only non-200s are
-        # the dead replica's in-flight/eviction-window set, every one
-        # marked un-retried — and the router's error counter matches
-        # the client-visible failures (a hidden retry would break the
-        # equality from either side)
+        # -- the exactly-once ledger ---------------------------------
+        # every request got exactly one answer, and the SIGKILL was
+        # fully absorbed by the keyed resend: ZERO client-visible 502s;
+        # every absorbed death surfaces as a 200 with retried:true and
+        # reconciles against the router's retry counters — and
+        # replica_errors (FINAL failures only) matches the 502 count,
+        # i.e. stays zero
         assert not exceptions, "dropped responses: %r" % exceptions[:3]
         failed = [(s, p) for s, p in results if s != 200]
-        for s, p in failed:
-            assert s in (502, 503), (s, p)
-            if s == 502:
-                assert p.get("retried") is False
-        status, stats = ServeClient("127.0.0.1", port).stats()
         n502 = sum(1 for s, _ in failed if s == 502)
-        assert stats["router"]["counters"].get("replica_errors", 0) \
-            == n502
+        assert n502 == 0, "client-visible 502s: %r" % failed[:3]
+        for s, p in failed:
+            assert s == 503, (s, p)     # brief no-replica windows only
+        retried_ok = sum(1 for s, p in results
+                         if s == 200 and p.get("retried") is True)
+        status, stats = ServeClient("127.0.0.1", port).stats()
+        counters = stats["router"]["counters"]
+        assert counters.get("replica_errors", 0) == n502 == 0
+        assert counters.get("retry_ok", 0) >= retried_ok
+        assert counters.get("retries", 0) >= counters.get("retry_ok", 0)
+        # the kill happened mid-traffic: at least one request must have
+        # actually ridden the resend path
+        assert retried_ok >= 1, "the SIGKILL was never client-visible"
 
         # -- fleet-wide SIGTERM: every replica drains to rc 0 --------
         proc.send_signal(signal.SIGTERM)
@@ -1456,6 +1473,182 @@ def test_fleet_drill_sigkill_replica_evict_reroute_rejoin_drain(
         stderr = proc.stderr.read()
         assert rc == 0, stderr[-3000:]
         assert "replica exit codes {0: 0, 1: 0}" in stderr
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+@pytest.mark.chaos
+def test_fleet_drill_gray_failure_eject_sigkill_exactly_once(tmp_path):
+    """The ISSUE-20 drill: a real 3-replica fleet with one replica
+    armed ``slow_replica`` and one SIGKILLed mid-traffic serves a
+    mixed-tenant closed loop with ZERO client-visible 502s.
+
+    1. replica 0 (home of the one model) is armed
+       ``slow_replica`` via ``--replica-env`` — gray failure: fast
+       /healthz, crawling predicts; hedging bounds the tail while the
+       outlier detector watches its reported ``p99_recent``;
+    2. the detector EJECTS it (``ejected: true`` on /stats, out of
+       ``healthy()``) without ever violating the routable floor;
+    3. replica 2 is SIGKILLed mid-traffic — the keyed resend absorbs
+       every in-flight death: zero 502s in the closed loop;
+    4. once the armed fault exhausts, the slow replica's window washes
+       clean and it REJOINS via the half-open probe
+       (``eject_rejoins`` counts it);
+    5. the ``dup_request`` fault (armed fleet-wide, consumed router-
+       side) re-sends delivered requests — the replica dedup cache
+       collapses them (``dedup_hits`` > 0 end to end over HTTP);
+    6. duplicate executions stay bounded: extra executions beyond
+       client sends are covered by hedges + retries + dup_requests.
+    """
+    import threading
+
+    from mxnet_tpu.serving import ServeClient
+
+    prefix = _save_serve_mlp(tmp_path)
+    store = str(tmp_path / "store")
+    run_dir = str(tmp_path / "run")
+    port_file = str(tmp_path / "port")
+    env = dict(os.environ,
+               MXTPU_FLEET_HEARTBEAT_S="0.3",
+               MXTPU_FLEET_EVICT_S="1.2",
+               MXTPU_FLEET_EJECT_X="3",
+               MXTPU_FLEET_HEDGE_PCT="95",
+               MXTPU_FLEET_HEDGE_MIN_MS="120",
+               MXTPU_FAULTS="dup_request:5",
+               MXTPU_SERVE_MAX_WAIT_MS="1")
+    proc = subprocess.Popen(
+        [sys.executable, FLEET, "serve",
+         "--model", "mlp=%s:1" % prefix,
+         "--input-shape", "mlp:data=32", "--replicas", "3",
+         "--device-sets", "cpu", "--buckets", "1,2,4",
+         "--warm-store", store, "--run-dir", run_dir,
+         # ~30 gray predicts at the 0.25s default stall, replica 0 only
+         "--replica-env", "0:MXTPU_FAULTS=slow_replica:30",
+         "--port", "0", "--port-file", port_file],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        port = _wait_port_file(port_file, proc, deadline_s=300)
+        results = []
+        exceptions = []
+        stop = threading.Event()
+
+        def traffic(i):
+            cli = ServeClient("127.0.0.1", port, timeout=30)
+            x = np.zeros(32, "f")
+            try:
+                while not stop.is_set():
+                    try:
+                        results.append(cli.predict(
+                            "mlp", x, npy=True,
+                            tenant="t%d" % (i % 2), priority=i % 2))
+                    except Exception as e:  # noqa: BLE001 — dropped
+                        exceptions.append(e)  # answer: contract-fatal
+                    time.sleep(0.01)
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=traffic, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+
+        def _ok_count():
+            return sum(1 for s, _ in results if s == 200)
+
+        cli = ServeClient("127.0.0.1", port, timeout=30)
+        deadline = time.monotonic() + 60
+        while _ok_count() < 20 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _ok_count() >= 20, "fleet never served baseline traffic"
+
+        # -- gray failure: the slow replica is EJECTED ----------------
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            status, stats = cli.stats()
+            if status == 200 and \
+                    stats["replicas"]["0"].get("ejected"):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("slow replica was never ejected")
+        assert stats["router"]["counters"].get("ejects", 0) >= 1
+        # floor respected: ejection never took out more than one
+        healthy_n = stats["fleet"]["replicas_healthy"]
+        assert healthy_n >= 2, stats["fleet"]
+
+        # -- SIGKILL a healthy non-home replica mid-traffic -----------
+        victim = stats["replicas"]["2"]
+        assert victim["pid"], stats
+        os.kill(victim["pid"], signal.SIGKILL)
+        base = _ok_count()
+        deadline = time.monotonic() + 30
+        while _ok_count() < base + 20 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert _ok_count() >= base + 20, "traffic stalled after kill"
+
+        # -- the fault exhausts; half-open probation REJOINS it -------
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            status, stats = cli.stats()
+            if status == 200 \
+                    and stats["router"]["counters"].get(
+                        "eject_rejoins", 0) >= 1 \
+                    and not stats["replicas"]["0"].get("ejected"):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("ejected replica never rejoined")
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # -- the exactly-once ledger ---------------------------------
+        assert not exceptions, "dropped responses: %r" % exceptions[:3]
+        n502 = sum(1 for s, _ in results if s == 502)
+        assert n502 == 0, "client-visible 502s under gray+kill chaos"
+        status, stats = cli.stats()
+        rc = stats["router"]["counters"]
+        fc = stats["fleet"]["counters"]
+        assert rc.get("replica_errors", 0) == 0
+        # hedging engaged on the gray tail, and the race's losers are
+        # accounted — never more losers than hedges
+        assert rc.get("hedges", 0) >= 1
+        assert rc.get("hedge_wasted", 0) <= rc.get("hedges", 0)
+        # the armed dup_request resends were collapsed by replica-side
+        # dedup, proving the id rides client -> router -> replica
+        assert rc.get("dup_requests", 0) >= 1
+        assert fc.get("dedup_hits", 0) >= 1
+        # duplicate executions bounded: every execution beyond the
+        # client's sends is covered by a counted hedge/retry/dup
+        sends = len(results)
+        extra = rc.get("hedges", 0) + rc.get("retries", 0) \
+            + rc.get("dup_requests", 0)
+        assert fc.get("accepted", 0) <= sends + extra
+
+        # -- wait for the relaunched victim before draining -----------
+        # the controller relaunched replica 2 after the SIGKILL; a
+        # SIGTERM that lands while it is still booting (before
+        # serve.py installs its drain handler) kills it rc=-15 and
+        # fails the drain — wait until it serves health first
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            status, stats = cli.stats()
+            if status == 200 and \
+                    stats["replicas"]["2"].get("healthy"):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("relaunched replica never came back")
+        cli.close()
+
+        proc.send_signal(signal.SIGTERM)
+        rc_exit = proc.wait(timeout=120)
+        stderr = proc.stderr.read()
+        assert rc_exit == 0, stderr[-3000:]
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -2150,6 +2343,12 @@ def test_region_storm_drill(tmp_path):
                   "arm:trainer:rot_checkpoint", "kill:replica#1"):
         assert events.get(label) == 1, events
     assert doc["stats"]["clients"]["dropped"] == 0
+    # exactly-once routing: the router absorbs the replica SIGKILL by
+    # keyed resend, so no client ever saw a 502 it had to retry.  503
+    # retries stay allowed — they are the backstop for the no-routable
+    # window when the kill overlaps the rolling swap's fence
+    assert events.get("client_retry:502", 0) == 0, events
+    assert doc["checks"]["no_502_leak"], events
     epochs = doc["spec"]["epochs"]
     assert doc["stats"]["served_epochs"] == {"0": epochs, "1": epochs}
     assert doc["stats"]["trainer"]["world"] == 4    # the resize landed

@@ -110,6 +110,11 @@ class _FakeReplica(object):
         self.est_wait = {}
         self.counters = {"completed": 0, "shed_queue": 0}
         self.draining = False
+        #: scriptable gray-failure shape: per-predict latency, a
+        #: reported recent-p99, and per-model tenant queue depths
+        self.predict_delay_s = 0.0
+        self.p99_recent = None
+        self.tenants = {}
         #: drop (no response, closed socket) the next N /healthz
         #: probes — the single-dropped-packet shape the probe retry
         #: exists for
@@ -163,11 +168,19 @@ class _FakeReplica(object):
                         "epochs": dict(fake.epochs)})
                 elif self.path == "/stats":
                     with fake._lock:
-                        self._reply(200, {
+                        payload = {
                             "queue_depth": dict(fake.depths),
                             "est_wait_ms": dict(fake.est_wait),
                             "epochs": dict(fake.epochs),
-                            "counters": dict(fake.counters)})
+                            "counters": dict(fake.counters)}
+                        if fake.p99_recent is not None:
+                            payload["latency_ms"] = {
+                                "p99_recent": fake.p99_recent}
+                        if fake.tenants:
+                            payload["tenants"] = {
+                                m: dict(t)
+                                for m, t in fake.tenants.items()}
+                        self._reply(200, payload)
                 else:
                     self._reply(404, {})
 
@@ -196,6 +209,8 @@ class _FakeReplica(object):
                     self._reply(200, {"ok": True, "action": "promoted",
                                       "epoch": epoch})
                     return
+                if fake.predict_delay_s:
+                    time.sleep(fake.predict_delay_s)
                 with fake._lock:
                     fake.counters["completed"] += 1
                 self._reply(200, {"fake": fake.port,
@@ -312,21 +327,227 @@ def test_router_evicts_on_heartbeat_age_then_rejoins(two_fakes):
         router.drain_and_stop(timeout=5)
 
 
-def test_router_dead_replica_fails_once_never_retried(two_fakes):
-    """The idempotency stance: a forward hitting a dead replica surfaces
-    ONE 502 to that client — the router must not resend the request to
-    another replica (the body may already have executed)."""
+def test_router_dead_replica_retried_once_elsewhere(two_fakes):
+    """The exactly-once stance: a transport-failed forward is resent
+    ONCE to a different healthy replica with the same request id — the
+    client gets a 200 carrying ``retried: true`` instead of the old
+    fail-once 502."""
     router = _mk_router(two_fakes)
     router.probe()
     two_fakes[0].die()              # dies AFTER probing healthy
     status, body, _ = _predict(router, "a")[0]
+    assert status == 200
+    payload = json.loads(body.decode())
+    assert payload["retried"] is True
+    assert len(two_fakes[1].received) == 1      # the resend landed
+    counters = router.stats.snapshot()["counters"]
+    assert counters["retries"] == 1
+    assert counters["retry_ok"] == 1
+    # replica_errors counts FINAL client-visible failures only
+    assert counters.get("replica_errors", 0) == 0
+
+
+def test_router_retry_is_once_then_final_502(two_fakes):
+    """The resend happens at most ONCE: with every candidate dead the
+    client sees a single 502 with ``retried: true`` (the resend was
+    attempted) and replica_errors counts exactly that final failure."""
+    router = _mk_router(two_fakes)
+    router.probe()
+    two_fakes[0].die()
+    two_fakes[1].die()
+    status, body, _ = _predict(router, "a")[0]
     assert status == 502
     payload = json.loads(body.decode())
-    assert payload["retried"] is False
-    assert "NOT retried" in payload["error"]
-    # nobody else received it
-    assert len(two_fakes[1].received) == 0
-    assert router.stats.snapshot()["counters"]["replica_errors"] == 1
+    assert payload["retried"] is True
+    counters = router.stats.snapshot()["counters"]
+    assert counters["retries"] == 1
+    assert counters.get("retry_ok", 0) == 0
+    assert counters["replica_errors"] == 1
+
+
+def test_router_no_resend_target_keeps_fail_once_surface():
+    """A single-replica fleet has nowhere to resend: the old fail-once
+    surface remains (one 502, ``retried: false``)."""
+    fake = _FakeReplica()
+    try:
+        router = _mk_router([fake])
+        router.probe()
+        fake.die()
+        status, body, _ = _predict(router, "a")[0]
+        assert status == 502
+        payload = json.loads(body.decode())
+        assert payload["retried"] is False
+        assert "no other healthy replica" in payload["error"]
+        counters = router.stats.snapshot()["counters"]
+        assert counters.get("retries", 0) == 0
+        assert counters["replica_errors"] == 1
+    finally:
+        fake.close()
+
+
+def test_router_hedges_slow_primary_first_answer_wins(
+        two_fakes, monkeypatch):
+    """Tail defense: a request older than the hedge threshold gets a
+    backup attempt on the other replica; the fast answer wins and the
+    late primary is accounted ``hedge_wasted``."""
+    monkeypatch.setenv("MXTPU_FLEET_HEDGE_PCT", "95")
+    monkeypatch.setenv("MXTPU_FLEET_HEDGE_MIN_MS", "40")
+    two_fakes[0].predict_delay_s = 0.6      # gray-slow home of "a"
+    router = _mk_router(two_fakes)
+    router.probe()
+    tic = time.monotonic()
+    status, body, _ = _predict(router, "a")[0]
+    took_s = time.monotonic() - tic
+    assert status == 200
+    payload = json.loads(body.decode())
+    assert payload["fake"] == two_fakes[1].port     # backup won
+    assert payload.get("retried") is None           # hedge, not retry
+    assert took_s < 0.5, "hedge should beat the slow primary"
+    counters = router.stats.snapshot()["counters"]
+    assert counters["hedges"] == 1
+    # the slow primary eventually lands and is counted as waste
+    deadline = time.monotonic() + 5
+    while router.stats.snapshot()["counters"].get("hedge_wasted", 0) < 1:
+        assert time.monotonic() < deadline, "loser never accounted"
+        time.sleep(0.05)
+    assert len(two_fakes[0].received) == 1
+    assert len(two_fakes[1].received) == 1
+
+
+def test_router_hedged_path_still_absorbs_dead_replica(
+        two_fakes, monkeypatch):
+    """With hedging on, a transport failure is still absorbed: the
+    in-flight hedge doubles as the retry, or an explicit resend goes
+    out — either way the client never sees the 502."""
+    monkeypatch.setenv("MXTPU_FLEET_HEDGE_PCT", "95")
+    monkeypatch.setenv("MXTPU_FLEET_HEDGE_MIN_MS", "40")
+    router = _mk_router(two_fakes)
+    router.probe()
+    two_fakes[0].die()
+    status, body, _ = _predict(router, "a")[0]
+    assert status == 200
+    assert json.loads(body.decode())["retried"] is True
+    assert router.stats.snapshot()["counters"].get(
+        "replica_errors", 0) == 0
+
+
+def test_router_brownout_sheds_low_priority_and_flooder_first(
+        two_fakes, monkeypatch):
+    """Brownout admission control: past the pressure SLO the router
+    sheds un-prioritized work and the flooder tenant's work with a
+    Retry-After 429 BEFORE it queues; prioritized well-behaved tenants
+    still land."""
+    monkeypatch.setenv("MXTPU_FLEET_BROWNOUT_MS", "100")
+    for f in two_fakes:
+        f.est_wait = {"a": 500.0, "b": 500.0}
+    two_fakes[0].tenants = {"a": {"noisy": 9}}
+    router = _mk_router(two_fakes, spill_queue=4)
+    router.probe()
+    body = json.dumps({"inputs": {"data": [0, 0, 0, 0]}}).encode()
+    # priority 0 (default): shed
+    status, data, _ = router.proxy_predict(
+        "a", body, {"Content-Type": "application/json"})
+    assert status == 429
+    payload = json.loads(data.decode())
+    assert payload["reason"] == "brownout"
+    assert payload["retry_after_s"] > 0
+    # flooder tenant: shed even at priority
+    status, _, _ = router.proxy_predict(
+        "a", body, {"Content-Type": "application/json",
+                    "X-MXTPU-Priority": "5",
+                    "X-MXTPU-Tenant": "noisy"})
+    assert status == 429
+    # prioritized well-behaved tenant: admitted
+    status, _, _ = router.proxy_predict(
+        "a", body, {"Content-Type": "application/json",
+                    "X-MXTPU-Priority": "5",
+                    "X-MXTPU-Tenant": "quiet"})
+    assert status == 200
+    counters = router.stats.snapshot()["counters"]
+    assert counters["brownout_shed"] == 2
+    assert counters["brownout_shed:-"] == 1
+    assert counters["brownout_shed:noisy"] == 1
+    assert router.stats_payload()["brownout"]["active"] is True
+
+
+def test_outlier_detector_ejects_then_half_open_rejoin():
+    """Unit shape of the detector: the replica whose recent p99 sits
+    k-x above the fleet median is ejected (never below the N-1 floor),
+    then rejoins via half-open probation once its samples come back
+    clean."""
+    from mxnet_tpu.fleet.view import OutlierDetector
+    det = OutlierDetector(eject_x=3.0, min_samples=3, hold_s=5.0)
+    assert det.enabled
+    routable = {0, 1, 2}
+    lat = {0: 10.0, 1: 12.0, 2: 400.0}
+    t = 100.0
+    for _ in range(4):
+        events = det.update(routable, lat, {}, now=t)
+        t += 1.0
+    assert det.counters["ejects"] == 1
+    assert det.ejected(now=t) == {2}
+    # held out for hold_s, then promoted to half-open (routable again)
+    t += 10.0
+    assert det.ejected(now=t) == set()
+    export = det.export(now=t)
+    assert export[2]["half_open"] is True
+    # clean samples on probation: reinstated for good
+    det.update(routable, {0: 10.0, 1: 12.0, 2: 11.0}, {}, now=t)
+    assert det.counters["eject_rejoins"] == 1
+    assert det.export(now=t)[2]["half_open"] is False
+
+
+def test_outlier_detector_respects_routable_floor():
+    """max-eject / N-1 floor: of a two-replica fleet the detector may
+    eject at most zero replicas (int(0.5*2)=1, n-1=1 -> 1; but a
+    two-way split keeps the upper median at the outlier so latency
+    never trips) — error streaks CAN trip it, and the second streak is
+    refused on the floor."""
+    from mxnet_tpu.fleet.view import OutlierDetector
+    det = OutlierDetector(eject_x=3.0, min_samples=3, hold_s=60.0,
+                          error_streak=2)
+    errs = {0: 0, 1: 0}
+    t = 100.0
+    det.update({0, 1}, {}, dict(errs), now=t)
+    for _ in range(3):      # both replicas grow error streaks together
+        t += 1.0
+        errs = {r: errs[r] + 1 for r in errs}
+        det.update({0, 1}, {}, dict(errs), now=t)
+    # one ejected, the other refused on the N-1 floor
+    assert det.counters["ejects"] == 1
+    assert det.counters["eject_blocked_floor"] >= 1
+    assert len(det.ejected(now=t)) == 1
+
+
+def test_router_folds_ejection_into_healthy_and_stats(
+        two_fakes, monkeypatch):
+    """Router integration: with MXTPU_FLEET_EJECT_X armed, a
+    gray-slow replica (fast /healthz, huge reported p99) drops out of
+    ``healthy()`` after enough probe passes and surfaces as
+    ``ejected`` in /stats; traffic reroutes around it."""
+    monkeypatch.setenv("MXTPU_FLEET_EJECT_X", "3")
+    third = _FakeReplica()
+    fakes = two_fakes + [third]
+    try:
+        fakes[0].p99_recent = 900.0     # gray: healthz fine, p99 awful
+        fakes[1].p99_recent = 10.0
+        fakes[2].p99_recent = 12.0
+        router = _mk_router(fakes)
+        for _ in range(4):
+            router.probe()
+        assert 0 not in router.healthy()
+        assert sorted(router.healthy()) == [1, 2]
+        payload = router.stats_payload()
+        assert payload["replicas"][0]["ejected"] is True
+        assert payload["replicas"][1]["ejected"] is False
+        assert payload["ejection"][0]["ejected"] is True
+        counters = router.stats.snapshot()["counters"]
+        assert counters["ejects"] == 1
+        # predicts route around the ejected outlier
+        _predict(router, "a", 3)
+        assert len(fakes[0].received) == 0
+    finally:
+        third.close()
 
 
 def test_router_no_healthy_replica_is_503(two_fakes):

@@ -1869,3 +1869,172 @@ def test_watcher_rejects_damaged_shard_exactly_once(tmp_path):
     assert out["action"] == "rejected" and out.get("already_counted")
     assert watcher.counters["rejected"] == 1
     assert entry.loaded_epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# exactly-once: the replica-side idempotency cache + client request ids
+# ---------------------------------------------------------------------------
+
+def test_dedup_completed_replay_is_bit_identical_without_reexecution():
+    """A duplicate of a COMPLETED request replays the cached response
+    bytes — bit-identical payload, batcher never re-entered (accepted
+    counter unchanged)."""
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, buckets=(1,), max_wait_ms=0)
+    x = np.random.RandomState(0).randn(32).astype("f")
+    status1, p1 = fe.handle_predict("m", {"data": x}, request_id="r1")
+    assert status1 == 200
+    assert fe.stats.snapshot()["counters"]["accepted"] == 1
+    status2, p2 = fe.handle_predict("m", {"data": x}, request_id="r1")
+    assert status2 == 200
+    assert json.dumps(p2).encode() == json.dumps(p1).encode()
+    counters = fe.stats.snapshot()["counters"]
+    assert counters["accepted"] == 1        # no second execution
+    assert counters["dedup_hits"] == 1
+    assert fe.stats_payload()["dedup"]["entries"] == 1
+
+
+def test_dedup_keys_scope_by_tenant_and_request_id():
+    """(tenant, request id) is the key: the same id from two tenants is
+    two executions; two different ids are two executions."""
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, buckets=(1,), max_wait_ms=0)
+    x = np.zeros((32,), "f")
+    fe.handle_predict("m", {"data": x}, request_id="r", tenant="t1")
+    fe.handle_predict("m", {"data": x}, request_id="r", tenant="t2")
+    fe.handle_predict("m", {"data": x}, request_id="r2", tenant="t1")
+    counters = fe.stats.snapshot()["counters"]
+    assert counters["accepted"] == 3
+    assert counters.get("dedup_hits", 0) == 0
+
+
+def test_dedup_inflight_duplicate_joins_the_one_execution():
+    """A duplicate arriving while the original is still executing
+    BLOCKS on the original's completion and shares its answer — one
+    execution, two identical responses."""
+    release = threading.Event()
+    pool, _, _, _ = make_pool()
+    entry = pool.get("m")
+    real_forward = entry.forward
+
+    def slow_forward(inputs, n=None):
+        release.wait(30)
+        return real_forward(inputs, n)
+
+    entry.forward = slow_forward
+    fe = ServingFrontend(pool, buckets=(1,), max_wait_ms=0)
+    x = np.random.RandomState(1).randn(32).astype("f")
+    out = [None, None]
+
+    def call(i):
+        out[i] = fe.handle_predict("m", {"data": x}, request_id="dup")
+
+    t1 = threading.Thread(target=call, args=(0,))
+    t1.start()
+    deadline = time.monotonic() + 5
+    while not fe.dedup._inflight:       # original claimed its slot
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    t2 = threading.Thread(target=call, args=(1,))
+    t2.start()
+    time.sleep(0.1)
+    assert out[1] is None, "duplicate must block, not double-execute"
+    release.set()
+    t1.join(10)
+    t2.join(10)
+    assert out[0][0] == 200 and out[1][0] == 200
+    assert json.dumps(out[0][1]) == json.dumps(out[1][1])
+    counters = fe.stats.snapshot()["counters"]
+    assert counters["accepted"] == 1
+    assert counters["dedup_joined"] == 1
+
+
+def test_dedup_ttl_and_size_eviction(monkeypatch):
+    """Bounds hold: an entry past MXTPU_SERVE_DEDUP_TTL_S re-executes
+    (dedup_evicted_ttl), and the cap evicts oldest-first
+    (dedup_evicted_size)."""
+    monkeypatch.setenv("MXTPU_SERVE_DEDUP_TTL_S", "0.05")
+    monkeypatch.setenv("MXTPU_SERVE_DEDUP_CAP", "2")
+    pool, _, _, _ = make_pool()
+    fe = ServingFrontend(pool, buckets=(1,), max_wait_ms=0)
+    x = np.zeros((32,), "f")
+    fe.handle_predict("m", {"data": x}, request_id="r1")
+    time.sleep(0.12)
+    fe.handle_predict("m", {"data": x}, request_id="r1")
+    counters = fe.stats.snapshot()["counters"]
+    assert counters["accepted"] == 2            # TTL expired: re-ran
+    assert counters["dedup_evicted_ttl"] >= 1
+    # cap=2: r2, r3 push the refreshed r1 out oldest-first
+    fe.handle_predict("m", {"data": x}, request_id="r2")
+    fe.handle_predict("m", {"data": x}, request_id="r3")
+    counters = fe.stats.snapshot()["counters"]
+    assert counters["dedup_evicted_size"] >= 1
+    assert fe.stats_payload()["dedup"]["entries"] <= 2
+
+
+class _HeaderEcho(object):
+    """Tiny HTTP server echoing the request-id header + client port —
+    enough to observe what ServeClient actually puts on the wire."""
+
+    def __init__(self):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        echo = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                echo.seen.append(
+                    (self.headers.get("X-MXTPU-Request-Id"),
+                     self.client_address[1]))
+                body = json.dumps({"ok": True}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.seen = []
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_serve_client_stamps_request_ids_and_retires_idle_conn():
+    """Every ServeClient.predict carries an auto-generated
+    X-MXTPU-Request-Id (distinct per call, caller-overridable), and an
+    idle keep-alive connection is proactively retired after
+    CONN_IDLE_S — the next request opens a FRESH socket instead of
+    racing the server's idle close (PR 11's router-side bug class)."""
+    echo = _HeaderEcho()
+    try:
+        client = ServeClient("127.0.0.1", echo.port)
+        client.CONN_IDLE_S = 0.1        # instance override for the test
+        client.predict("m", np.zeros((4,), "f"))
+        client.predict("m", np.zeros((4,), "f"))
+        client.predict("m", np.zeros((4,), "f"),
+                       request_id="caller-chosen")
+        assert len(echo.seen) == 3
+        ids = [rid for rid, _ in echo.seen]
+        assert all(ids) and ids[0] != ids[1]
+        assert ids[2] == "caller-chosen"
+        # back-to-back requests reuse the keep-alive socket
+        assert echo.seen[0][1] == echo.seen[1][1] == echo.seen[2][1]
+        time.sleep(0.25)                # > CONN_IDLE_S: retire it
+        client.predict("m", np.zeros((4,), "f"))
+        assert echo.seen[3][1] != echo.seen[0][1], \
+            "post-idle request must ride a fresh connection"
+        client.close()
+    finally:
+        echo.close()

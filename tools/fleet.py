@@ -145,9 +145,19 @@ def _cmd_serve(fleet, args):
         from mxnet_tpu.base import get_env as _get_env
         workers_n = int(_get_env(fleet.ENV_FLEET_WORKERS))
     man.router_workers = int(workers_n)
+    env_by_rid = {}
+    for spec in getattr(args, "replica_env", []):
+        try:
+            rid, assign = spec.split(":", 1)
+            name, value = assign.split("=", 1)
+        except ValueError:
+            raise SystemExit("--replica-env wants RID:NAME=VALUE, "
+                             "got %r" % spec)
+        env_by_rid.setdefault(int(rid), {})[name] = value
     controller = fleet.ReplicaController(
         man, run_dir, warm_store=args.warm_store,
-        max_restarts=args.max_restarts, log=_log)
+        max_restarts=args.max_restarts, extra_env_by_rid=env_by_rid,
+        log=_log)
     # sharded mode: this router never serves HTTP — it is the
     # controller-side PROBER (health loop, fence state, capacity
     # floor) behind the view publisher; port 0 keeps the public port
@@ -341,6 +351,12 @@ def main(argv=None):
     p_serve.add_argument("--run-dir", default=None,
                          help="replica port files + logs (default: "
                               "under --warm-store or cwd)")
+    p_serve.add_argument("--replica-env", action="append", default=[],
+                         metavar="RID:NAME=VALUE",
+                         help="extra env for ONE replica (repeatable) "
+                              "— e.g. 0:MXTPU_FAULTS=slow_replica:100 "
+                              "arms a fault on replica 0 only (chaos "
+                              "drills, bench.py tail)")
     p_serve.add_argument("--max-restarts", type=int, default=3,
                          help="per-replica consecutive-relaunch budget")
     p_serve.add_argument("--slo-ms", type=float, default=0.0,
